@@ -1,0 +1,9 @@
+"""quokka-tpu: a TPU-native, push-based, pipelined distributed query engine.
+
+Capabilities modeled on marsupialtail/quokka (see SURVEY.md): a lazy
+Polars-like DataStream API over a streaming task runtime with lineage-based
+fault tolerance — with per-batch columnar compute rebuilt as JAX/XLA kernels
+on TPU instead of Polars/DuckDB on CPU.
+"""
+
+__version__ = "0.1.0"

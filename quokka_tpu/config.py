@@ -1,0 +1,79 @@
+"""Global configuration for quokka-tpu.
+
+Dtype and shape policy for the device kernel layer.  The reference engine
+(pyquokka) runs ragged Polars batches; XLA wants static shapes, so every batch
+is padded up to a "bucket" size and carries a validity mask.  Buckets are
+geometric so each (kernel, bucket, dtype-signature) compiles at most once and
+the compile cache stays small.
+
+Float policy: on CPU test meshes we enable x64 and compute in float64 (exact
+oracle comparisons); on TPU we keep float32 data with float64 host-side final
+combines (TPU f64 is software-emulated and slow, and the MXU/VPU want 32-bit).
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+# ---------------------------------------------------------------------------
+# Padding buckets
+# ---------------------------------------------------------------------------
+
+MIN_BUCKET = 256
+MAX_BUCKET = 1 << 24
+
+
+def bucket_size(n: int) -> int:
+    """Smallest padding bucket that fits n rows (next power of two, floored at
+    MIN_BUCKET). Static-shape discipline: all kernels see bucketed lengths."""
+    if n <= MIN_BUCKET:
+        return MIN_BUCKET
+    b = 1 << (int(n - 1).bit_length())
+    if b > MAX_BUCKET:
+        raise ValueError(f"batch of {n} rows exceeds max bucket {MAX_BUCKET}")
+    return b
+
+
+# ---------------------------------------------------------------------------
+# Dtype policy
+# ---------------------------------------------------------------------------
+
+
+def _platform() -> str:
+    try:
+        return jax.default_backend()
+    except Exception:  # pragma: no cover
+        return "cpu"
+
+
+def x64_enabled() -> bool:
+    return bool(jax.config.read("jax_enable_x64"))
+
+
+def float_dtype():
+    """float64 when x64 is on (CPU test meshes), else float32 (TPU)."""
+    return jnp.float64 if x64_enabled() else jnp.float32
+
+
+def int_dtype():
+    return jnp.int64 if x64_enabled() else jnp.int32
+
+
+# Default batch target: how many rows a reader should aim to emit per batch.
+DEFAULT_BATCH_ROWS = int(os.environ.get("QUOKKA_TPU_BATCH_ROWS", 1 << 20))
+
+# Executor/runtime defaults (mirrors the reference's exec_config knobs,
+# pyquokka/df.py:63-66, rebuilt as a flat dict).
+DEFAULT_EXEC_CONFIG = {
+    "hbq_path": "/tmp/quokka_tpu_spill/",
+    "fault_tolerance": False,
+    "memory_limit": 0.25,
+    "max_pipeline_batches": 30,
+    "checkpoint_interval": None,
+    "checkpoint_bucket": None,
+    "max_pipeline": 4,
+    "batch_attempt": 4,
+}

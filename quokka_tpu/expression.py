@@ -1,0 +1,648 @@
+"""Backend-independent expression AST.
+
+Role parallel to the reference's Expression wrapper over sqlglot columns
+(pyquokka/expression.py:5) — but since this framework owns its whole compile
+path (sqlglot is not a dependency), the AST here is first-class: the DataStream
+API builds it via operator overloading, the SQL parser (quokka_tpu.sqlparse)
+builds it from text, the optimizer rewrites it, and ops/expr_compile lowers it
+to jitted JAX kernels.
+"""
+
+from __future__ import annotations
+
+import datetime
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+EPOCH = datetime.date(1970, 1, 1)
+
+
+def date_to_days(d) -> int:
+    if isinstance(d, str):
+        d = datetime.date.fromisoformat(d)
+    return (d - EPOCH).days
+
+
+class Expr:
+    """Base expression node."""
+
+    # -- operator overloading ------------------------------------------------
+    def _bin(self, op, other, reverse=False):
+        other = lit_wrap(other)
+        return BinOp(op, other, self) if reverse else BinOp(op, self, other)
+
+    def __add__(self, o):
+        return self._bin("+", o)
+
+    def __radd__(self, o):
+        return self._bin("+", o, True)
+
+    def __sub__(self, o):
+        return self._bin("-", o)
+
+    def __rsub__(self, o):
+        return self._bin("-", o, True)
+
+    def __mul__(self, o):
+        return self._bin("*", o)
+
+    def __rmul__(self, o):
+        return self._bin("*", o, True)
+
+    def __truediv__(self, o):
+        return self._bin("/", o)
+
+    def __rtruediv__(self, o):
+        return self._bin("/", o, True)
+
+    def __floordiv__(self, o):
+        return self._bin("//", o)
+
+    def __mod__(self, o):
+        return self._bin("%", o)
+
+    def __eq__(self, o):  # type: ignore[override]
+        return self._bin("=", o)
+
+    def __ne__(self, o):  # type: ignore[override]
+        return self._bin("!=", o)
+
+    def __lt__(self, o):
+        return self._bin("<", o)
+
+    def __le__(self, o):
+        return self._bin("<=", o)
+
+    def __gt__(self, o):
+        return self._bin(">", o)
+
+    def __ge__(self, o):
+        return self._bin(">=", o)
+
+    def __and__(self, o):
+        return self._bin("and", o)
+
+    def __rand__(self, o):
+        return self._bin("and", o, True)
+
+    def __or__(self, o):
+        return self._bin("or", o)
+
+    def __ror__(self, o):
+        return self._bin("or", o, True)
+
+    def __invert__(self):
+        return UnaryOp("not", self)
+
+    def __neg__(self):
+        return UnaryOp("-", self)
+
+    def __hash__(self):
+        return id(self)
+
+    # -- methods -------------------------------------------------------------
+    def alias(self, name: str) -> "Alias":
+        return Alias(self, name)
+
+    def is_in(self, values: Sequence) -> "InList":
+        return InList(self, list(values))
+
+    def is_null(self) -> "IsNull":
+        return IsNull(self, False)
+
+    def is_not_null(self) -> "IsNull":
+        return IsNull(self, True)
+
+    def between(self, lo, hi) -> "Expr":
+        return (self >= lit_wrap(lo)) & (self <= lit_wrap(hi))
+
+    def cast(self, to: str) -> "Cast":
+        return Cast(self, to)
+
+    def abs(self):
+        return Func("abs", [self])
+
+    def round(self, n=0):
+        return Func("round", [self, Literal(n)])
+
+    def sqrt(self):
+        return Func("sqrt", [self])
+
+    def exp(self):
+        return Func("exp", [self])
+
+    def ln(self):
+        return Func("ln", [self])
+
+    def floor(self):
+        return Func("floor", [self])
+
+    def ceil(self):
+        return Func("ceil", [self])
+
+    @property
+    def str(self):
+        return StrNamespace(self)
+
+    @property
+    def dt(self):
+        return DtNamespace(self)
+
+    # -- aggregation builders (usable in agg contexts) -----------------------
+    def sum(self):
+        return Agg("sum", self)
+
+    def mean(self):
+        return Agg("avg", self)
+
+    def avg(self):
+        return Agg("avg", self)
+
+    def min(self):
+        return Agg("min", self)
+
+    def max(self):
+        return Agg("max", self)
+
+    def count(self):
+        return Agg("count", self)
+
+    # -- analysis ------------------------------------------------------------
+    def required_columns(self) -> set:
+        out = set()
+        _walk_required(self, out)
+        return out
+
+    def children(self) -> List["Expr"]:
+        return []
+
+    def sql(self) -> str:
+        raise NotImplementedError
+
+    def __repr__(self):
+        try:
+            return f"Expr({self.sql()})"
+        except Exception:
+            return object.__repr__(self)
+
+    def __bool__(self):
+        raise TypeError(
+            "Expression truth value is ambiguous; use & / | instead of and / or"
+        )
+
+
+def _walk_required(e: Expr, out: set):
+    if isinstance(e, ColRef):
+        out.add(e.name)
+    for c in e.children():
+        _walk_required(c, out)
+
+
+def lit_wrap(v) -> Expr:
+    if isinstance(v, Expr):
+        return v
+    if isinstance(v, (datetime.date, datetime.datetime)):
+        return DateLit(v)
+    return Literal(v)
+
+
+class ColRef(Expr):
+    def __init__(self, name: str):
+        self.name = name
+
+    def sql(self):
+        return self.name
+
+
+class Literal(Expr):
+    def __init__(self, value: Any):
+        self.value = value
+
+    def sql(self):
+        if isinstance(self.value, str):
+            return "'" + self.value.replace("'", "''") + "'"
+        if self.value is None:
+            return "NULL"
+        return repr(self.value)
+
+
+class DateLit(Expr):
+    """A date (or timestamp) literal, held as days since epoch (date) or a
+    datetime (timestamp)."""
+
+    def __init__(self, value):
+        if isinstance(value, str):
+            if len(value) > 10:
+                value = datetime.datetime.fromisoformat(value)
+            else:
+                value = datetime.date.fromisoformat(value)
+        self.value = value
+
+    @property
+    def days(self) -> int:
+        v = self.value
+        if isinstance(v, datetime.datetime):
+            v = v.date()
+        return date_to_days(v)
+
+    def sql(self):
+        return f"date '{self.value.isoformat()}'"
+
+
+class IntervalLit(Expr):
+    """interval 'n' unit — value normalized to (months, microseconds)."""
+
+    UNIT_US = {
+        "second": 1_000_000,
+        "minute": 60_000_000,
+        "hour": 3_600_000_000,
+        "day": 86_400_000_000,
+        "week": 7 * 86_400_000_000,
+    }
+
+    def __init__(self, n: float, unit: str):
+        unit = unit.rstrip("s").lower()
+        self.n = n
+        self.unit = unit
+        if unit in ("month", "year"):
+            self.months = int(n) * (12 if unit == "year" else 1)
+            self.micros = 0
+        else:
+            self.months = 0
+            self.micros = int(n * self.UNIT_US[unit])
+
+    @property
+    def days(self) -> int:
+        return self.micros // 86_400_000_000
+
+    def sql(self):
+        return f"interval '{self.n}' {self.unit}"
+
+
+class BinOp(Expr):
+    def __init__(self, op: str, left: Expr, right: Expr):
+        self.op = op
+        self.left = left
+        self.right = right
+
+    def children(self):
+        return [self.left, self.right]
+
+    def sql(self):
+        return f"({self.left.sql()} {self.op} {self.right.sql()})"
+
+
+class UnaryOp(Expr):
+    def __init__(self, op: str, operand: Expr):
+        self.op = op
+        self.operand = operand
+
+    def children(self):
+        return [self.operand]
+
+    def sql(self):
+        return f"({self.op} {self.operand.sql()})"
+
+
+class Func(Expr):
+    def __init__(self, name: str, args: List[Expr]):
+        self.name = name.lower()
+        self.args = args
+
+    def children(self):
+        return list(self.args)
+
+    def sql(self):
+        return f"{self.name}({', '.join(a.sql() for a in self.args)})"
+
+
+class Cast(Expr):
+    def __init__(self, expr: Expr, to: str):
+        self.expr = expr
+        self.to = to.lower()
+
+    def children(self):
+        return [self.expr]
+
+    def sql(self):
+        return f"cast({self.expr.sql()} as {self.to})"
+
+
+class Alias(Expr):
+    def __init__(self, expr: Expr, name: str):
+        self.expr = expr
+        self.name = name
+
+    def children(self):
+        return [self.expr]
+
+    def sql(self):
+        return f"{self.expr.sql()} as {self.name}"
+
+
+class InList(Expr):
+    def __init__(self, expr: Expr, values: List, negated: bool = False):
+        self.expr = expr
+        self.values = values
+        self.negated = negated
+
+    def children(self):
+        return [self.expr]
+
+    def sql(self):
+        neg = "not " if self.negated else ""
+        vals = ", ".join(Literal(v).sql() if not isinstance(v, Expr) else v.sql() for v in self.values)
+        return f"({self.expr.sql()} {neg}in ({vals}))"
+
+
+class IsNull(Expr):
+    def __init__(self, expr: Expr, negated: bool):
+        self.expr = expr
+        self.negated = negated
+
+    def children(self):
+        return [self.expr]
+
+    def sql(self):
+        return f"({self.expr.sql()} is {'not ' if self.negated else ''}null)"
+
+
+class Case(Expr):
+    def __init__(self, whens: List[Tuple[Expr, Expr]], default: Optional[Expr]):
+        self.whens = whens
+        self.default = default
+
+    def children(self):
+        out = []
+        for c, v in self.whens:
+            out.extend([c, v])
+        if self.default is not None:
+            out.append(self.default)
+        return out
+
+    def sql(self):
+        parts = ["case"]
+        for c, v in self.whens:
+            parts.append(f"when {c.sql()} then {v.sql()}")
+        if self.default is not None:
+            parts.append(f"else {self.default.sql()}")
+        parts.append("end")
+        return " ".join(parts)
+
+
+class Agg(Expr):
+    """An aggregate call.  op in sum/avg/min/max/count/count_distinct;
+    arg None means count(*)."""
+
+    def __init__(self, op: str, arg: Optional[Expr], distinct: bool = False):
+        self.op = op.lower()
+        self.arg = arg
+        self.distinct = distinct
+
+    def children(self):
+        return [] if self.arg is None else [self.arg]
+
+    def sql(self):
+        inner = "*" if self.arg is None else self.arg.sql()
+        d = "distinct " if self.distinct else ""
+        return f"{self.op}({d}{inner})"
+
+
+class StrOp(Expr):
+    """String predicate/transform evaluated on the dictionary host-side."""
+
+    def __init__(self, op: str, expr: Expr, args: List):
+        self.op = op
+        self.expr = expr
+        self.args = args
+
+    def children(self):
+        return [self.expr]
+
+    def sql(self):
+        if self.op == "like":
+            return f"({self.expr.sql()} like {Literal(self.args[0]).sql()})"
+        return f"{self.op}({self.expr.sql()}, {', '.join(map(repr, self.args))})"
+
+
+class StrNamespace:
+    def __init__(self, expr: Expr):
+        self._e = expr
+
+    def contains(self, pat: str):
+        return StrOp("contains", self._e, [pat])
+
+    def starts_with(self, pat: str):
+        return StrOp("starts_with", self._e, [pat])
+
+    def ends_with(self, pat: str):
+        return StrOp("ends_with", self._e, [pat])
+
+    def like(self, pat: str):
+        return StrOp("like", self._e, [pat])
+
+    def lower(self):
+        return StrOp("lower", self._e, [])
+
+    def upper(self):
+        return StrOp("upper", self._e, [])
+
+    def strip(self):
+        return StrOp("strip", self._e, [])
+
+    def length(self):
+        return StrOp("length", self._e, [])
+
+    def slice(self, offset: int, length: Optional[int] = None):
+        return StrOp("slice", self._e, [offset, length])
+
+    def json_extract(self, path: str):
+        return StrOp("json_extract", self._e, [path])
+
+    def hash(self):
+        return StrOp("hash", self._e, [])
+
+
+class DtField(Expr):
+    def __init__(self, field: str, expr: Expr):
+        self.field = field
+        self.expr = expr
+
+    def children(self):
+        return [self.expr]
+
+    def sql(self):
+        return f"extract({self.field} from {self.expr.sql()})"
+
+
+class DtNamespace:
+    def __init__(self, expr: Expr):
+        self._e = expr
+
+    @property
+    def year(self):
+        return DtField("year", self._e)
+
+    @property
+    def month(self):
+        return DtField("month", self._e)
+
+    @property
+    def day(self):
+        return DtField("day", self._e)
+
+    @property
+    def hour(self):
+        return DtField("hour", self._e)
+
+    @property
+    def minute(self):
+        return DtField("minute", self._e)
+
+    @property
+    def second(self):
+        return DtField("second", self._e)
+
+    @property
+    def weekday(self):
+        return DtField("weekday", self._e)
+
+    def offset_by(self, interval: "IntervalLit"):
+        return BinOp("+", self._e, interval)
+
+    def truncate(self, every: str):
+        return Func("date_trunc", [Literal(every), self._e])
+
+
+# ---------------------------------------------------------------------------
+# public constructors
+# ---------------------------------------------------------------------------
+
+
+def col(name: str) -> ColRef:
+    return ColRef(name)
+
+
+def lit(v) -> Expr:
+    return lit_wrap(v)
+
+
+def date(s) -> DateLit:
+    return DateLit(s)
+
+
+def interval(n, unit: str) -> IntervalLit:
+    return IntervalLit(n, unit)
+
+
+def when(cond: Expr):
+    """when(cond).then(v).otherwise(d) builder."""
+
+    class _When:
+        def __init__(self, whens):
+            self._whens = whens
+
+        def then(self, v):
+            w = self._whens + [(cond, lit_wrap(v))]
+
+            class _Then:
+                def when(self, c2):
+                    return when_chain(w, c2)
+
+                def otherwise(self, d):
+                    return Case(w, lit_wrap(d))
+
+                def end(self):
+                    return Case(w, None)
+
+            return _Then()
+
+    return _When([])
+
+
+def when_chain(whens, cond):
+    class _When:
+        def then(self, v):
+            w = whens + [(cond, lit_wrap(v))]
+
+            class _Then:
+                def when(self, c2):
+                    return when_chain(w, c2)
+
+                def otherwise(self, d):
+                    return Case(w, lit_wrap(d))
+
+                def end(self):
+                    return Case(w, None)
+
+            return _Then()
+
+    return _When()
+
+
+# ---------------------------------------------------------------------------
+# rewriting / analysis helpers used by the optimizer
+# ---------------------------------------------------------------------------
+
+
+def split_conjuncts(e: Expr) -> List[Expr]:
+    """Flatten a predicate into CNF-ish top-level AND conjuncts (the unit of
+    predicate pushdown, as in the reference's per-parent conjunct routing,
+    pyquokka/df.py:1029-1139)."""
+    if isinstance(e, BinOp) and e.op == "and":
+        return split_conjuncts(e.left) + split_conjuncts(e.right)
+    return [e]
+
+
+def conjoin(exprs: Sequence[Expr]) -> Optional[Expr]:
+    exprs = list(exprs)
+    if not exprs:
+        return None
+    out = exprs[0]
+    for e in exprs[1:]:
+        out = BinOp("and", out, e)
+    return out
+
+
+def rename_columns(e: Expr, mapping: Dict[str, str]) -> Expr:
+    """Return a copy of e with column refs renamed (schema_mapping walks)."""
+    if isinstance(e, ColRef):
+        return ColRef(mapping.get(e.name, e.name))
+    return _rebuild(e, [rename_columns(c, mapping) for c in e.children()])
+
+
+def substitute_columns(e: Expr, mapping: Dict[str, Expr]) -> Expr:
+    """Replace column refs by expressions (used by map folding)."""
+    if isinstance(e, ColRef):
+        return mapping.get(e.name, e)
+    return _rebuild(e, [substitute_columns(c, mapping) for c in e.children()])
+
+
+def _rebuild(e: Expr, kids: List[Expr]) -> Expr:
+    if isinstance(e, BinOp):
+        return BinOp(e.op, kids[0], kids[1])
+    if isinstance(e, UnaryOp):
+        return UnaryOp(e.op, kids[0])
+    if isinstance(e, Func):
+        return Func(e.name, kids)
+    if isinstance(e, Cast):
+        return Cast(kids[0], e.to)
+    if isinstance(e, Alias):
+        return Alias(kids[0], e.name)
+    if isinstance(e, InList):
+        return InList(kids[0], e.values, e.negated)
+    if isinstance(e, IsNull):
+        return IsNull(kids[0], e.negated)
+    if isinstance(e, StrOp):
+        return StrOp(e.op, kids[0], e.args)
+    if isinstance(e, DtField):
+        return DtField(e.field, kids[0])
+    if isinstance(e, Agg):
+        return Agg(e.op, kids[0] if kids else None, e.distinct)
+    if isinstance(e, Case):
+        n = len(e.whens)
+        whens = [(kids[2 * i], kids[2 * i + 1]) for i in range(n)]
+        default = kids[2 * n] if len(kids) > 2 * n else None
+        return Case(whens, default)
+    if not kids:
+        return e
+    raise NotImplementedError(type(e))

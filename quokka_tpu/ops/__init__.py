@@ -1,0 +1,2 @@
+from quokka_tpu.ops.batch import DeviceBatch, NumCol, StrCol, StringDict, key_limbs
+from quokka_tpu.ops.bridge import arrow_to_device, concat_batches, device_to_arrow, to_pandas

@@ -1,0 +1,213 @@
+"""Device-resident columnar batch.
+
+The unit of data flowing through the engine.  Where the reference keeps Polars
+DataFrames on the host (pyquokka/core.py push/execute paths), quokka-tpu keeps
+batches as dicts of padded ``jax.Array`` columns plus a validity mask, so every
+relational kernel (filter/project/hash/agg/join) is a jitted XLA program with
+static shapes.
+
+Strings are dictionary-encoded at ingest: the device sees only int32 codes; the
+dictionary (small: unique values) stays on the host together with 64-bit FNV
+hashes split into two uint32 limbs (TPU-native — no 64-bit ints needed on
+device).  Predicates on strings are evaluated once on the dictionary host-side
+and gathered by code on device; joins/groupbys on strings use the hash limbs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from quokka_tpu import config
+
+# ---------------------------------------------------------------------------
+# String dictionaries
+# ---------------------------------------------------------------------------
+
+_FNV_OFFSET = 0xCBF29CE484222325
+_FNV_PRIME = 0x100000001B3
+_MASK64 = 0xFFFFFFFFFFFFFFFF
+
+
+def fnv1a64(s: str) -> int:
+    """Stable 64-bit FNV-1a hash (process-independent, unlike Python hash())."""
+    h = _FNV_OFFSET
+    for b in s.encode("utf-8", errors="surrogatepass"):
+        h = ((h ^ b) * _FNV_PRIME) & _MASK64
+    return h
+
+
+def _hash_strings(values: Sequence) -> np.ndarray:
+    try:
+        from quokka_tpu.utils import native  # C++ fast path if built
+
+        out = native.fnv1a64_many(values)
+        if out is not None:
+            return out
+    except Exception:
+        pass
+    return np.array([fnv1a64(v) if v is not None else 0 for v in values], dtype=np.uint64)
+
+
+class StringDict:
+    """Host-side dictionary for a string column: values + 64-bit hashes as
+    two uint32 limb arrays (device-friendly)."""
+
+    def __init__(self, values: np.ndarray):
+        # values: np object/str array of unique strings (may contain None)
+        self.values = np.asarray(values, dtype=object)
+        self._h64: Optional[np.ndarray] = None
+
+    def __len__(self) -> int:
+        return len(self.values)
+
+    @property
+    def h64(self) -> np.ndarray:
+        if self._h64 is None:
+            self._h64 = _hash_strings(self.values)
+        return self._h64
+
+    @property
+    def hash_hi(self) -> np.ndarray:
+        return (self.h64 >> np.uint64(32)).astype(np.uint32).astype(np.int32)
+
+    @property
+    def hash_lo(self) -> np.ndarray:
+        return (self.h64 & np.uint64(0xFFFFFFFF)).astype(np.uint32).astype(np.int32)
+
+    def code_of(self, literal: str) -> int:
+        """Code of a literal in this dictionary, or -1 if absent."""
+        hits = np.nonzero(self.values == literal)[0]
+        return int(hits[0]) if len(hits) else -1
+
+
+# ---------------------------------------------------------------------------
+# Columns
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class NumCol:
+    """Numeric / boolean / date / timestamp column on device.
+
+    kind: 'f' float, 'i' int, 'b' bool, 'd' date32 (days), 't' timestamp.
+    ``hi`` is the optional high 32-bit limb for wide integers/timestamps when
+    running without x64 (TPU): value = hi * 2^32 + uint32(data).
+    """
+
+    data: jax.Array
+    kind: str = "f"
+    hi: Optional[jax.Array] = None
+    unit: Optional[str] = None  # timestamp unit ('s','ms','us','ns')
+
+    @property
+    def padded_len(self) -> int:
+        return self.data.shape[0]
+
+    def take(self, idx: jax.Array) -> "NumCol":
+        return NumCol(
+            self.data[idx], self.kind, None if self.hi is None else self.hi[idx], self.unit
+        )
+
+
+@dataclasses.dataclass
+class StrCol:
+    """Dictionary-encoded string column: int32 codes on device, dict on host."""
+
+    codes: jax.Array
+    dictionary: StringDict
+
+    @property
+    def padded_len(self) -> int:
+        return self.codes.shape[0]
+
+    def hash_limbs(self):
+        """Two int32 device arrays (hi, lo) of the 64-bit value hash per row."""
+        hi = jnp.asarray(self.dictionary.hash_hi)[self.codes]
+        lo = jnp.asarray(self.dictionary.hash_lo)[self.codes]
+        return hi, lo
+
+    def take(self, idx: jax.Array) -> "StrCol":
+        return StrCol(self.codes[idx], self.dictionary)
+
+
+Column = object  # NumCol | StrCol
+
+
+# ---------------------------------------------------------------------------
+# Batch
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class DeviceBatch:
+    """A padded columnar batch.  ``valid`` marks live rows; all kernels must
+    respect it.  ``nrows`` is the host-known live count when available (None
+    after device-side filtering until a sync)."""
+
+    columns: Dict[str, Column]
+    valid: jax.Array  # bool[padded]
+    nrows: Optional[int] = None
+    sorted_by: Optional[List[str]] = None  # ordered-stream metadata
+
+    @property
+    def padded_len(self) -> int:
+        return self.valid.shape[0]
+
+    @property
+    def names(self) -> List[str]:
+        return list(self.columns.keys())
+
+    def count_valid(self) -> int:
+        if self.nrows is None:
+            self.nrows = int(jnp.sum(self.valid))
+        return self.nrows
+
+    def select(self, names: Sequence[str]) -> "DeviceBatch":
+        return DeviceBatch(
+            {n: self.columns[n] for n in names}, self.valid, self.nrows, self.sorted_by
+        )
+
+    def drop(self, names: Sequence[str]) -> "DeviceBatch":
+        keep = [n for n in self.columns if n not in set(names)]
+        return self.select(keep)
+
+    def rename(self, mapping: Dict[str, str]) -> "DeviceBatch":
+        return DeviceBatch(
+            {mapping.get(n, n): c for n, c in self.columns.items()},
+            self.valid,
+            self.nrows,
+            self.sorted_by,
+        )
+
+    def with_column(self, name: str, col: Column) -> "DeviceBatch":
+        cols = dict(self.columns)
+        cols[name] = col
+        return DeviceBatch(cols, self.valid, self.nrows, self.sorted_by)
+
+    def take(self, idx: jax.Array, valid: jax.Array, nrows: Optional[int]) -> "DeviceBatch":
+        return DeviceBatch(
+            {n: c.take(idx) for n, c in self.columns.items()}, valid, nrows, self.sorted_by
+        )
+
+
+def key_limbs(batch: DeviceBatch, cols: Sequence[str]) -> List[jax.Array]:
+    """Flatten key columns into a list of 32-bit (or native-width) integer/float
+    arrays usable as lexicographic sort keys and equality keys.  Strings become
+    their two hash limbs; wide ints contribute (hi, lo)."""
+    limbs: List[jax.Array] = []
+    for name in cols:
+        c = batch.columns[name]
+        if isinstance(c, StrCol):
+            hi, lo = c.hash_limbs()
+            limbs.append(hi)
+            limbs.append(lo)
+        else:
+            if c.hi is not None:
+                limbs.append(c.hi)
+            limbs.append(c.data)
+    return limbs

@@ -1,0 +1,219 @@
+"""Arrow <-> device bridge.
+
+Converts pyarrow Tables (what readers produce and writers consume) into
+DeviceBatch (what kernels consume).  Mirrors the role Polars conversion plays
+at pyquokka/core.py:287-299 (batch arrives -> to polars -> executor), but the
+target is padded jax Arrays with dictionary-encoded strings.
+
+Wide integers (int64 / timestamps) without x64: stored as two int32 limbs
+(hi = arithmetic >> 32, lo = low 32 bits with the sign bit flipped so that
+signed-int32 lexicographic (hi, lo) order equals numeric order).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from quokka_tpu import config
+from quokka_tpu.ops.batch import DeviceBatch, NumCol, StrCol, StringDict
+
+_I32_MIN = -(2**31)
+_I32_MAX = 2**31 - 1
+
+
+def _pad(arr: np.ndarray, padded: int, fill=0) -> np.ndarray:
+    n = len(arr)
+    if n == padded:
+        return arr
+    out = np.full(padded, fill, dtype=arr.dtype)
+    out[:n] = arr
+    return out
+
+
+def _wide_int_limbs(vals: np.ndarray, padded: int):
+    """Split int64 numpy values into (hi, lo_sortable) int32 limbs."""
+    hi = (vals >> np.int64(32)).astype(np.int32)
+    lo = (vals & np.int64(0xFFFFFFFF)).astype(np.uint32)
+    lo_sortable = (lo ^ np.uint32(0x80000000)).astype(np.int64) - 2**31
+    lo_sortable = lo_sortable.astype(np.int32)
+    return (
+        jnp.asarray(_pad(hi, padded)),
+        jnp.asarray(_pad(lo_sortable, padded)),
+    )
+
+
+def _limbs_to_int64(hi: np.ndarray, lo_sortable: np.ndarray) -> np.ndarray:
+    lo = (lo_sortable.astype(np.int64) + 2**31).astype(np.uint32) ^ np.uint32(0x80000000)
+    return (hi.astype(np.int64) << np.int64(32)) | lo.astype(np.int64)
+
+
+def _ints_to_col(vals: np.ndarray, padded: int, kind: str, unit=None) -> NumCol:
+    vals = np.ascontiguousarray(vals)
+    if config.x64_enabled():
+        return NumCol(jnp.asarray(_pad(vals.astype(np.int64), padded)), kind, unit=unit)
+    if vals.size == 0 or (vals.min() >= _I32_MIN and vals.max() <= _I32_MAX):
+        return NumCol(jnp.asarray(_pad(vals.astype(np.int32), padded)), kind, unit=unit)
+    hi, lo = _wide_int_limbs(vals.astype(np.int64), padded)
+    return NumCol(lo, kind, hi=hi, unit=unit)
+
+
+def arrow_column_to_device(arr: pa.ChunkedArray, padded: int):
+    if isinstance(arr, pa.ChunkedArray):
+        arr = arr.combine_chunks()
+    t = arr.type
+    if pa.types.is_dictionary(t):
+        codes = arr.indices.to_numpy(zero_copy_only=False).astype(np.int32)
+        values = arr.dictionary.to_pylist()
+        return StrCol(jnp.asarray(_pad(codes, padded)), StringDict(np.array(values, dtype=object)))
+    if pa.types.is_string(t) or pa.types.is_large_string(t):
+        enc = pc.dictionary_encode(arr)
+        if isinstance(enc, pa.ChunkedArray):
+            enc = enc.combine_chunks()
+        return arrow_column_to_device(enc, padded)
+    if arr.null_count:
+        arr = pc.fill_null(arr, 0)
+    if pa.types.is_boolean(t):
+        vals = arr.to_numpy(zero_copy_only=False).astype(np.bool_)
+        return NumCol(jnp.asarray(_pad(vals, padded, fill=False)), "b")
+    if pa.types.is_date32(t):
+        vals = arr.cast(pa.int32()).to_numpy(zero_copy_only=False)
+        return NumCol(jnp.asarray(_pad(vals.astype(np.int32), padded)), "d")
+    if pa.types.is_date64(t):
+        vals = arr.cast(pa.timestamp("ms")).cast(pa.int64()).to_numpy(zero_copy_only=False)
+        vals = vals // 86400000
+        return NumCol(jnp.asarray(_pad(vals.astype(np.int32), padded)), "d")
+    if pa.types.is_timestamp(t):
+        vals = arr.cast(pa.int64()).to_numpy(zero_copy_only=False)
+        return _ints_to_col(vals, padded, "t", unit=t.unit)
+    if pa.types.is_decimal(t):
+        vals = arr.cast(pa.float64()).to_numpy(zero_copy_only=False)
+        return NumCol(jnp.asarray(_pad(vals.astype(config.float_dtype()), padded)), "f")
+    if pa.types.is_integer(t):
+        vals = arr.to_numpy(zero_copy_only=False)
+        return _ints_to_col(vals, padded, "i")
+    if pa.types.is_floating(t):
+        vals = arr.to_numpy(zero_copy_only=False).astype(config.float_dtype())
+        return NumCol(jnp.asarray(_pad(vals, padded)), "f")
+    raise NotImplementedError(f"arrow type {t} not supported on device yet")
+
+
+def arrow_to_device(table: pa.Table, sorted_by: Optional[List[str]] = None) -> DeviceBatch:
+    n = table.num_rows
+    padded = config.bucket_size(n)
+    cols = {name: arrow_column_to_device(table.column(name), padded) for name in table.column_names}
+    valid = jnp.arange(padded) < n
+    return DeviceBatch(cols, valid, nrows=n, sorted_by=sorted_by)
+
+
+def device_to_arrow(batch: DeviceBatch) -> pa.Table:
+    """Sync a batch to the host as a compacted Arrow table (valid rows only)."""
+    mask = np.asarray(batch.valid)
+    arrays = []
+    names = []
+    for name, col in batch.columns.items():
+        names.append(name)
+        if isinstance(col, StrCol):
+            codes = np.asarray(col.codes)[mask]
+            vals = col.dictionary.values
+            out = np.empty(len(codes), dtype=object)
+            for i, c in enumerate(codes):
+                out[i] = vals[c] if 0 <= c < len(vals) else None
+            arrays.append(pa.array(out, type=pa.string()))
+        else:
+            data = np.asarray(col.data)[mask]
+            if col.hi is not None:
+                hi = np.asarray(col.hi)[mask]
+                v64 = _limbs_to_int64(hi, data)
+                if col.kind == "t":
+                    arrays.append(pa.array(v64).cast(pa.timestamp(col.unit or "us")))
+                else:
+                    arrays.append(pa.array(v64, type=pa.int64()))
+            elif col.kind == "d":
+                arrays.append(pa.array(data.astype(np.int32)).cast(pa.date32()))
+            elif col.kind == "t":
+                arrays.append(pa.array(data.astype(np.int64)).cast(pa.timestamp(col.unit or "us")))
+            elif col.kind == "b":
+                arrays.append(pa.array(data.astype(np.bool_)))
+            else:
+                arrays.append(pa.array(data))
+    return pa.table(arrays, names=names)
+
+
+def merge_dicts(dicts: Sequence[StringDict]):
+    """Merge string dictionaries; returns (merged StringDict, [remap arrays])."""
+    if len(dicts) == 1:
+        return dicts[0], [None]
+    all_vals = np.concatenate([d.values for d in dicts])
+    # np.unique on object arrays with None fails; substitute sentinel
+    sent = "\x00__null__"
+    flat = np.array([sent if v is None else v for v in all_vals], dtype=object)
+    uniq, inverse = np.unique(flat.astype(str), return_inverse=True)
+    merged_vals = np.array([None if v == sent else v for v in uniq], dtype=object)
+    merged = StringDict(merged_vals)
+    remaps = []
+    off = 0
+    for d in dicts:
+        remaps.append(inverse[off : off + len(d)].astype(np.int32))
+        off += len(d)
+    return merged, remaps
+
+
+def concat_batches(batches: Sequence[DeviceBatch]) -> DeviceBatch:
+    """Concatenate same-schema batches into one padded batch (host-coordinated:
+    dictionaries merge on host, data stays on device)."""
+    if len(batches) == 1:
+        return batches[0]
+    names = batches[0].names
+    total = sum(b.count_valid() for b in batches)
+    padded = config.bucket_size(total)
+    # compact each batch first (gather valid rows), then concat + pad
+    from quokka_tpu.ops import kernels
+
+    compacted = [kernels.compact(b) for b in batches]
+    counts = [b.count_valid() for b in compacted]
+    out_cols = {}
+    for name in names:
+        cols = [b.columns[name] for b in compacted]
+        if isinstance(cols[0], StrCol):
+            merged, remaps = merge_dicts([c.dictionary for c in cols])
+            code_parts = []
+            for c, remap, cnt in zip(cols, remaps, counts):
+                codes = c.codes[:cnt]
+                if remap is not None:
+                    codes = jnp.asarray(remap)[codes]
+                code_parts.append(codes)
+            codes = _pad_device(jnp.concatenate(code_parts), padded)
+            out_cols[name] = StrCol(codes, merged)
+        else:
+            data = jnp.concatenate([c.data[:cnt] for c, cnt in zip(cols, counts)])
+            data = _pad_device(data, padded)
+            hi = None
+            if cols[0].hi is not None:
+                hi = _pad_device(
+                    jnp.concatenate([c.hi[:cnt] for c, cnt in zip(cols, counts)]), padded
+                )
+            out_cols[name] = NumCol(data, cols[0].kind, hi=hi, unit=cols[0].unit)
+    valid = jnp.arange(padded) < total
+    sorted_by = batches[0].sorted_by
+    return DeviceBatch(out_cols, valid, nrows=total, sorted_by=sorted_by)
+
+
+def _pad_device(arr, padded):
+    n = arr.shape[0]
+    if n == padded:
+        return arr
+    if n > padded:
+        return arr[:padded]
+    return jnp.pad(arr, (0, padded - n))
+
+
+def to_pandas(batch_or_table):
+    t = batch_or_table
+    if isinstance(t, DeviceBatch):
+        t = device_to_arrow(t)
+    return t.to_pandas()

@@ -1,0 +1,689 @@
+"""Lower expression ASTs onto DeviceBatch columns as jnp computations.
+
+Replaces the reference's dual path of sqlglot->polars `evaluate`
+(pyquokka/sql_utils.py:86) and "give up and run DuckDB SQL" (pyquokka/
+core.py:156-163): here there is exactly one compile path and it emits JAX ops,
+so filters/projections fuse into the surrounding jitted kernel.
+
+String rules (TPU-first): predicates and transforms evaluate on the host over
+the (small) dictionary once, then a device gather by code applies them to all
+rows.  Date math runs on int32 days with the civil-calendar algorithm
+vectorized in jnp.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from quokka_tpu import config
+from quokka_tpu.expression import (
+    Agg,
+    Alias,
+    BinOp,
+    Case,
+    Cast,
+    ColRef,
+    DateLit,
+    DtField,
+    Expr,
+    Func,
+    InList,
+    IntervalLit,
+    IsNull,
+    Literal,
+    StrOp,
+    UnaryOp,
+)
+from quokka_tpu.ops.batch import DeviceBatch, NumCol, StrCol, StringDict
+
+
+class CompileError(Exception):
+    pass
+
+
+Value = object  # NumCol | StrCol | python scalar | IntervalLit
+
+
+def evaluate(e: Expr, batch: DeviceBatch):
+    """Evaluate an expression against a batch -> NumCol / StrCol / scalar."""
+    if isinstance(e, Alias):
+        return evaluate(e.expr, batch)
+    if isinstance(e, ColRef):
+        if e.name not in batch.columns:
+            raise CompileError(f"unknown column {e.name}; have {batch.names}")
+        return batch.columns[e.name]
+    if isinstance(e, Literal):
+        return e.value
+    if isinstance(e, DateLit):
+        return _DateScalar(e.days)
+    if isinstance(e, IntervalLit):
+        return e
+    if isinstance(e, BinOp):
+        return _binop(e.op, evaluate(e.left, batch), evaluate(e.right, batch))
+    if isinstance(e, UnaryOp):
+        v = evaluate(e.operand, batch)
+        if e.op == "not":
+            return NumCol(~_as_bool(v), "b")
+        if e.op == "-":
+            if isinstance(v, NumCol):
+                return NumCol(-v.data, v.kind)
+            return -v
+        raise CompileError(e.op)
+    if isinstance(e, Case):
+        return _case(e, batch)
+    if isinstance(e, InList):
+        return _in_list(e, batch)
+    if isinstance(e, IsNull):
+        return _is_null(e, batch)
+    if isinstance(e, StrOp):
+        return _str_op(e, batch)
+    if isinstance(e, DtField):
+        return _dt_field(e, batch)
+    if isinstance(e, Cast):
+        return _cast(e, batch)
+    if isinstance(e, Func):
+        return _func(e, batch)
+    if isinstance(e, Agg):
+        raise CompileError("aggregate expression used in a scalar context")
+    raise CompileError(f"cannot compile {type(e).__name__}")
+
+
+def evaluate_predicate(e: Expr, batch: DeviceBatch) -> jnp.ndarray:
+    return _as_bool(evaluate(e, batch))
+
+
+def evaluate_to_column(e: Expr, batch: DeviceBatch):
+    v = evaluate(e, batch)
+    if isinstance(v, (NumCol, StrCol)):
+        return v
+    if isinstance(v, _DateScalar):
+        return NumCol(jnp.full(batch.padded_len, v.days, dtype=jnp.int32), "d")
+    if isinstance(v, str):
+        return StrCol(
+            jnp.zeros(batch.padded_len, dtype=jnp.int32),
+            StringDict(np.array([v], dtype=object)),
+        )
+    if isinstance(v, bool):
+        return NumCol(jnp.full(batch.padded_len, v, dtype=jnp.bool_), "b")
+    if isinstance(v, int):
+        return NumCol(jnp.full(batch.padded_len, v, dtype=config.int_dtype()), "i")
+    if isinstance(v, float):
+        return NumCol(jnp.full(batch.padded_len, v, dtype=config.float_dtype()), "f")
+    raise CompileError(f"cannot materialize {type(v)} as a column")
+
+
+class _DateScalar:
+    __slots__ = ("days",)
+
+    def __init__(self, days: int):
+        self.days = days
+
+
+# ---------------------------------------------------------------------------
+# coercion helpers
+# ---------------------------------------------------------------------------
+
+
+def _as_bool(v) -> jnp.ndarray:
+    if isinstance(v, NumCol):
+        return v.data.astype(jnp.bool_) if v.data.dtype != jnp.bool_ else v.data
+    if isinstance(v, bool):
+        return jnp.asarray(v)
+    raise CompileError(f"expected boolean, got {type(v)}")
+
+
+def _numeric_data(v):
+    if isinstance(v, NumCol):
+        if v.hi is not None:
+            raise CompileError("arithmetic on wide ints requires x64 (CPU) mode")
+        return v.data
+    if isinstance(v, _DateScalar):
+        return v.days
+    if isinstance(v, (int, float, bool)):
+        return v
+    raise CompileError(f"expected numeric, got {type(v)}")
+
+
+def _result_kind(a, b, op):
+    ka = a.kind if isinstance(a, NumCol) else _scalar_kind(a)
+    kb = b.kind if isinstance(b, NumCol) else _scalar_kind(b)
+    if op == "/":
+        return "f"
+    if "d" in (ka, kb) and op in ("+", "-"):
+        # date - date -> int days; date +/- interval -> date
+        if ka == "d" and kb == "d":
+            return "i"
+        return "d"
+    if "f" in (ka, kb):
+        return "f"
+    return "i"
+
+
+def _scalar_kind(v):
+    if isinstance(v, _DateScalar):
+        return "d"
+    if isinstance(v, bool):
+        return "b"
+    if isinstance(v, int):
+        return "i"
+    if isinstance(v, float):
+        return "f"
+    return "?"
+
+
+_CMP = {"=", "!=", "<", "<=", ">", ">="}
+
+
+def _binop(op, a, b):
+    if op in ("and", "or"):
+        xa, xb = _as_bool(a), _as_bool(b)
+        return NumCol(xa & xb if op == "and" else xa | xb, "b")
+
+    # string comparisons -> dictionary trick / hash equality
+    if isinstance(a, StrCol) or isinstance(b, StrCol):
+        return _string_compare(op, a, b)
+
+    # interval arithmetic on dates
+    if isinstance(b, IntervalLit):
+        return _date_interval(op, a, b)
+    if isinstance(a, IntervalLit):
+        raise CompileError("interval must be on the right-hand side")
+
+    # wide-int comparisons (two-limb)
+    wa = isinstance(a, NumCol) and a.hi is not None
+    wb = isinstance(b, NumCol) and b.hi is not None
+    if (wa or wb) and op in _CMP:
+        return _wide_compare(op, a, b)
+
+    da, db = _numeric_data(a), _numeric_data(b)
+    if op in _CMP:
+        fn = {
+            "=": lambda x, y: x == y,
+            "!=": lambda x, y: x != y,
+            "<": lambda x, y: x < y,
+            "<=": lambda x, y: x <= y,
+            ">": lambda x, y: x > y,
+            ">=": lambda x, y: x >= y,
+        }[op]
+        return NumCol(fn(da, db), "b")
+
+    kind = _result_kind(a, b, op)
+    if op == "+":
+        out = da + db
+    elif op == "-":
+        out = da - db
+    elif op == "*":
+        out = da * db
+    elif op == "/":
+        fa = jnp.asarray(da, dtype=config.float_dtype()) if not isinstance(da, (int, float)) else da
+        fb = jnp.asarray(db, dtype=config.float_dtype()) if not isinstance(db, (int, float)) else db
+        out = fa / fb
+    elif op == "//":
+        out = da // db
+    elif op == "%":
+        out = da % db
+    else:
+        raise CompileError(f"binop {op}")
+    out = jnp.asarray(out)
+    return NumCol(out, kind)
+
+
+def _date_interval(op, a, iv: IntervalLit):
+    if iv.months:
+        raise CompileError("month/year intervals need calendar arithmetic (todo)")
+    if not isinstance(a, NumCol):
+        if isinstance(a, _DateScalar):
+            d = a.days + (iv.days if op == "+" else -iv.days)
+            return _DateScalar(d)
+        raise CompileError("interval arithmetic on non-date")
+    if a.kind == "d":
+        delta = iv.days
+    elif a.kind == "t":
+        delta = _micros_to_unit(iv.micros, a.unit or "us")
+    else:
+        raise CompileError(f"interval arithmetic on kind {a.kind}")
+    if op == "-":
+        delta = -delta
+    if a.hi is not None:
+        raise CompileError("interval arithmetic on wide timestamps requires x64")
+    return NumCol(a.data + delta, a.kind, unit=a.unit)
+
+
+def _micros_to_unit(micros: int, unit: str) -> int:
+    scale = {"s": 1_000_000, "ms": 1_000, "us": 1, "ns": 1 / 1000}[unit]
+    return int(micros / scale)
+
+
+def _wide_compare(op, a, b):
+    def limbs(v):
+        if isinstance(v, NumCol):
+            if v.hi is not None:
+                return v.hi, v.data
+            # narrow col vs wide: widen
+            hi = jnp.where(v.data < 0, -1, 0).astype(v.data.dtype)
+            lo = _lo_sortable_from_narrow(v.data)
+            return hi, lo
+        val = int(v.days if isinstance(v, _DateScalar) else v)
+        hi = np.int32(val >> 32)
+        lo_u = np.uint32(val & 0xFFFFFFFF)
+        lo = np.int32(np.int64(int(lo_u) ^ 0x80000000) - 2**31)
+        return hi, lo
+
+    ahi, alo = limbs(a)
+    bhi, blo = limbs(b)
+    eq = (ahi == bhi) & (alo == blo)
+    lt = (ahi < bhi) | ((ahi == bhi) & (alo < blo))
+    table = {
+        "=": eq,
+        "!=": ~eq,
+        "<": lt,
+        "<=": lt | eq,
+        ">": ~(lt | eq),
+        ">=": ~lt,
+    }
+    return NumCol(table[op], "b")
+
+
+def _lo_sortable_from_narrow(x):
+    u = x.astype(jnp.uint32)
+    return (u ^ jnp.uint32(0x80000000)).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# strings
+# ---------------------------------------------------------------------------
+
+
+def _dict_gather(col: StrCol, host_values: np.ndarray, kind: str) -> NumCol:
+    """Evaluate something per-dictionary-entry on host, gather by code."""
+    return NumCol(jnp.asarray(host_values)[col.codes], kind)
+
+
+def _string_compare(op, a, b):
+    if isinstance(a, str) and isinstance(b, StrCol):
+        a, b, op = b, a, _flip(op)
+    if isinstance(a, StrCol) and isinstance(b, str):
+        vals = a.dictionary.values.astype(str)
+        if op == "=":
+            return _dict_gather(a, vals == b, "b")
+        if op == "!=":
+            return _dict_gather(a, vals != b, "b")
+        cmp = {"<": vals < b, "<=": vals <= b, ">": vals > b, ">=": vals >= b}[op]
+        return _dict_gather(a, cmp, "b")
+    if isinstance(a, StrCol) and isinstance(b, StrCol):
+        if op not in ("=", "!="):
+            raise CompileError("ordering comparison between two string columns (todo)")
+        ahi, alo = a.hash_limbs()
+        bhi, blo = b.hash_limbs()
+        eq = (ahi == bhi) & (alo == blo)
+        return NumCol(eq if op == "=" else ~eq, "b")
+    raise CompileError(f"string comparison {type(a)} {op} {type(b)}")
+
+
+def _flip(op):
+    return {"<": ">", "<=": ">=", ">": "<", ">=": "<=", "=": "=", "!=": "!="}[op]
+
+
+def _like_to_regex(pat: str) -> str:
+    out = []
+    for ch in pat:
+        if ch == "%":
+            out.append(".*")
+        elif ch == "_":
+            out.append(".")
+        else:
+            out.append(re.escape(ch))
+    return "^" + "".join(out) + "$"
+
+
+def _str_op(e: StrOp, batch: DeviceBatch):
+    v = evaluate(e.expr, batch)
+    if not isinstance(v, StrCol):
+        raise CompileError(f"str op {e.op} on non-string")
+    vals = v.dictionary.values
+    svals = vals.astype(str)
+    if e.op == "like":
+        rx = re.compile(_like_to_regex(e.args[0]))
+        mask = np.array([bool(rx.match(s)) for s in svals])
+        return _dict_gather(v, mask, "b")
+    if e.op == "contains":
+        return _dict_gather(v, np.char.find(svals, e.args[0]) >= 0, "b")
+    if e.op == "starts_with":
+        return _dict_gather(v, np.char.startswith(svals, e.args[0]), "b")
+    if e.op == "ends_with":
+        return _dict_gather(v, np.char.endswith(svals, e.args[0]), "b")
+    if e.op == "length":
+        return _dict_gather(v, np.char.str_len(svals).astype(np.int32), "i")
+    if e.op == "hash":
+        hi = jnp.asarray(v.dictionary.hash_hi)[v.codes]
+        return NumCol(hi, "i")
+    # string -> string transforms: rewrite the dictionary, keep codes
+    if e.op == "lower":
+        return StrCol(v.codes, StringDict(np.char.lower(svals).astype(object)))
+    if e.op == "upper":
+        return StrCol(v.codes, StringDict(np.char.upper(svals).astype(object)))
+    if e.op == "strip":
+        return StrCol(v.codes, StringDict(np.char.strip(svals).astype(object)))
+    if e.op == "slice":
+        off, length = e.args[0], e.args[1]
+        if length is None:
+            new = np.array([s[off:] for s in svals], dtype=object)
+        else:
+            new = np.array([s[off : off + int(length)] for s in svals], dtype=object)
+        return StrCol(v.codes, StringDict(new))
+    if e.op == "json_extract":
+        import json
+
+        path = e.args[0].lstrip("$.")
+
+        def get(s):
+            try:
+                return str(json.loads(s).get(path))
+            except Exception:
+                return None
+
+        new = np.array([get(s) for s in svals], dtype=object)
+        return StrCol(v.codes, StringDict(new))
+    raise CompileError(f"str op {e.op}")
+
+
+def _in_list(e: InList, batch: DeviceBatch):
+    v = evaluate(e.expr, batch)
+    if isinstance(v, StrCol):
+        mask = np.isin(v.dictionary.values.astype(str), [str(x) for x in e.values])
+        out = _dict_gather(v, mask, "b")
+    else:
+        data = _numeric_data(v)
+        acc = jnp.zeros_like(data, dtype=jnp.bool_)
+        for val in e.values:
+            acc = acc | (data == val)
+        out = NumCol(acc, "b")
+    if e.negated:
+        out = NumCol(~out.data, "b")
+    return out
+
+
+def _is_null(e: IsNull, batch: DeviceBatch):
+    v = evaluate(e.expr, batch)
+    if isinstance(v, StrCol):
+        mask = np.array([x is None for x in v.dictionary.values])
+        out = _dict_gather(v, mask, "b")
+    elif isinstance(v, NumCol) and v.kind == "f":
+        out = NumCol(jnp.isnan(v.data), "b")
+    else:
+        out = NumCol(jnp.zeros(batch.padded_len, dtype=jnp.bool_), "b")
+    if e.negated:
+        out = NumCol(~out.data, "b")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# dates
+# ---------------------------------------------------------------------------
+
+
+def _civil_from_days(days):
+    """days since 1970-01-01 -> (year, month, day); Hinnant's algorithm in
+    pure int32 jnp ops."""
+    z = days + 719468
+    era = jnp.floor_divide(z, 146097)
+    doe = z - era * 146097
+    yoe = jnp.floor_divide(doe - doe // 1460 + doe // 36524 - doe // 146096, 365)
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = jnp.floor_divide(5 * doy + 2, 153)
+    d = doy - jnp.floor_divide(153 * mp + 2, 5) + 1
+    m = mp + jnp.where(mp < 10, 3, -9)
+    y = y + (m <= 2)
+    return y, m, d
+
+
+def _ts_to_seconds(col: NumCol):
+    scale = {"s": 1, "ms": 1_000, "us": 1_000_000, "ns": 1_000_000_000}[col.unit or "us"]
+    if col.hi is not None:
+        raise CompileError("timestamp field extraction on wide ints requires x64")
+    return col.data // scale
+
+
+def _dt_field(e: DtField, batch: DeviceBatch):
+    v = evaluate(e.expr, batch)
+    if not isinstance(v, NumCol) or v.kind not in ("d", "t"):
+        raise CompileError(f"extract({e.field}) on non-temporal column")
+    if v.kind == "d":
+        days = v.data
+        secs_in_day = None
+    else:
+        secs = _ts_to_seconds(v)
+        days = jnp.floor_divide(secs, 86400)
+        secs_in_day = secs - days * 86400
+    f = e.field
+    if f in ("year", "month", "day"):
+        y, m, d = _civil_from_days(days)
+        out = {"year": y, "month": m, "day": d}[f]
+        return NumCol(out.astype(jnp.int32), "i")
+    if f == "weekday":
+        return NumCol(((days + 4) % 7).astype(jnp.int32), "i")  # 0=Sunday
+    if secs_in_day is None:
+        raise CompileError(f"extract({f}) from a date")
+    if f == "hour":
+        return NumCol((secs_in_day // 3600).astype(jnp.int32), "i")
+    if f == "minute":
+        return NumCol(((secs_in_day // 60) % 60).astype(jnp.int32), "i")
+    if f == "second":
+        return NumCol((secs_in_day % 60).astype(jnp.int32), "i")
+    raise CompileError(f"extract field {f}")
+
+
+# ---------------------------------------------------------------------------
+# misc scalar funcs
+# ---------------------------------------------------------------------------
+
+
+def _case(e: Case, batch: DeviceBatch):
+    default = (
+        evaluate_to_column(e.default, batch)
+        if e.default is not None
+        else NumCol(jnp.full(batch.padded_len, jnp.nan, dtype=config.float_dtype()), "f")
+    )
+    if isinstance(default, StrCol):
+        raise CompileError("string-valued CASE (todo)")
+    out = default.data
+    kind = default.kind
+    for cond, val in reversed(e.whens):
+        c = evaluate_predicate(cond, batch)
+        vcol = evaluate_to_column(val, batch)
+        if isinstance(vcol, StrCol):
+            raise CompileError("string-valued CASE (todo)")
+        v, out = jnp.broadcast_arrays(vcol.data, out)
+        out = jnp.where(c, v.astype(out.dtype) if v.dtype != out.dtype else v, out)
+        if vcol.kind == "f":
+            kind = "f"
+    return NumCol(out, kind)
+
+
+def _cast(e: Cast, batch: DeviceBatch):
+    v = evaluate(e.expr, batch)
+    to = e.to
+    if to.startswith(("double", "float", "real", "decimal", "numeric")):
+        if isinstance(v, (int, float)):
+            return float(v)
+        if isinstance(v, StrCol):
+            vals = np.array(
+                [float(x) if x not in (None, "") else np.nan for x in v.dictionary.values]
+            )
+            return _dict_gather(v, vals.astype(np.float64 if config.x64_enabled() else np.float32), "f")
+        return NumCol(v.data.astype(config.float_dtype()), "f")
+    if to.startswith(("int", "bigint", "smallint", "tinyint")):
+        if isinstance(v, (int, float)):
+            return int(v)
+        return NumCol(v.data.astype(config.int_dtype()), "i")
+    if to.startswith("bool"):
+        return NumCol(_as_bool(v), "b")
+    if to.startswith("date"):
+        if isinstance(v, str):
+            return _DateScalar(DateLit(v).days)
+        if isinstance(v, NumCol) and v.kind == "t":
+            secs = _ts_to_seconds(v)
+            return NumCol((secs // 86400).astype(jnp.int32), "d")
+        if isinstance(v, NumCol):
+            return NumCol(v.data.astype(jnp.int32), "d")
+    if to.startswith(("varchar", "string", "text")):
+        raise CompileError("cast to string (todo)")
+    raise CompileError(f"cast to {to}")
+
+
+def _func(e: Func, batch: DeviceBatch):
+    name = e.name
+    args = [evaluate(a, batch) for a in e.args]
+
+    def num(i):
+        return _numeric_data(args[i])
+
+    if name == "abs":
+        return NumCol(jnp.abs(num(0)), _kind_of(args[0]))
+    if name == "round":
+        nd = int(args[1]) if len(args) > 1 else 0
+        return NumCol(jnp.round(num(0), nd), "f")
+    if name == "sqrt":
+        return NumCol(jnp.sqrt(jnp.asarray(num(0), config.float_dtype())), "f")
+    if name == "exp":
+        return NumCol(jnp.exp(jnp.asarray(num(0), config.float_dtype())), "f")
+    if name in ("ln", "log"):
+        return NumCol(jnp.log(jnp.asarray(num(0), config.float_dtype())), "f")
+    if name == "floor":
+        return NumCol(jnp.floor(num(0)), "f")
+    if name == "ceil":
+        return NumCol(jnp.ceil(num(0)), "f")
+    if name == "power":
+        return NumCol(jnp.power(jnp.asarray(num(0), config.float_dtype()), num(1)), "f")
+    if name == "sign":
+        return NumCol(jnp.sign(num(0)), _kind_of(args[0]))
+    if name in ("sin", "cos"):
+        f = jnp.sin if name == "sin" else jnp.cos
+        return NumCol(f(jnp.asarray(num(0), config.float_dtype())), "f")
+    if name == "coalesce":
+        out = num(0)
+        for i in range(1, len(args)):
+            out = jnp.where(jnp.isnan(out), num(i), out)
+        return NumCol(out, "f")
+    if name in ("greatest", "least"):
+        f = jnp.maximum if name == "greatest" else jnp.minimum
+        out = num(0)
+        for i in range(1, len(args)):
+            out = f(out, num(i))
+        return NumCol(jnp.asarray(out), _kind_of(args[0]))
+    if name == "date_trunc":
+        every = args[0]
+        v = args[1]
+        if not isinstance(v, NumCol):
+            raise CompileError("date_trunc on scalar")
+        if v.kind == "d" and every in ("month", "year"):
+            y, m, _ = _civil_from_days(v.data)
+            if every == "year":
+                m = jnp.ones_like(m)
+            return NumCol(_days_from_civil(y, m, jnp.ones_like(m)), "d")
+        raise CompileError(f"date_trunc {every} on kind {v.kind}")
+    raise CompileError(f"function {name}")
+
+
+def _days_from_civil(y, m, d):
+    y = y - (m <= 2)
+    era = jnp.floor_divide(y, 400)
+    yoe = y - era * 400
+    mp = m + jnp.where(m > 2, -3, 9)
+    doy = jnp.floor_divide(153 * mp + 2, 5) + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return (era * 146097 + doe - 719468).astype(jnp.int32)
+
+
+def _kind_of(v):
+    if isinstance(v, NumCol):
+        return v.kind
+    return _scalar_kind(v)
+
+
+# ---------------------------------------------------------------------------
+# aggregation decomposition (partial -> final), mirroring the semantics of
+# pyquokka/sql_utils.py:299-412 parse_multiple_aggregations
+# ---------------------------------------------------------------------------
+
+
+class AggPlan:
+    """Decomposed aggregation:
+    - pre: [(tmp_name, Expr)]           per-batch scalar columns to compute
+    - partials: [(pname, op, tmp|None)] kernel aggs over (keys, tmp columns)
+    - recombine: [(pname, op)]          how to merge partial results
+    - finals: [(out_name, Expr over partial names)]
+    """
+
+    def __init__(self):
+        self.pre: List[Tuple[str, Expr]] = []
+        self.partials: List[Tuple[str, str, Optional[str]]] = []
+        self.recombine: List[Tuple[str, str]] = []
+        self.finals: List[Tuple[str, Expr]] = []
+        self._memo: Dict[str, str] = {}
+
+    def _tmp(self, e: Expr) -> str:
+        key = "pre:" + e.sql()
+        if key in self._memo:
+            return self._memo[key]
+        name = f"__pre_{len(self.pre)}"
+        self.pre.append((name, e))
+        self._memo[key] = name
+        return name
+
+    def _partial(self, op: str, arg: Optional[Expr]) -> str:
+        key = f"agg:{op}:{arg.sql() if arg is not None else '*'}"
+        if key in self._memo:
+            return self._memo[key]
+        name = f"__agg_{len(self.partials)}"
+        tmp = self._tmp(arg) if arg is not None else None
+        self.partials.append((name, op, tmp))
+        self.recombine.append((name, {"count": "sum"}.get(op, op)))
+        self._memo[key] = name
+        return name
+
+    def rewrite(self, e: Expr) -> Expr:
+        if isinstance(e, Agg):
+            if e.distinct:
+                raise CompileError("count(distinct) requires the holistic agg path")
+            if e.op in ("sum", "min", "max"):
+                return ColRef(self._partial(e.op, e.arg))
+            if e.op == "count":
+                return ColRef(self._partial("count", e.arg))
+            if e.op == "avg":
+                s = ColRef(self._partial("sum", e.arg))
+                c = ColRef(self._partial("count", e.arg))
+                return BinOp("/", s, c)
+            if e.op in ("stddev", "var"):
+                x = e.arg
+                s1 = ColRef(self._partial("sum", x))
+                s2 = ColRef(self._partial("sum", BinOp("*", x, x)))
+                c = ColRef(self._partial("count", x))
+                mean = BinOp("/", s1, c)
+                var = BinOp("-", BinOp("/", s2, c), BinOp("*", mean, mean))
+                if e.op == "var":
+                    return var
+                return Func("sqrt", [var])
+            raise CompileError(f"aggregate {e.op}")
+        kids = e.children()
+        if not kids:
+            return e
+        from quokka_tpu.expression import _rebuild
+
+        return _rebuild(e, [self.rewrite(k) for k in kids])
+
+
+def plan_aggregation(outputs: Sequence[Expr]) -> AggPlan:
+    """outputs: Alias-wrapped expressions containing Agg nodes."""
+    plan = AggPlan()
+    for i, e in enumerate(outputs):
+        name = e.name if isinstance(e, Alias) else f"col{i}"
+        inner = e.expr if isinstance(e, Alias) else e
+        plan.finals.append((name, plan.rewrite(inner)))
+    return plan

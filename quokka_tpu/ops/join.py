@@ -1,0 +1,183 @@
+"""Device join kernels.
+
+TPU has no pointer-chasing hash tables, so joins are rank-based (SURVEY.md
+"Hard parts" #3): concatenate probe+build key limbs, compute dense ranks via a
+multi-operand sort (one XLA sort), then match rows that share a rank.  Two
+paths:
+
+- ``hash_join_pk``: build side has unique keys (the common TPC-H case —
+  dimension/PK build sides).  Output is probe-aligned and mask-based: no host
+  sync, stays fully on device.
+- ``hash_join_general``: many-to-many.  Output size is computed on device and
+  synced to the host once per batch to pick the output bucket, then a jitted
+  expansion kernel gathers (probe_idx, build_idx) pairs.
+
+Reference behavior being matched: BuildProbeJoinExecutor semantics
+(pyquokka/executors/sql_executors.py:325-378) — inner/left/semi/anti.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from quokka_tpu import config
+from quokka_tpu.ops import kernels
+from quokka_tpu.ops.batch import DeviceBatch, NumCol, StrCol, key_limbs
+from quokka_tpu.ops.kernels import dense_rank
+
+
+def _concat_limbs(probe: DeviceBatch, build: DeviceBatch, probe_keys, build_keys):
+    lp = key_limbs(probe, probe_keys)
+    lb = key_limbs(build, build_keys)
+    assert len(lp) == len(lb), "join key column types must match"
+    limbs = [jnp.concatenate([a, b.astype(a.dtype)]) for a, b in zip(lp, lb)]
+    valid = jnp.concatenate([probe.valid, build.valid])
+    return limbs, valid
+
+
+@functools.partial(jax.jit, static_argnames=("p",))
+def _pk_match(limbs: Tuple[jax.Array, ...], valid: jax.Array, p: int):
+    n = valid.shape[0]
+    ranks, _ = dense_rank(limbs, valid)
+    rp, rb = ranks[:p], ranks[p:]
+    vp, vb = valid[:p], valid[p:]
+    b = n - p
+    iota_b = jnp.arange(b, dtype=jnp.int32)
+    first = jnp.full(n, b, dtype=jnp.int32).at[rb].min(jnp.where(vb, iota_b, b))
+    cnt = jax.ops.segment_sum(vb.astype(jnp.int32), rb, num_segments=n)
+    build_idx = jnp.clip(first[rp], 0, b - 1)
+    matched = vp & (cnt[rp] > 0)
+    return build_idx, matched
+
+
+def hash_join_pk(
+    probe: DeviceBatch,
+    build: DeviceBatch,
+    probe_keys: Sequence[str],
+    build_keys: Sequence[str],
+    how: str = "inner",
+    build_payload: Sequence[str] = (),
+) -> DeviceBatch:
+    """Join where build keys are unique.  Probe-aligned, no host sync."""
+    p = probe.padded_len
+    limbs, valid = _concat_limbs(probe, build, probe_keys, build_keys)
+    build_idx, matched = _pk_match(tuple(limbs), valid, p)
+    if how == "semi":
+        return kernels.apply_mask(probe, matched)
+    if how == "anti":
+        return kernels.apply_mask(probe, probe.valid & ~matched)
+    cols = dict(probe.columns)
+    for name in build_payload:
+        c = build.columns[name]
+        taken = c.take(build_idx)
+        if how == "left" and isinstance(taken, NumCol) and taken.kind == "f":
+            taken = NumCol(jnp.where(matched, taken.data, jnp.nan), "f")
+        cols[name] = taken
+    if how == "inner":
+        out_valid = matched
+    elif how == "left":
+        out_valid = probe.valid
+    else:
+        raise ValueError(f"how={how}")
+    return DeviceBatch(cols, out_valid, None, probe.sorted_by)
+
+
+@functools.partial(jax.jit, static_argnames=("p",))
+def _mm_plan(limbs: Tuple[jax.Array, ...], valid: jax.Array, p: int):
+    n = valid.shape[0]
+    ranks, _ = dense_rank(limbs, valid)
+    rp, rb = ranks[:p], ranks[p:]
+    vp, vb = valid[:p], valid[p:]
+    b = n - p
+    cnt = jax.ops.segment_sum(vb.astype(jnp.int32), rb, num_segments=n)
+    # build rows grouped by rank: sort build positions by rank
+    iota_b = jnp.arange(b, dtype=jnp.int32)
+    inv = (~vb).astype(jnp.int32)
+    _, _, build_pos_sorted = lax.sort([inv, rb, iota_b], num_keys=2)
+    offsets = jnp.cumsum(cnt) - cnt  # start of each rank's run in the sorted build
+    match_count = jnp.where(vp, cnt[rp], 0)
+    total = jnp.sum(match_count)
+    return match_count, total, offsets, build_pos_sorted, rp
+
+
+@functools.partial(jax.jit, static_argnames=("out_padded",))
+def _mm_expand(match_count, offsets, build_pos_sorted, rp, total, out_padded: int):
+    p = match_count.shape[0]
+    cum = jnp.cumsum(match_count)
+    j = jnp.arange(out_padded, dtype=jnp.int32)
+    probe_idx = jnp.searchsorted(cum, j, side="right").astype(jnp.int32)
+    probe_idx = jnp.clip(probe_idx, 0, p - 1)
+    start = cum[probe_idx] - match_count[probe_idx]
+    k = j - start
+    bpos = offsets[rp[probe_idx]] + k
+    bpos = jnp.clip(bpos, 0, build_pos_sorted.shape[0] - 1)
+    build_idx = build_pos_sorted[bpos]
+    out_valid = j < total
+    return probe_idx, build_idx, out_valid
+
+
+def hash_join_general(
+    probe: DeviceBatch,
+    build: DeviceBatch,
+    probe_keys: Sequence[str],
+    build_keys: Sequence[str],
+    how: str = "inner",
+    build_payload: Sequence[str] = (),
+) -> DeviceBatch:
+    """Many-to-many join.  One host sync per batch for the output bucket."""
+    p = probe.padded_len
+    limbs, valid = _concat_limbs(probe, build, probe_keys, build_keys)
+    match_count, total, offsets, build_pos_sorted, rp = _mm_plan(tuple(limbs), valid, p)
+    if how in ("semi", "anti"):
+        matched = match_count > 0
+        mask = matched if how == "semi" else (probe.valid & ~matched)
+        return kernels.apply_mask(probe, mask)
+    if how == "left":
+        # unmatched probe rows still emit one row
+        match_count = jnp.where(probe.valid & (match_count == 0), 1, match_count)
+        total = jnp.sum(match_count)
+    ntotal = int(total)  # host sync: pick output bucket
+    out_padded = config.bucket_size(ntotal)
+    probe_idx, build_idx, out_valid = _mm_expand(
+        match_count, offsets, build_pos_sorted, rp, total, out_padded
+    )
+    cols = {}
+    for name, c in probe.columns.items():
+        cols[name] = c.take(probe_idx)
+    unmatched = None
+    if how == "left":
+        unmatched = (match_count[probe_idx] == 1) & _is_unmatched_gather(
+            limbs, valid, p, probe_idx
+        )
+    for name in build_payload:
+        c = build.columns[name]
+        taken = c.take(build_idx)
+        if how == "left" and isinstance(taken, NumCol) and taken.kind == "f":
+            taken = NumCol(jnp.where(unmatched, jnp.nan, taken.data), "f")
+        cols[name] = taken
+    return DeviceBatch(cols, out_valid, ntotal if how == "inner" else None, None)
+
+
+@functools.partial(jax.jit, static_argnames=("p",))
+def _is_unmatched_gather(limbs, valid, p, probe_idx):
+    ranks, _ = dense_rank(tuple(limbs), valid)
+    rp, rb = ranks[:p], ranks[p:]
+    vb = valid[p:]
+    n = valid.shape[0]
+    cnt = jax.ops.segment_sum(vb.astype(jnp.int32), rb, num_segments=n)
+    return cnt[rp][probe_idx] == 0
+
+
+def build_keys_unique(build: DeviceBatch, build_keys: Sequence[str]) -> bool:
+    """Host-synced check whether the build side is PK-unique (decides fast path).
+    Called once per finalized build table, not per probe batch."""
+    limbs = key_limbs(build, build_keys)
+    ranks, num = dense_rank(limbs, build.valid)
+    nvalid = build.count_valid()
+    return int(num) == nvalid

@@ -1,0 +1,305 @@
+"""Jitted relational kernels over DeviceBatch.
+
+Design notes (TPU-first):
+- Every kernel is static-shape: batches are padded to buckets (config.bucket_size)
+  and carry a validity mask.  Filtering flips mask bits; compaction (which needs
+  a host sync for the live count) happens only at batch boundaries (shuffle,
+  output), mirroring where the reference engine synchronizes anyway.
+- Group-by uses a sort + segment-reduce plan ("dense rank"): sort rows by key
+  limbs, mark group starts, prefix-sum to get dense segment ids, then
+  jax.ops.segment_* with num_segments = padded length.  This replaces the
+  hash-table group-bys Polars does on CPU (SURVEY.md section 2.2) with a plan that
+  maps onto XLA's sort and scatter-add, which tile well on TPU.
+- Multi-column / string / wide-int keys are lists of 32-bit "limbs"
+  (ops/batch.key_limbs); lexicographic multi-operand lax.sort handles them
+  without 64-bit device ints.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from quokka_tpu import config
+from quokka_tpu.ops.batch import DeviceBatch, NumCol, StrCol, key_limbs
+
+# ---------------------------------------------------------------------------
+# masking / compaction
+# ---------------------------------------------------------------------------
+
+
+def apply_mask(batch: DeviceBatch, mask: jax.Array) -> DeviceBatch:
+    return DeviceBatch(batch.columns, batch.valid & mask, None, batch.sorted_by)
+
+
+@functools.partial(jax.jit, static_argnames=("out_size",))
+def _compact_idx(valid, out_size):
+    idx = jnp.nonzero(valid, size=out_size, fill_value=0)[0]
+    return idx
+
+
+def compact(batch: DeviceBatch) -> DeviceBatch:
+    """Gather valid rows to the front and shrink to the smallest bucket.
+    Costs one host sync for the live count."""
+    n = batch.count_valid()
+    padded = config.bucket_size(n)
+    if n == batch.padded_len and padded == batch.padded_len:
+        return batch
+    idx = _compact_idx(batch.valid, padded)
+    valid = jnp.arange(padded) < n
+    return batch.take(idx, valid, n)
+
+
+def head(batch: DeviceBatch, k: int) -> DeviceBatch:
+    b = compact(batch)
+    n = min(b.count_valid(), k)
+    padded = config.bucket_size(n)
+    idx = jnp.arange(padded)
+    return b.take(idx, idx < n, n)
+
+
+# ---------------------------------------------------------------------------
+# sort-key limbs (order-preserving, unlike hash limbs)
+# ---------------------------------------------------------------------------
+
+
+def sort_limbs(batch: DeviceBatch, cols: Sequence[str], descending=None) -> List[jax.Array]:
+    """Limbs whose ascending lexicographic order == the requested column order.
+    Strings map codes -> dictionary-rank (host argsort of the dict), so string
+    sorts are true lexicographic sorts, not hash-order."""
+    if descending is None:
+        descending = [False] * len(cols)
+    limbs: List[jax.Array] = []
+    for name, desc in zip(cols, descending):
+        c = batch.columns[name]
+        if isinstance(c, StrCol):
+            order = np.argsort(c.dictionary.values.astype(str), kind="stable")
+            rank = np.empty(len(order), dtype=np.int32)
+            rank[order] = np.arange(len(order), dtype=np.int32)
+            limb = jnp.asarray(rank)[c.codes]
+            limbs.append(~limb if desc else limb)
+        else:
+            parts = []
+            if c.hi is not None:
+                parts.append(c.hi)
+            parts.append(c.data)
+            for p in parts:
+                if desc:
+                    if jnp.issubdtype(p.dtype, jnp.floating):
+                        p = -p
+                    elif p.dtype == jnp.bool_:
+                        p = ~p
+                    else:
+                        p = ~p  # bitwise-not reverses signed-int order, no overflow
+                limbs.append(p)
+    return limbs
+
+
+# ---------------------------------------------------------------------------
+# dense rank (the group-by / join workhorse)
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def _dense_rank_impl(limbs: Tuple[jax.Array, ...], valid: jax.Array):
+    n = valid.shape[0]
+    iota = jnp.arange(n, dtype=jnp.int32)
+    inv = (~valid).astype(jnp.int32)
+    sorted_ops = lax.sort([inv, *limbs, iota], num_keys=1 + len(limbs))
+    perm = sorted_ops[-1]
+    valid_sorted = sorted_ops[0] == 0
+    changed = jnp.zeros(n, dtype=bool)
+    for limb_sorted in sorted_ops[1:-1]:
+        changed = changed | (limb_sorted != jnp.roll(limb_sorted, 1))
+    starts = valid_sorted & (changed | (iota == 0))
+    ranks_sorted = jnp.cumsum(starts.astype(jnp.int32)) - 1
+    ranks_sorted = jnp.maximum(ranks_sorted, 0)
+    num = jnp.max(jnp.where(valid_sorted, ranks_sorted, -1)) + 1
+    ranks = jnp.zeros(n, dtype=jnp.int32).at[perm].set(ranks_sorted)
+    return ranks, num
+
+
+def dense_rank(limbs: Sequence[jax.Array], valid: jax.Array):
+    """Dense 0..k-1 ids such that two valid rows share an id iff their key limbs
+    are equal.  Invalid rows get an arbitrary id; callers must mask."""
+    return _dense_rank_impl(tuple(limbs), valid)
+
+
+# ---------------------------------------------------------------------------
+# group-by aggregate
+# ---------------------------------------------------------------------------
+
+AGG_OPS = ("sum", "count", "min", "max", "mean", "first")
+
+
+@functools.partial(jax.jit, static_argnames=("ops",))
+def _segment_aggs(ranks, valid, arrays: Tuple[jax.Array, ...], ops: Tuple[str, ...]):
+    n = ranks.shape[0]
+    outs = []
+    counts = jax.ops.segment_sum(valid.astype(jnp.int32), ranks, num_segments=n)
+    iota = jnp.arange(n, dtype=jnp.int32)
+    rep = jnp.full(n, n - 1, dtype=jnp.int32).at[ranks].min(jnp.where(valid, iota, n - 1))
+    for arr, op in zip(arrays, ops):
+        if op == "count":
+            if arr is not None and jnp.issubdtype(arr.dtype, jnp.floating):
+                c = jax.ops.segment_sum(
+                    (valid & ~jnp.isnan(arr)).astype(jnp.int32), ranks, num_segments=n
+                )
+            else:
+                c = counts
+            outs.append(c)
+        elif op == "sum":
+            x = jnp.where(valid, arr, jnp.zeros((), arr.dtype))
+            outs.append(jax.ops.segment_sum(x, ranks, num_segments=n))
+        elif op == "mean":
+            x = jnp.where(valid, arr, jnp.zeros((), arr.dtype))
+            s = jax.ops.segment_sum(x, ranks, num_segments=n)
+            outs.append(s / jnp.maximum(counts, 1).astype(s.dtype))
+        elif op == "min":
+            big = _max_sentinel(arr.dtype)
+            x = jnp.where(valid, arr, big)
+            outs.append(jax.ops.segment_min(x, ranks, num_segments=n))
+        elif op == "max":
+            small = _min_sentinel(arr.dtype)
+            x = jnp.where(valid, arr, small)
+            outs.append(jax.ops.segment_max(x, ranks, num_segments=n))
+        elif op == "first":
+            outs.append(arr[rep])
+        else:
+            raise ValueError(f"unknown agg {op}")
+    return outs, counts, rep
+
+
+def _max_sentinel(dtype):
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.array(jnp.inf, dtype)
+    return jnp.array(jnp.iinfo(dtype).max, dtype)
+
+
+def _min_sentinel(dtype):
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.array(-jnp.inf, dtype)
+    return jnp.array(jnp.iinfo(dtype).min, dtype)
+
+
+def groupby_aggregate(
+    batch: DeviceBatch,
+    keys: Sequence[str],
+    aggs: Sequence[Tuple[str, str, Optional[jax.Array]]],
+) -> DeviceBatch:
+    """aggs: list of (output_name, op, input_array_or_None_for_count).
+    Returns a grouped batch (padded to input size; compact() to shrink)."""
+    n = batch.padded_len
+    if keys:
+        limbs = key_limbs(batch, keys)
+        ranks, num = dense_rank(limbs, batch.valid)
+    else:
+        ranks = jnp.zeros(n, dtype=jnp.int32)
+        num = jnp.minimum(jnp.sum(batch.valid), 1).astype(jnp.int32)
+    arrays = tuple(
+        a if a is not None else jnp.zeros(n, dtype=jnp.int32) for (_, _, a) in aggs
+    )
+    ops = tuple(op for (_, op, _) in aggs)
+    outs, counts, rep = _segment_aggs(ranks, batch.valid, arrays, ops)
+    cols = {}
+    for k in keys:
+        cols[k] = batch.columns[k].take(rep)
+    for (name, _, _), arr in zip(aggs, outs):
+        cols[name] = NumCol(arr, "f" if jnp.issubdtype(arr.dtype, jnp.floating) else "i")
+    group_valid = jnp.arange(n) < num
+    return DeviceBatch(cols, group_valid, None, None)
+
+
+def distinct(batch: DeviceBatch, keys: Sequence[str]) -> DeviceBatch:
+    g = groupby_aggregate(batch, list(keys), [])
+    return g.select(list(keys))
+
+
+# ---------------------------------------------------------------------------
+# sort / top-k
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def _sort_perm(limbs: Tuple[jax.Array, ...], valid: jax.Array):
+    n = valid.shape[0]
+    iota = jnp.arange(n, dtype=jnp.int32)
+    inv = (~valid).astype(jnp.int32)
+    out = lax.sort([inv, *limbs, iota], num_keys=1 + len(limbs))
+    return out[-1]
+
+
+def sort_batch(batch: DeviceBatch, by: Sequence[str], descending=None) -> DeviceBatch:
+    limbs = sort_limbs(batch, by, descending)
+    perm = _sort_perm(tuple(limbs), batch.valid)
+    out = batch.take(perm, batch.valid, batch.nrows)
+    # valid rows are now contiguous at the front
+    n = batch.count_valid()
+    out.valid = jnp.arange(batch.padded_len) < n
+    out.nrows = n
+    out.sorted_by = list(by)
+    return out
+
+
+def top_k(batch: DeviceBatch, by: Sequence[str], k: int, descending=None) -> DeviceBatch:
+    s = sort_batch(batch, by, descending)
+    return head(s, k)
+
+
+# ---------------------------------------------------------------------------
+# hash partition (shuffle)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("n_parts",))
+def _partition_ids(limbs: Tuple[jax.Array, ...], n_parts: int):
+    h = jnp.zeros(limbs[0].shape[0], dtype=jnp.uint32)
+    for limb in limbs:
+        if jnp.issubdtype(limb.dtype, jnp.floating):
+            limb = limb.astype(jnp.int32)
+        elif limb.dtype == jnp.bool_:
+            limb = limb.astype(jnp.int32)
+        u = limb.astype(jnp.uint32) if limb.dtype != jnp.int64 else limb.astype(jnp.uint32)
+        h = h * jnp.uint32(0x9E3779B1) + u
+        h = h ^ (h >> 16)
+        h = h * jnp.uint32(0x85EBCA6B)
+        h = h ^ (h >> 13)
+    return (h % jnp.uint32(n_parts)).astype(jnp.int32)
+
+
+def partition_ids(batch: DeviceBatch, keys: Sequence[str], n_parts: int) -> jax.Array:
+    limbs = key_limbs(batch, keys)
+    return _partition_ids(tuple(limbs), n_parts)
+
+
+def split_by_partition(batch: DeviceBatch, part_ids: jax.Array, n_parts: int):
+    """Split a batch into n compacted per-partition batches (host-coordinated;
+    this runs at shuffle boundaries where the host must route data anyway)."""
+    out = []
+    for p in range(n_parts):
+        sub = apply_mask(batch, part_ids == p)
+        out.append(compact(sub))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# whole-batch reductions
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("op",))
+def reduce_array(arr: jax.Array, valid: jax.Array, op: str):
+    if op == "sum":
+        return jnp.sum(jnp.where(valid, arr, jnp.zeros((), arr.dtype)))
+    if op == "count":
+        return jnp.sum(valid.astype(jnp.int64 if config.x64_enabled() else jnp.int32))
+    if op == "min":
+        return jnp.min(jnp.where(valid, arr, _max_sentinel(arr.dtype)))
+    if op == "max":
+        return jnp.max(jnp.where(valid, arr, _min_sentinel(arr.dtype)))
+    raise ValueError(op)

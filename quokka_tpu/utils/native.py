@@ -1,0 +1,81 @@
+"""ctypes bindings to the optional native C++ helper library (native/).
+
+The library accelerates host-side columnar chores that sit off the device path:
+string hashing for dictionary encoding and CSV newline-boundary scans.  Pure
+Python fallbacks exist everywhere, so the package works without a compiler.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional, Sequence
+
+import numpy as np
+
+_LIB = None
+_TRIED = False
+
+
+def _find_lib():
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    _TRIED = True
+    here = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    for cand in (
+        os.path.join(here, "native", "libquokka_native.so"),
+        os.environ.get("QUOKKA_TPU_NATIVE_LIB", ""),
+    ):
+        if cand and os.path.exists(cand):
+            try:
+                lib = ctypes.CDLL(cand)
+                lib.qk_fnv1a64_many.restype = None
+                lib.qk_fnv1a64_many.argtypes = [
+                    ctypes.c_void_p,  # concatenated utf8 bytes
+                    ctypes.c_void_p,  # int64 offsets (n+1)
+                    ctypes.c_int64,  # n strings
+                    ctypes.c_void_p,  # out uint64[n]
+                ]
+                lib.qk_find_newline.restype = ctypes.c_int64
+                lib.qk_find_newline.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+                _LIB = lib
+            except OSError:
+                _LIB = None
+            break
+    return _LIB
+
+
+def fnv1a64_many(values: Sequence) -> Optional[np.ndarray]:
+    """Hash a sequence of strings with the native lib; None if unavailable."""
+    lib = _find_lib()
+    if lib is None:
+        return None
+    encoded = [(v if v is not None else "").encode("utf-8", errors="surrogatepass") for v in values]
+    n = len(encoded)
+    offsets = np.zeros(n + 1, dtype=np.int64)
+    for i, b in enumerate(encoded):
+        offsets[i + 1] = offsets[i] + len(b)
+    blob = b"".join(encoded)
+    buf = np.frombuffer(blob, dtype=np.uint8) if blob else np.zeros(0, dtype=np.uint8)
+    out = np.zeros(n, dtype=np.uint64)
+    lib.qk_fnv1a64_many(
+        buf.ctypes.data if buf.size else 0,
+        offsets.ctypes.data,
+        n,
+        out.ctypes.data,
+    )
+    # null entries hash to 0 to match the Python fallback
+    for i, v in enumerate(values):
+        if v is None:
+            out[i] = 0
+    return out
+
+
+def find_newline(data: bytes) -> int:
+    """Index of first b'\\n' in data, or -1.  Native when available."""
+    lib = _find_lib()
+    if lib is None:
+        return data.find(b"\n")
+    buf = np.frombuffer(data, dtype=np.uint8)
+    return int(lib.qk_find_newline(buf.ctypes.data if buf.size else 0, len(data)))

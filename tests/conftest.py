@@ -1,0 +1,50 @@
+"""Test harness: run everything on a virtual 8-device CPU mesh with x64 so
+results compare exactly against the pandas oracle.  Must set env before jax
+initializes (hence top-of-module, before any quokka_tpu import)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(42)
+
+
+def make_table(n=1000, seed=0):
+    """A mixed-type test table with strings, ints, floats, dates."""
+    r = np.random.default_rng(seed)
+    return pa.table(
+        {
+            "k": r.integers(0, 20, n).astype(np.int64),
+            "v": r.normal(size=n),
+            "q": r.integers(1, 50, n).astype(np.int64),
+            "s": np.array([["apple", "banana", "cherry", "date"][i] for i in r.integers(0, 4, n)]),
+            "d": pa.array(r.integers(8000, 12000, n).astype(np.int32), type=pa.int32()).cast(
+                pa.date32()
+            ),
+        }
+    )
+
+
+@pytest.fixture
+def table():
+    return make_table()
+
+
+@pytest.fixture
+def pdf(table):
+    return table.to_pandas()

@@ -1,0 +1,198 @@
+"""Expression AST, SQL parser, and expression->JAX compiler tests."""
+
+import datetime
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from quokka_tpu import sqlparse
+from quokka_tpu.expression import col, date, interval, lit, split_conjuncts, when
+from quokka_tpu.ops import bridge, expr_compile, kernels
+
+
+def eval_mask(expr, table):
+    b = bridge.arrow_to_device(table)
+    m = expr_compile.evaluate_predicate(expr, b)
+    return np.asarray(m)[np.asarray(b.valid)]
+
+
+def eval_col(expr, table):
+    b = bridge.arrow_to_device(table)
+    c = expr_compile.evaluate_to_column(expr, b)
+    out = bridge.device_to_arrow(
+        type(b)({"x": c}, b.valid, b.nrows)
+    )
+    return out.column("x").to_numpy(zero_copy_only=False)
+
+
+class TestPythonExprs:
+    def test_arith_and_compare(self, table, pdf):
+        e = (col("v") * 2 + col("q")) > 30
+        got = eval_mask(e, table)
+        np.testing.assert_array_equal(got, (pdf.v * 2 + pdf.q) > 30)
+
+    def test_and_or_not(self, table, pdf):
+        e = ((col("k") < 5) | (col("k") > 15)) & ~(col("q") == 10)
+        got = eval_mask(e, table)
+        exp = ((pdf.k < 5) | (pdf.k > 15)) & ~(pdf.q == 10)
+        np.testing.assert_array_equal(got, exp)
+
+    def test_string_equality(self, table, pdf):
+        got = eval_mask(col("s") == "banana", table)
+        np.testing.assert_array_equal(got, pdf.s == "banana")
+
+    def test_string_contains_like(self, table, pdf):
+        got = eval_mask(col("s").str.contains("an"), table)
+        np.testing.assert_array_equal(got, pdf.s.str.contains("an"))
+        got = eval_mask(col("s").str.like("%rry"), table)
+        np.testing.assert_array_equal(got, pdf.s.str.endswith("rry"))
+
+    def test_is_in(self, table, pdf):
+        got = eval_mask(col("s").is_in(["apple", "date"]), table)
+        np.testing.assert_array_equal(got, pdf.s.isin(["apple", "date"]))
+        got = eval_mask(col("k").is_in([1, 2, 3]), table)
+        np.testing.assert_array_equal(got, pdf.k.isin([1, 2, 3]))
+
+    def test_date_compare(self, table, pdf):
+        cutoff = datetime.date(1997, 6, 1)
+        got = eval_mask(col("d") <= date("1997-06-01"), table)
+        np.testing.assert_array_equal(got, pdf.d <= cutoff)
+
+    def test_date_interval_arith(self, table, pdf):
+        e = col("d") <= (date("1998-12-01") - interval(90, "day"))
+        got = eval_mask(e, table)
+        cutoff = datetime.date(1998, 12, 1) - datetime.timedelta(days=90)
+        np.testing.assert_array_equal(got, pdf.d <= cutoff)
+
+    def test_dt_year_month(self, table, pdf):
+        got = eval_col(col("d").dt.year, table)
+        np.testing.assert_array_equal(got, pd.DatetimeIndex(pdf.d).year)
+        got = eval_col(col("d").dt.month, table)
+        np.testing.assert_array_equal(got, pd.DatetimeIndex(pdf.d).month)
+
+    def test_case_when(self, table, pdf):
+        e = when(col("k") < 10).then(1.0).otherwise(0.0)
+        got = eval_col(e, table)
+        np.testing.assert_array_equal(got, np.where(pdf.k < 10, 1.0, 0.0))
+
+    def test_string_transform_slice(self, table, pdf):
+        e = col("s").str.slice(0, 2) == "ba"
+        got = eval_mask(e, table)
+        np.testing.assert_array_equal(got, pdf.s.str[:2] == "ba")
+
+    def test_required_columns(self):
+        e = (col("a") + col("b")) > col("c")
+        assert e.required_columns() == {"a", "b", "c"}
+
+    def test_split_conjuncts(self):
+        e = (col("a") > 1) & (col("b") > 2) & (col("c") > 3)
+        parts = split_conjuncts(e)
+        assert len(parts) == 3
+
+
+class TestSqlParser:
+    def test_simple_filter(self, table, pdf):
+        e = sqlparse.parse_expression("q > 25 and s = 'apple'")
+        got = eval_mask(e, table)
+        np.testing.assert_array_equal(got, (pdf.q > 25) & (pdf.s == "apple"))
+
+    def test_tpch_q1_filter(self, table, pdf):
+        e = sqlparse.parse_expression("d <= date '1998-12-01' - interval '90' day")
+        got = eval_mask(e, table)
+        cutoff = datetime.date(1998, 12, 1) - datetime.timedelta(days=90)
+        np.testing.assert_array_equal(got, pdf.d <= cutoff)
+
+    def test_between_in_like(self, table, pdf):
+        e = sqlparse.parse_expression(
+            "k between 5 and 10 and s in ('apple','cherry') and s like '%e%'"
+        )
+        got = eval_mask(e, table)
+        exp = (
+            pdf.k.between(5, 10)
+            & pdf.s.isin(["apple", "cherry"])
+            & pdf.s.str.contains("e")
+        )
+        np.testing.assert_array_equal(got, exp)
+
+    def test_arith_precedence(self, table, pdf):
+        e = sqlparse.parse_expression("v * 2 + q / 2 > 20")
+        got = eval_mask(e, table)
+        np.testing.assert_array_equal(got, pdf.v * 2 + pdf.q / 2 > 20)
+
+    def test_case_expression(self, table, pdf):
+        e = sqlparse.parse_expression("case when k < 10 then 1 else 0 end")
+        got = eval_col(e, table)
+        np.testing.assert_array_equal(got, np.where(pdf.k < 10, 1, 0))
+
+    def test_not_like(self, table, pdf):
+        e = sqlparse.parse_expression("s not like '%an%'")
+        got = eval_mask(e, table)
+        np.testing.assert_array_equal(got, ~pdf.s.str.contains("an"))
+
+    def test_extract(self, table, pdf):
+        e = sqlparse.parse_expression("extract(year from d)")
+        got = eval_col(e, table)
+        np.testing.assert_array_equal(got, pd.DatetimeIndex(pdf.d).year)
+
+    def test_select_list_with_aliases(self):
+        exprs = sqlparse.parse_select_list("sum(a) as s, count(*) as n, avg(b * c) as m")
+        assert [e.name for e in exprs] == ["s", "n", "m"]
+
+    def test_substring(self, table, pdf):
+        e = sqlparse.parse_expression("substring(s, 1, 3) = 'app'")
+        got = eval_mask(e, table)
+        np.testing.assert_array_equal(got, pdf.s.str[:3] == "app")
+
+    def test_cast(self, table, pdf):
+        e = sqlparse.parse_expression("cast(q as double) / 2")
+        got = eval_col(e, table)
+        np.testing.assert_allclose(got, pdf.q / 2)
+
+
+class TestAggPlan:
+    def test_q1_style_aggs(self, table, pdf):
+        exprs = sqlparse.parse_select_list(
+            "sum(q) as sum_qty, avg(v) as avg_v, count(*) as n, "
+            "sum(q * (1 - v)) as disc, max(q) as mk"
+        )
+        plan = expr_compile.plan_aggregation(exprs)
+        b = bridge.arrow_to_device(make_batch_table(table))
+        # compute pre columns
+        for name, e in plan.pre:
+            b = b.with_column(name, expr_compile.evaluate_to_column(e, b))
+        aggs = [
+            (pname, op, None if tmp is None else b.columns[tmp].data)
+            for (pname, op, tmp) in plan.partials
+        ]
+        g = kernels.compact(kernels.groupby_aggregate(b, ["k"], aggs))
+        for name, e in plan.finals:
+            g = g.with_column(name, expr_compile.evaluate_to_column(e, g))
+        got = (
+            bridge.device_to_arrow(g.select(["k"] + [n for n, _ in plan.finals]))
+            .to_pandas()
+            .sort_values("k")
+            .reset_index(drop=True)
+        )
+        exp = (
+            pdf.groupby("k")
+            .apply(
+                lambda df: pd.Series(
+                    {
+                        "sum_qty": df.q.sum(),
+                        "avg_v": df.v.mean(),
+                        "n": len(df),
+                        "disc": (df.q * (1 - df.v)).sum(),
+                        "mk": df.q.max(),
+                    }
+                ),
+                include_groups=False,
+            )
+            .reset_index()
+        )
+        pd.testing.assert_frame_equal(got, exp, check_dtype=False, rtol=1e-9)
+
+
+def make_batch_table(table):
+    return table
