@@ -7,3 +7,8 @@ on TPU instead of Polars/DuckDB on CPU.
 """
 
 __version__ = "0.1.0"
+
+from quokka_tpu.context import QuokkaContext
+from quokka_tpu.datastream import DataStream, GroupedDataStream, OrderedStream
+from quokka_tpu.expression import col, date, interval, lit, when
+

@@ -1,0 +1,189 @@
+"""QuokkaContext: the session object.
+
+Reference role (pyquokka/df.py:14-134): owns the logical-plan node registry,
+the read_* entry points, the optimizer driver, and lowering into the runtime.
+In the embedded single-host deployment it builds a TaskGraph per executed sink;
+cluster deployments swap the TaskGraph's store/cache for served ones.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Dict, List, Optional
+
+import pyarrow as pa
+
+from quokka_tpu import config, logical
+from quokka_tpu.datastream import DataStream, OrderedStream
+from quokka_tpu.dataset.readers import (
+    InputArrowDataset,
+    InputCSVDataset,
+    InputJSONDataset,
+    InputParquetDataset,
+)
+from quokka_tpu.runtime.engine import TaskGraph
+
+
+class QuokkaContext:
+    def __init__(
+        self,
+        cluster=None,
+        io_channels: int = 2,
+        exec_channels: int = 2,
+        exec_config: Optional[dict] = None,
+        optimize: bool = True,
+    ):
+        self.cluster = cluster  # reserved for multi-host deployments
+        self.io_channels = io_channels
+        self.exec_channels = exec_channels
+        self.exec_config = dict(config.DEFAULT_EXEC_CONFIG)
+        if exec_config:
+            self.exec_config.update(exec_config)
+        self.optimize_plans = optimize
+        self.nodes: Dict[int, logical.Node] = {}
+        self._next_node = 0
+        self.latest_graph = None  # last executed TaskGraph (introspection)
+
+    def set_config(self, key, value):
+        self.exec_config[key] = value
+
+    # -- plan registry --------------------------------------------------------
+    def add_node(self, node: logical.Node) -> int:
+        nid = self._next_node
+        self.nodes[nid] = node
+        self._next_node += 1
+        return nid
+
+    def new_stream(self, node: logical.Node, ordered: bool = False) -> DataStream:
+        nid = self.add_node(node)
+        return OrderedStream(self, nid) if ordered else DataStream(self, nid)
+
+    # -- readers ---------------------------------------------------------------
+    def read_parquet(self, path, columns=None) -> DataStream:
+        reader = InputParquetDataset(path, columns=columns)
+        schema = [f for f in reader.schema.names]
+        if columns:
+            schema = list(columns)
+        return self.new_stream(logical.SourceNode(reader, schema))
+
+    def read_csv(self, path, schema: Optional[List[str]] = None,
+                 has_header: bool = True, sep: str = ",") -> DataStream:
+        reader = InputCSVDataset(path, schema=schema, has_header=has_header, sep=sep)
+        return self.new_stream(logical.SourceNode(reader, list(reader.schema.names)))
+
+    def read_json(self, path) -> DataStream:
+        reader = InputJSONDataset(path)
+        return self.new_stream(logical.SourceNode(reader, list(reader.schema.names)))
+
+    def from_arrow(self, table: pa.Table) -> DataStream:
+        reader = InputArrowDataset(table)
+        return self.new_stream(logical.SourceNode(reader, list(table.column_names)))
+
+    def from_pandas(self, df) -> DataStream:
+        return self.from_arrow(pa.Table.from_pandas(df, preserve_index=False))
+
+    from_polars = from_pandas  # API-compat alias (no polars in this stack)
+
+    def read_dataset(self, reader, schema=None, sorted_by=None) -> DataStream:
+        schema = schema or list(reader.schema.names)
+        return self.new_stream(
+            logical.SourceNode(reader, schema, sorted_by=sorted_by),
+            ordered=sorted_by is not None,
+        )
+
+    # -- execution -------------------------------------------------------------
+    def execute_node(self, node_id: int):
+        # copy the reachable subgraph so optimizer rewrites don't mutate the
+        # user's plan (df.py:956-979 does the same)
+        sub, mapping = self._copy_subgraph(node_id)
+        sink_id = mapping[node_id]
+        if not isinstance(sub[sink_id], logical.SinkNode):
+            sink = logical.SinkNode([sink_id], sub[sink_id].schema)
+            sub_sink_id = max(sub) + 1
+            sub[sub_sink_id] = sink
+            sink_id = sub_sink_id
+        if self.optimize_plans:
+            from quokka_tpu.optimizer import optimize
+
+            sink_id = optimize(sub, sink_id)
+        self._assign_stages(sub, sink_id)
+        graph = TaskGraph(self.exec_config)
+        actor_of: Dict[int, int] = {}
+        for nid in self._toposort(sub, sink_id):
+            sub[nid].lower(self, graph, actor_of, nid)
+        self.latest_graph = graph
+        graph.run()
+        return graph.result(actor_of[sink_id])
+
+    def _copy_subgraph(self, node_id: int):
+        mapping: Dict[int, int] = {}
+        sub: Dict[int, logical.Node] = {}
+
+        def rec(nid: int) -> int:
+            if nid in mapping:
+                return mapping[nid]
+            node = self.nodes[nid]
+            cp = copy.copy(node)
+            cp.parents = [rec(p) for p in node.parents]
+            cp.schema = list(node.schema)
+            mapping[nid] = nid
+            sub[nid] = cp
+            return nid
+
+        rec(node_id)
+        return sub, mapping
+
+    def _toposort(self, sub: Dict[int, logical.Node], sink_id: int) -> List[int]:
+        out: List[int] = []
+        seen = set()
+
+        def rec(nid):
+            if nid in seen:
+                return
+            seen.add(nid)
+            for p in sub[nid].parents:
+                rec(p)
+            out.append(nid)
+
+        rec(sink_id)
+        return out
+
+    def _assign_stages(self, sub: Dict[int, logical.Node], sink_id: int) -> None:
+        """Build-before-probe stage assignment (df.py:1530-1621): walking from
+        the sink, a build parent's subtree gets stage-1; normalize to 0-based
+        ascending so the coordinator runs stages in increasing order."""
+        stage: Dict[int, int] = {}
+
+        def rec(nid: int, s: int):
+            # only re-walk a subtree when this visit improves (lowers) the
+            # stage — otherwise shared diamonds cost 2^k walks
+            if nid in stage and s >= stage[nid]:
+                return
+            stage[nid] = s
+            node = sub[nid]
+            for i, p in enumerate(node.parents):
+                rec(p, s - 1 if i in node.build_parents else s)
+
+        rec(sink_id, 0)
+        lo = min(stage.values())
+        for nid, s in stage.items():
+            sub[nid].stage = s - lo
+
+    # -- introspection ---------------------------------------------------------
+    def explain(self, node_id: int) -> str:
+        sub, _ = self._copy_subgraph(node_id)
+        sink_id = node_id
+        if self.optimize_plans:
+            from quokka_tpu.optimizer import optimize
+
+            sink_id = optimize(sub, sink_id)
+        self._assign_stages(sub, sink_id)
+        lines = []
+        for nid in self._toposort(sub, sink_id):
+            n = sub[nid]
+            indent = "  " * (max(n.stage, 0))
+            lines.append(
+                f"{indent}[{nid}] {n.describe()} stage={n.stage} "
+                f"schema={n.schema} parents={n.parents}"
+            )
+        return "\n".join(lines)

@@ -1,0 +1,6 @@
+from quokka_tpu.dataset.readers import (
+    InputArrowDataset,
+    InputCSVDataset,
+    InputJSONDataset,
+    InputParquetDataset,
+)

@@ -1,0 +1,392 @@
+"""DataStream: the lazy relational API.
+
+Mirrors the reference's surface (pyquokka/datastream.py:15-2192): every method
+appends a logical Node to the context's plan and returns a new stream; nothing
+executes until collect()/compute()/count().  SQL-string variants (filter_sql,
+with_columns_sql, agg_sql, transform_sql) go through quokka_tpu.sqlparse
+instead of sqlglot.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Union
+
+from quokka_tpu import logical, sqlparse
+from quokka_tpu.expression import (
+    Agg,
+    Alias,
+    ColRef,
+    Expr,
+    col,
+    conjoin,
+    lit_wrap,
+)
+from quokka_tpu.ops import bridge, kernels
+from quokka_tpu.ops.batch import DeviceBatch
+from quokka_tpu.ops.expr_compile import evaluate_to_column, plan_aggregation
+
+
+class DataStream:
+    def __init__(self, ctx, node_id: int):
+        self.ctx = ctx
+        self.node_id = node_id
+
+    # -- plan plumbing -------------------------------------------------------
+    @property
+    def _node(self) -> logical.Node:
+        return self.ctx.nodes[self.node_id]
+
+    @property
+    def schema(self) -> List[str]:
+        return list(self._node.schema)
+
+    def _child(self, node: logical.Node) -> "DataStream":
+        nid = self.ctx.add_node(node)
+        return DataStream(self.ctx, nid)
+
+    def _ordered_child(self, node: logical.Node) -> "OrderedStream":
+        nid = self.ctx.add_node(node)
+        return OrderedStream(self.ctx, nid)
+
+    def explain(self) -> str:
+        return self.ctx.explain(self.node_id)
+
+    # -- execution -----------------------------------------------------------
+    def collect(self):
+        """Execute and return a pandas DataFrame (the reference returns a
+        Polars DF, datastream.py:75)."""
+        ds = self.compute()
+        df = ds.to_df()
+        if df is None:
+            import pandas as pd
+
+            return pd.DataFrame(columns=self.schema)
+        return df
+
+    def to_arrow(self):
+        return self.compute().to_arrow()
+
+    def compute(self):
+        """Execute and return the materialized ResultDataset."""
+        return self.ctx.execute_node(self.node_id)
+
+    def count(self) -> int:
+        df = self.aggregate_sql("count(*) as count").collect()
+        return int(df["count"][0])
+
+    # -- row ops ---------------------------------------------------------------
+    def filter(self, predicate: Union[Expr, str]) -> "DataStream":
+        if isinstance(predicate, str):
+            return self.filter_sql(predicate)
+        assert isinstance(predicate, Expr)
+        missing = predicate.required_columns() - set(self.schema)
+        if missing:
+            raise ValueError(f"filter references unknown columns {missing}")
+        return self._child(logical.FilterNode([self.node_id], self.schema, predicate))
+
+    def filter_sql(self, sql: str) -> "DataStream":
+        return self.filter(sqlparse.parse_expression(sql))
+
+    def select(self, columns: Sequence[str]) -> "DataStream":
+        columns = [columns] if isinstance(columns, str) else list(columns)
+        missing = set(columns) - set(self.schema)
+        if missing:
+            raise ValueError(f"select references unknown columns {missing}")
+        return self._child(logical.ProjectionNode([self.node_id], columns))
+
+    def drop(self, columns: Sequence[str]) -> "DataStream":
+        columns = [columns] if isinstance(columns, str) else list(columns)
+        return self.select([c for c in self.schema if c not in set(columns)])
+
+    def rename(self, mapping: Dict[str, str]) -> "DataStream":
+        new_schema = [mapping.get(c, c) for c in self.schema]
+
+        def fn(b: DeviceBatch) -> DeviceBatch:
+            return b.rename(mapping)
+
+        return self._child(logical.MapNode([self.node_id], new_schema, fn))
+
+    def with_columns(self, exprs: Dict[str, Union[Expr, str]]) -> "DataStream":
+        compiled = {
+            k: (sqlparse.parse_expression(v) if isinstance(v, str) else v)
+            for k, v in exprs.items()
+        }
+        new_schema = self.schema + [k for k in compiled if k not in self.schema]
+
+        def fn(b: DeviceBatch) -> DeviceBatch:
+            for name, e in compiled.items():
+                b = b.with_column(name, evaluate_to_column(e, b))
+            return b
+
+        return self._child(
+            logical.MapNode([self.node_id], new_schema, fn, exprs=compiled)
+        )
+
+    def with_columns_sql(self, sql: str) -> "DataStream":
+        exprs = sqlparse.parse_select_list(sql)
+        named = {}
+        for e in exprs:
+            if not isinstance(e, Alias):
+                raise ValueError(f"with_columns_sql needs 'expr as name': {e.sql()}")
+            named[e.name] = e.expr
+        return self.with_columns(named)
+
+    def transform(self, fn: Callable, new_schema: List[str]) -> "DataStream":
+        """Arbitrary per-batch UDF over a pandas DataFrame (host round-trip,
+        like the reference's polars UDFs, datastream.py:652)."""
+
+        def wrapped(b: DeviceBatch) -> Optional[DeviceBatch]:
+            import pyarrow as pa
+
+            df = bridge.to_pandas(b)
+            out = fn(df)
+            if out is None or len(out) == 0:
+                return None
+            return bridge.arrow_to_device(pa.Table.from_pandas(out, preserve_index=False))
+
+        return self._child(logical.MapNode([self.node_id], new_schema, wrapped))
+
+    def stateful_transform(self, executor, new_schema: List[str],
+                           required_columns=None, by=None) -> "DataStream":
+        """Run a user Executor over the stream, optionally key-partitioned
+        (datastream.py:1312)."""
+        from quokka_tpu.target_info import HashPartitioner, PassThroughPartitioner
+
+        part = HashPartitioner(list(by)) if by else PassThroughPartitioner()
+        import copy as _copy
+
+        return self._child(
+            logical.StatefulNode(
+                [self.node_id],
+                new_schema,
+                lambda: _copy.deepcopy(executor),
+                partitioners={0: part},
+            )
+        )
+
+    def clip(self, limit: int) -> "DataStream":
+        return self.head(limit)
+
+    def head(self, limit: int) -> "DataStream":
+        return self._child(_HeadNode([self.node_id], self.schema, limit))
+
+    def union(self, other: "DataStream") -> "DataStream":
+        if set(other.schema) != set(self.schema):
+            raise ValueError("union requires identical schemas")
+        return self._child(_UnionNode([self.node_id, other.node_id], self.schema))
+
+    def distinct(self, keys: Optional[Sequence[str]] = None) -> "DataStream":
+        keys = list(keys) if keys else self.schema
+        return self._child(logical.DistinctNode([self.node_id], keys, keys))
+
+    # -- joins ----------------------------------------------------------------
+    def join(
+        self,
+        right: "DataStream",
+        on: Optional[Union[str, Sequence[str]]] = None,
+        left_on=None,
+        right_on=None,
+        how: str = "inner",
+        suffix: str = "_2",
+        maintain_sort_order=None,
+    ) -> "DataStream":
+        if on is not None:
+            left_on = right_on = [on] if isinstance(on, str) else list(on)
+        else:
+            left_on = [left_on] if isinstance(left_on, str) else list(left_on)
+            right_on = [right_on] if isinstance(right_on, str) else list(right_on)
+        for c in left_on:
+            if c not in self.schema:
+                raise ValueError(f"left join key {c} not in {self.schema}")
+        for c in right_on:
+            if c not in right.schema:
+                raise ValueError(f"right join key {c} not in {right.schema}")
+        if how in ("semi", "anti"):
+            out_schema = self.schema
+        else:
+            rpayload = [c for c in right.schema if c not in set(right_on)]
+            out_schema = self.schema + [
+                c + suffix if c in set(self.schema) else c for c in rpayload
+            ]
+        return self._child(
+            logical.JoinNode(
+                [self.node_id, right.node_id], out_schema, left_on, right_on, how, suffix
+            )
+        )
+
+    def broadcast_join(self, right: "DataStream", on=None, left_on=None,
+                       right_on=None, how: str = "inner", suffix: str = "_2"):
+        ds = self.join(right, on, left_on, right_on, how, suffix)
+        ds._node.broadcast = True
+        return ds
+
+    # -- aggregation -----------------------------------------------------------
+    def groupby(self, keys: Union[str, Sequence[str]], orderby=None) -> "GroupedDataStream":
+        keys = [keys] if isinstance(keys, str) else list(keys)
+        for k in keys:
+            if k not in self.schema:
+                raise ValueError(f"groupby key {k} not in {self.schema}")
+        return GroupedDataStream(self, keys, orderby)
+
+    def agg(self, aggregations: Dict) -> "DataStream":
+        return GroupedDataStream(self, [], None).agg(aggregations)
+
+    def agg_sql(self, sql: str) -> "DataStream":
+        return GroupedDataStream(self, [], None).agg_sql(sql)
+
+    aggregate = agg
+    aggregate_sql = agg_sql
+
+    def count_distinct(self, col_name: str) -> "DataStream":
+        return self.select([col_name]).distinct().aggregate_sql("count(*) as count")
+
+    def sum(self, columns) -> "DataStream":
+        columns = [columns] if isinstance(columns, str) else list(columns)
+        return self.agg_sql(", ".join(f"sum({c}) as {c}_sum" for c in columns))
+
+    def max(self, columns) -> "DataStream":
+        columns = [columns] if isinstance(columns, str) else list(columns)
+        return self.agg_sql(", ".join(f"max({c}) as {c}_max" for c in columns))
+
+    def min(self, columns) -> "DataStream":
+        columns = [columns] if isinstance(columns, str) else list(columns)
+        return self.agg_sql(", ".join(f"min({c}) as {c}_min" for c in columns))
+
+    def mean(self, columns) -> "DataStream":
+        columns = [columns] if isinstance(columns, str) else list(columns)
+        return self.agg_sql(", ".join(f"avg({c}) as {c}_mean" for c in columns))
+
+    # -- ordering --------------------------------------------------------------
+    def top_k(self, by, k: int, descending=None) -> "DataStream":
+        by = [by] if isinstance(by, str) else list(by)
+        descending = descending or [False] * len(by)
+        return self._child(logical.TopKNode([self.node_id], self.schema, by, k, descending))
+
+    def sort(self, by, descending=None) -> "DataStream":
+        by = [by] if isinstance(by, str) else list(by)
+        descending = descending or [False] * len(by)
+        return self._child(logical.SortNode([self.node_id], self.schema, by, descending))
+
+
+class _HeadNode(logical.Node):
+    def __init__(self, parents, schema, limit):
+        super().__init__(parents, schema)
+        self.limit = limit
+
+    def lower(self, ctx, graph, actor_of, node_id):
+        from quokka_tpu.executors.sql_execs import TopKExecutor
+
+        limit = self.limit
+
+        class _Head(TopKExecutor):
+            def __init__(self):
+                super().__init__([], limit, [])
+
+            def execute(self, batches, stream_id, channel):
+                parts = [b for b in batches if b is not None]
+                if self.state is not None:
+                    parts.append(self.state)
+                if not parts:
+                    return None
+                merged = bridge.concat_batches(parts) if len(parts) > 1 else parts[0]
+                self.state = kernels.head(merged, self.k)
+                return None
+
+        actor_of[node_id] = graph.new_exec_node(
+            _Head,
+            {0: (actor_of[self.parents[0]], logical._passthrough_edge())},
+            1,
+            self.stage,
+        )
+
+    def describe(self):
+        return f"Head({self.limit})"
+
+
+class _UnionNode(logical.Node):
+    def lower(self, ctx, graph, actor_of, node_id):
+        from quokka_tpu.executors.sql_execs import StorageExecutor
+
+        schema = list(self.schema)
+
+        class _Align(StorageExecutor):
+            def execute(self, batches, stream_id, channel):
+                out = StorageExecutor.execute(self, batches, stream_id, channel)
+                return None if out is None else out.select(schema)
+
+        actor_of[node_id] = graph.new_exec_node(
+            _Align,
+            {
+                i: (actor_of[p], logical._passthrough_edge())
+                for i, p in enumerate(self.parents)
+            },
+            self.channels or ctx.exec_channels,
+            self.stage,
+        )
+
+    def describe(self):
+        return "Union"
+
+
+class GroupedDataStream:
+    """groupby(...) handle -> agg / agg_sql (datastream.py:2066)."""
+
+    def __init__(self, stream: DataStream, keys: List[str], orderby):
+        self.stream = stream
+        self.keys = keys
+        self.orderby = orderby
+
+    def agg(self, aggregations: Dict) -> DataStream:
+        """{'col': 'sum' | ['sum','max'] | ...,  '*': 'count'} — output column
+        naming matches the reference: col_sum, col_max, ..., count."""
+        exprs: List[Expr] = []
+        for c, specs in aggregations.items():
+            specs = [specs] if isinstance(specs, str) else list(specs)
+            for s in specs:
+                s = s.lower()
+                if c == "*":
+                    if s != "count":
+                        raise ValueError("only count supported for '*'")
+                    exprs.append(Alias(Agg("count", None), "count"))
+                else:
+                    op = "avg" if s in ("mean", "avg") else s
+                    exprs.append(Alias(Agg(op, ColRef(c)), f"{c}_{s}"))
+        return self._agg_exprs(exprs)
+
+    def agg_sql(self, sql: str) -> DataStream:
+        exprs = sqlparse.parse_select_list(sql)
+        named = []
+        for i, e in enumerate(exprs):
+            if isinstance(e, Alias):
+                named.append(e)
+            else:
+                named.append(Alias(e, f"col{i}"))
+        return self._agg_exprs(named)
+
+    aggregate = agg
+    aggregate_sql = agg_sql
+
+    def _agg_exprs(self, exprs: List[Alias]) -> DataStream:
+        plan = plan_aggregation(exprs)
+        out_schema = self.keys + [n for n, _ in plan.finals if n not in self.keys]
+        order_by = None
+        if self.orderby:
+            order_by = [
+                (c, False) if isinstance(c, str) else (c[0], c[1] == "desc")
+                for c in ([self.orderby] if isinstance(self.orderby, str) else self.orderby)
+            ]
+        elif self.keys:
+            order_by = [(k, False) for k in self.keys]
+        node = logical.AggNode(
+            [self.stream.node_id], out_schema, self.keys, plan, order_by=order_by
+        )
+        return self.stream._child(node)
+
+
+class OrderedStream(DataStream):
+    """Sorted-stream subclass (orderedstream.py:3); time-series verbs attach
+    here (asof joins, windows, CEP) — see quokka_tpu.ts (task tier)."""
+
+    @property
+    def sorted_by(self):
+        return self._node.sorted_by

@@ -1,0 +1,13 @@
+from quokka_tpu.executors.base import Executor
+from quokka_tpu.executors.sql_execs import (
+    BroadcastJoinExecutor,
+    BuildProbeJoinExecutor,
+    CountExecutor,
+    DistinctExecutor,
+    FinalAggExecutor,
+    PartialAggExecutor,
+    SortExecutor,
+    StorageExecutor,
+    TopKExecutor,
+    UDFExecutor,
+)

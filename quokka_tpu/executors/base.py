@@ -1,0 +1,39 @@
+"""Executor protocol.
+
+Same plugin boundary as the reference (pyquokka/executors/base_executor.py:26-32):
+an executor is a per-channel stateful object the runtime drives with
+``execute(batches, stream_id, channel)`` for every input batch-set and
+``done(channel)`` once all inputs are exhausted; optional checkpoint/restore
+make it fault-tolerant.  Batches here are DeviceBatches (on-chip), and
+executors express their compute as jitted kernel calls.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from quokka_tpu.ops.batch import DeviceBatch
+
+
+class Executor:
+    def execute(
+        self, batches: List[DeviceBatch], stream_id: int, channel: int
+    ) -> Optional[DeviceBatch]:
+        raise NotImplementedError
+
+    def done(self, channel: int) -> Optional[DeviceBatch]:
+        return None
+
+    def source_done(self, stream_id: int, channel: int) -> Optional[DeviceBatch]:
+        """Called by the runtime when one input stream is exhausted (other
+        streams may still flow).  Lets multi-stream executors (joins) finalize
+        a side; may return an output batch."""
+        return None
+
+    # -- fault tolerance hooks (optional) ------------------------------------
+    def checkpoint(self):
+        """Return a picklable snapshot of executor state, or None."""
+        return None
+
+    def restore(self, state) -> None:
+        pass

@@ -1,0 +1,362 @@
+"""Core relational executors on device kernels.
+
+Functional parity targets (reference: pyquokka/executors/sql_executors.py):
+UDFExecutor:3, CountExecutor:69, StorageExecutor:24, BuildProbeJoinExecutor:325,
+DistinctExecutor:517, SQLAggExecutor:556 (split here into PartialAgg/FinalAgg so
+aggregation is decomposed partial->shuffle->final instead of concat-then-DuckDB),
+ConcatThenSQLExecutor:45 (TopK/Sort below).
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from quokka_tpu.ops import bridge, kernels
+from quokka_tpu.ops import join as join_ops
+from quokka_tpu.ops.batch import DeviceBatch
+from quokka_tpu.ops.expr_compile import AggPlan, evaluate_predicate, evaluate_to_column
+from quokka_tpu.executors.base import Executor
+
+
+class UDFExecutor(Executor):
+    """Stateless per-batch transform (DataStream.transform)."""
+
+    def __init__(self, fn: Callable[[DeviceBatch], DeviceBatch]):
+        self.fn = fn
+
+    def execute(self, batches, stream_id, channel):
+        out = [self.fn(b) for b in batches if b is not None]
+        out = [b for b in out if b is not None]
+        if not out:
+            return None
+        return bridge.concat_batches(out) if len(out) > 1 else out[0]
+
+
+class CountExecutor(Executor):
+    def __init__(self):
+        self.count = 0
+
+    def execute(self, batches, stream_id, channel):
+        self.count += sum(b.count_valid() for b in batches)
+
+    def done(self, channel):
+        import pyarrow as pa
+
+        return bridge.arrow_to_device(pa.table({"count": [self.count]}))
+
+
+class StorageExecutor(Executor):
+    """Pass batches through unchanged (terminal collect node)."""
+
+    def execute(self, batches, stream_id, channel):
+        live = [b for b in batches if b is not None and b.count_valid() > 0]
+        if not live:
+            return None
+        return bridge.concat_batches(live) if len(live) > 1 else live[0]
+
+
+class PartialAggExecutor(Executor):
+    """Per-channel partial group-by: maintains one running partial-aggregate
+    batch; emits it at done.  Sits upstream of the hash shuffle."""
+
+    def __init__(self, keys: Sequence[str], plan: AggPlan):
+        self.keys = list(keys)
+        self.plan = plan
+        self.state: Optional[DeviceBatch] = None
+
+    def _partial(self, batch: DeviceBatch) -> DeviceBatch:
+        b = batch
+        for name, e in self.plan.pre:
+            b = b.with_column(name, evaluate_to_column(e, b))
+        aggs = [
+            (p, op, None if tmp is None else b.columns[tmp].data)
+            for (p, op, tmp) in self.plan.partials
+        ]
+        g = kernels.groupby_aggregate(b, self.keys, aggs)
+        return kernels.compact(g.select(self.keys + [p for p, _, _ in self.plan.partials]))
+
+    def _recombine(self, parts: List[DeviceBatch]) -> DeviceBatch:
+        merged = bridge.concat_batches(parts) if len(parts) > 1 else parts[0]
+        aggs = [(p, op, merged.columns[p].data) for (p, op) in self.plan.recombine]
+        g = kernels.groupby_aggregate(merged, self.keys, aggs)
+        return kernels.compact(g.select(self.keys + [p for p, _ in self.plan.recombine]))
+
+    def execute(self, batches, stream_id, channel):
+        parts = [self._partial(b) for b in batches if b is not None]
+        if self.state is not None:
+            parts.append(self.state)
+        if parts:
+            self.state = self._recombine(parts)
+        return None
+
+    def done(self, channel):
+        out, self.state = self.state, None
+        return out
+
+    def checkpoint(self):
+        return None if self.state is None else bridge.device_to_arrow(self.state)
+
+    def restore(self, state):
+        self.state = None if state is None else bridge.arrow_to_device(state)
+
+
+class FinalAggExecutor(Executor):
+    """Downstream of the key shuffle: recombines partials for its key range,
+    then applies final expressions, HAVING, ORDER BY and LIMIT at done."""
+
+    def __init__(
+        self,
+        keys: Sequence[str],
+        plan: AggPlan,
+        having=None,
+        order_by: Optional[List[Tuple[str, bool]]] = None,
+        limit: Optional[int] = None,
+    ):
+        self.keys = list(keys)
+        self.plan = plan
+        self.having = having
+        self.order_by = order_by
+        self.limit = limit
+        self.state: Optional[DeviceBatch] = None
+
+    def execute(self, batches, stream_id, channel):
+        parts = [b for b in batches if b is not None and b.count_valid() > 0]
+        if self.state is not None:
+            parts.append(self.state)
+        if not parts:
+            return None
+        merged = bridge.concat_batches(parts) if len(parts) > 1 else parts[0]
+        aggs = [(p, op, merged.columns[p].data) for (p, op) in self.plan.recombine]
+        g = kernels.groupby_aggregate(merged, self.keys, aggs)
+        self.state = kernels.compact(g.select(self.keys + [p for p, _ in self.plan.recombine]))
+        return None
+
+    def done(self, channel):
+        if self.state is None:
+            if self.keys:
+                return None
+            # SQL semantics: a global aggregate over zero rows yields one row
+            # (count = 0, sum = 0, min/max = null)
+            import numpy as np
+            import pyarrow as pa
+
+            cols = {}
+            for pname, op, _tmp in self.plan.partials:
+                if op == "count":
+                    cols[pname] = np.array([0], dtype=np.int64)
+                elif op == "sum":
+                    cols[pname] = np.array([0.0])
+                else:
+                    cols[pname] = np.array([np.nan])
+            self.state = bridge.arrow_to_device(pa.table(cols))
+        g = self.state
+        for name, e in self.plan.finals:
+            g = g.with_column(name, evaluate_to_column(e, g))
+        out_cols = self.keys + [n for n, _ in self.plan.finals]
+        # dedupe (a key may also be an output)
+        seen, cols = set(), []
+        for c in out_cols:
+            if c not in seen:
+                seen.add(c)
+                cols.append(c)
+        g = g.select(cols)
+        if self.having is not None:
+            g = kernels.compact(kernels.apply_mask(g, evaluate_predicate(self.having, g)))
+        if self.order_by:
+            names = [n for n, _ in self.order_by]
+            desc = [d for _, d in self.order_by]
+            if self.limit is not None:
+                g = kernels.top_k(g, names, self.limit, desc)
+            else:
+                g = kernels.sort_batch(g, names, desc)
+        elif self.limit is not None:
+            g = kernels.head(g, self.limit)
+        self.state = None
+        return g
+
+
+class BuildProbeJoinExecutor(Executor):
+    """Streamed hash join: stream 1 is the build side (buffered until its
+    stage completes), stream 0 probes.  Stage scheduling guarantees build
+    completes before the first probe batch arrives (the reference asserts the
+    same invariant, sql_executors.py:357)."""
+
+    def __init__(
+        self,
+        left_on: Sequence[str],
+        right_on: Sequence[str],
+        how: str = "inner",
+        suffix: str = "_2",
+    ):
+        self.left_on = list(left_on)
+        self.right_on = list(right_on)
+        self.how = how
+        self.suffix = suffix
+        self.build_parts: List[DeviceBatch] = []
+        self.build: Optional[DeviceBatch] = None
+        self.build_done = False
+        self.probe_buffer: List[DeviceBatch] = []
+        self.build_unique: Optional[bool] = None
+        self.payload: Optional[List[str]] = None
+        self.rename: Dict[str, str] = {}
+
+    def _finalize_build(self, probe_cols: List[str]):
+        if not self.build_parts:
+            self.build = None
+            return
+        b = (
+            bridge.concat_batches(self.build_parts)
+            if len(self.build_parts) > 1
+            else self.build_parts[0]
+        )
+        self.build_parts = []
+        # payload = build columns minus its join keys; rename clashes
+        payload = [c for c in b.names if c not in self.right_on]
+        self.rename = {c: c + self.suffix for c in payload if c in probe_cols}
+        if self.rename:
+            b = b.rename(self.rename)
+            payload = [self.rename.get(c, c) for c in payload]
+        self.payload = payload
+        self.build = b
+        self.build_unique = join_ops.build_keys_unique(b, self.right_on)
+
+    def execute(self, batches, stream_id, channel):
+        live = [b for b in batches if b is not None]
+        if not live:
+            return None
+        if stream_id == 1:
+            assert self.build is None, "build batch arrived after probing began"
+            self.build_parts.extend(live)
+            return None
+        # probe: if the build stream hasn't been declared exhausted yet
+        # (stage-tie cases like self-joins), buffer and flush on source_done
+        if not self.build_done:
+            self.probe_buffer.extend(live)
+            return None
+        return self._probe(live)
+
+    def source_done(self, stream_id, channel):
+        if stream_id != 1 or self.build_done:
+            return None
+        self.build_done = True
+        buffered, self.probe_buffer = self.probe_buffer, []
+        if buffered:
+            return self._probe(buffered)
+        return None
+
+    def _probe(self, live):
+        if self.build is None and self.build_parts:
+            self._finalize_build(live[0].names)
+        if self.build is None or self.build.count_valid() == 0:
+            if self.how in ("inner", "semi"):
+                return None
+            if self.how == "anti":
+                out = live
+                return bridge.concat_batches(out) if len(out) > 1 else out[0]
+            raise NotImplementedError("left join against empty build (todo)")
+        outs = []
+        for probe in live:
+            if self.build_unique and self.how in ("inner", "semi", "anti"):
+                out = join_ops.hash_join_pk(
+                    probe, self.build, self.left_on, self.right_on, self.how, self.payload
+                )
+            else:
+                out = join_ops.hash_join_general(
+                    probe, self.build, self.left_on, self.right_on, self.how, self.payload
+                )
+            if out is not None:
+                outs.append(out)
+        if not outs:
+            return None
+        return bridge.concat_batches(outs) if len(outs) > 1 else outs[0]
+
+    def checkpoint(self):
+        state = self.build if self.build is not None else None
+        if state is None and self.build_parts:
+            state = bridge.concat_batches(self.build_parts)
+        return None if state is None else bridge.device_to_arrow(state)
+
+    def restore(self, state):
+        if state is not None:
+            self.build_parts = [bridge.arrow_to_device(state)]
+
+
+class BroadcastJoinExecutor(BuildProbeJoinExecutor):
+    """Small side broadcast to every channel (reference sql_executors.py:275):
+    identical device logic; only the partitioner differs (Broadcast)."""
+
+
+class DistinctExecutor(Executor):
+    """Streaming distinct: emit rows not seen before (anti-join against the
+    accumulated key state, reference sql_executors.py:517)."""
+
+    def __init__(self, keys: Sequence[str]):
+        self.keys = list(keys)
+        self.seen: Optional[DeviceBatch] = None
+
+    def execute(self, batches, stream_id, channel):
+        outs = []
+        for b in batches:
+            if b is None:
+                continue
+            b = kernels.distinct(b, self.keys)
+            b = kernels.compact(b)
+            if self.seen is not None:
+                b = kernels.compact(
+                    join_ops.hash_join_general(b, self.seen, self.keys, self.keys, "anti")
+                )
+            if b.count_valid() == 0:
+                continue
+            self.seen = (
+                b if self.seen is None else bridge.concat_batches([self.seen, b])
+            )
+            outs.append(b)
+        if not outs:
+            return None
+        return bridge.concat_batches(outs) if len(outs) > 1 else outs[0]
+
+
+class TopKExecutor(Executor):
+    """Running top-k by sort keys (reference expresses this via
+    ConcatThenSQLExecutor; here the running state is never larger than k)."""
+
+    def __init__(self, by: List[str], k: int, descending: List[bool]):
+        self.by = by
+        self.k = k
+        self.descending = descending
+        self.state: Optional[DeviceBatch] = None
+
+    def execute(self, batches, stream_id, channel):
+        parts = [b for b in batches if b is not None]
+        if self.state is not None:
+            parts.append(self.state)
+        if not parts:
+            return None
+        merged = bridge.concat_batches(parts) if len(parts) > 1 else parts[0]
+        self.state = kernels.top_k(merged, self.by, self.k, self.descending)
+        return None
+
+    def done(self, channel):
+        out, self.state = self.state, None
+        return out
+
+
+class SortExecutor(Executor):
+    """Blocking sort: accumulate, sort once at done.  (External merge-sort
+    with spill, as in SuperFastSortExecutor, is a later tier.)"""
+
+    def __init__(self, by: List[str], descending: List[bool]):
+        self.by = by
+        self.descending = descending
+        self.parts: List[DeviceBatch] = []
+
+    def execute(self, batches, stream_id, channel):
+        self.parts.extend(b for b in batches if b is not None)
+
+    def done(self, channel):
+        if not self.parts:
+            return None
+        merged = bridge.concat_batches(self.parts) if len(self.parts) > 1 else self.parts[0]
+        self.parts = []
+        return kernels.sort_batch(merged, self.by, self.descending)

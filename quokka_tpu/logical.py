@@ -1,0 +1,322 @@
+"""Logical plan nodes.
+
+Role of pyquokka/logical.py: the DataStream API builds a DAG of these; the
+optimizer rewrites it; ``lower()`` emits physical actors into the runtime
+TaskGraph.  Each node records its parents, output schema, and (assigned by
+stage analysis) its execution stage; every consumer edge carries a TargetInfo
+describing partitioning and any folded-in predicate/projection/batch functions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from quokka_tpu.expression import Expr
+from quokka_tpu.ops import kernels
+from quokka_tpu.ops.expr_compile import AggPlan, evaluate_predicate, evaluate_to_column
+from quokka_tpu.target_info import (
+    BroadcastPartitioner,
+    HashPartitioner,
+    PassThroughPartitioner,
+    TargetInfo,
+)
+
+
+class Node:
+    def __init__(self, parents: List[int], schema: List[str]):
+        self.parents = parents
+        self.schema = schema
+        self.stage = 0
+        self.channels: Optional[int] = None  # None -> context default
+        # build_parents: indices into self.parents whose subtree must complete
+        # before this node's streaming side runs (join build sides)
+        self.build_parents: List[int] = []
+        self.sorted_by: Optional[List[str]] = None
+
+    def lower(self, ctx, graph, actor_of: Dict[int, int], node_id: int) -> None:
+        raise NotImplementedError
+
+    def describe(self) -> str:
+        return type(self).__name__
+
+
+class SourceNode(Node):
+    def __init__(self, reader, schema: List[str], sorted_by=None):
+        super().__init__([], schema)
+        self.reader = reader
+        self.sorted_by = sorted_by
+
+    def lower(self, ctx, graph, actor_of, node_id):
+        actor_of[node_id] = graph.new_input_reader_node(
+            self.reader, self.channels or ctx.io_channels, self.stage, self.sorted_by
+        )
+
+    def describe(self):
+        return f"Source({type(self.reader).__name__})"
+
+
+def _passthrough_edge():
+    return TargetInfo(PassThroughPartitioner())
+
+
+class FilterNode(Node):
+    def __init__(self, parents, schema, predicate: Expr):
+        super().__init__(parents, schema)
+        self.predicate = predicate
+
+    def lower(self, ctx, graph, actor_of, node_id):
+        from quokka_tpu.executors.sql_execs import UDFExecutor
+
+        pred = self.predicate
+        actor_of[node_id] = graph.new_exec_node(
+            lambda: UDFExecutor(
+                lambda b: kernels.apply_mask(b, evaluate_predicate(pred, b))
+            ),
+            {0: (actor_of[self.parents[0]], _passthrough_edge())},
+            self.channels or ctx.exec_channels,
+            self.stage,
+        )
+
+    def describe(self):
+        return f"Filter({self.predicate.sql()})"
+
+
+class ProjectionNode(Node):
+    def __init__(self, parents, schema):
+        super().__init__(parents, schema)
+
+    def lower(self, ctx, graph, actor_of, node_id):
+        from quokka_tpu.executors.sql_execs import UDFExecutor
+
+        cols = list(self.schema)
+        actor_of[node_id] = graph.new_exec_node(
+            lambda: UDFExecutor(lambda b: b.select(cols)),
+            {0: (actor_of[self.parents[0]], _passthrough_edge())},
+            self.channels or ctx.exec_channels,
+            self.stage,
+        )
+
+    def describe(self):
+        return f"Projection({self.schema})"
+
+
+class MapNode(Node):
+    """with_columns / rename / transform: a per-batch device function.
+    ``exprs`` (when set) makes the map foldable by the optimizer."""
+
+    def __init__(self, parents, schema, fn: Callable, exprs: Optional[Dict[str, Expr]] = None):
+        super().__init__(parents, schema)
+        self.fn = fn
+        self.exprs = exprs
+
+    def lower(self, ctx, graph, actor_of, node_id):
+        from quokka_tpu.executors.sql_execs import UDFExecutor
+
+        fn = self.fn
+        actor_of[node_id] = graph.new_exec_node(
+            lambda: UDFExecutor(fn),
+            {0: (actor_of[self.parents[0]], _passthrough_edge())},
+            self.channels or ctx.exec_channels,
+            self.stage,
+        )
+
+    def describe(self):
+        if self.exprs:
+            return "Map(" + ", ".join(f"{k}={v.sql()}" for k, v in self.exprs.items()) + ")"
+        return "Map(udf)"
+
+
+class StatefulNode(Node):
+    """User-provided executor (stateful_transform / custom operators)."""
+
+    def __init__(self, parents, schema, executor_factory, partitioners=None, sorted_output=None):
+        super().__init__(parents, schema)
+        self.executor_factory = executor_factory
+        self.partitioners = partitioners or {}
+        self.sorted_by = sorted_output
+
+    def lower(self, ctx, graph, actor_of, node_id):
+        sources = {}
+        for i, p in enumerate(self.parents):
+            part = self.partitioners.get(i, PassThroughPartitioner())
+            sources[i] = (actor_of[p], TargetInfo(part))
+        actor_of[node_id] = graph.new_exec_node(
+            self.executor_factory,
+            sources,
+            self.channels or ctx.exec_channels,
+            self.stage,
+            sorted_actor=self.sorted_by is not None,
+        )
+
+    def describe(self):
+        return "Stateful"
+
+
+class JoinNode(Node):
+    """Binary hash join; parents[0] = probe (stream 0), parents[1] = build."""
+
+    def __init__(self, parents, schema, left_on, right_on, how="inner", suffix="_2", broadcast=False):
+        super().__init__(parents, schema)
+        self.left_on = list(left_on)
+        self.right_on = list(right_on)
+        self.how = how
+        self.suffix = suffix
+        self.broadcast = broadcast
+        self.build_parents = [1]
+
+    def lower(self, ctx, graph, actor_of, node_id):
+        from quokka_tpu.executors.sql_execs import BuildProbeJoinExecutor
+
+        left_on, right_on, how, suffix = self.left_on, self.right_on, self.how, self.suffix
+        if self.broadcast:
+            edges = {
+                0: (actor_of[self.parents[0]], _passthrough_edge()),
+                1: (actor_of[self.parents[1]], TargetInfo(BroadcastPartitioner())),
+            }
+        else:
+            edges = {
+                0: (actor_of[self.parents[0]], TargetInfo(HashPartitioner(left_on))),
+                1: (actor_of[self.parents[1]], TargetInfo(HashPartitioner(right_on))),
+            }
+        actor_of[node_id] = graph.new_exec_node(
+            lambda: BuildProbeJoinExecutor(left_on, right_on, how, suffix),
+            edges,
+            self.channels or ctx.exec_channels,
+            self.stage,
+        )
+
+    def describe(self):
+        k = "BroadcastJoin" if self.broadcast else "HashJoin"
+        return f"{k}({self.how}, {self.left_on}={self.right_on})"
+
+
+class AggNode(Node):
+    """Decomposed group-by aggregate: a partial-agg actor on the parent's
+    channels feeds a key-hash-partitioned final-agg actor.  (The TPU-first
+    replacement for batch_funcs partial agg + SQLAggExecutor concat-DuckDB.)"""
+
+    def __init__(self, parents, schema, keys: List[str], plan: AggPlan,
+                 having=None, order_by=None, limit=None):
+        super().__init__(parents, schema)
+        self.keys = keys
+        self.plan = plan
+        self.having = having
+        self.order_by = order_by
+        self.limit = limit
+
+    def lower(self, ctx, graph, actor_of, node_id):
+        from quokka_tpu.executors.sql_execs import FinalAggExecutor, PartialAggExecutor
+
+        keys, plan = self.keys, self.plan
+        having, order_by, limit = self.having, self.order_by, self.limit
+        partial = graph.new_exec_node(
+            lambda: PartialAggExecutor(keys, plan),
+            {0: (actor_of[self.parents[0]], _passthrough_edge())},
+            self.channels or ctx.exec_channels,
+            self.stage,
+        )
+        n_final = (self.channels or ctx.exec_channels) if keys else 1
+        part = HashPartitioner(keys) if keys else PassThroughPartitioner()
+        actor_of[node_id] = graph.new_exec_node(
+            lambda: FinalAggExecutor(keys, plan, having, order_by, limit),
+            {0: (partial, TargetInfo(part))},
+            n_final,
+            self.stage,
+        )
+
+    def describe(self):
+        return f"Agg(keys={self.keys}, out={[n for n, _ in self.plan.finals]})"
+
+
+class DistinctNode(Node):
+    def __init__(self, parents, schema, keys):
+        super().__init__(parents, schema)
+        self.keys = keys
+
+    def lower(self, ctx, graph, actor_of, node_id):
+        from quokka_tpu.executors.sql_execs import DistinctExecutor
+
+        keys = self.keys
+        actor_of[node_id] = graph.new_exec_node(
+            lambda: DistinctExecutor(keys),
+            {0: (actor_of[self.parents[0]], TargetInfo(HashPartitioner(keys)))},
+            self.channels or ctx.exec_channels,
+            self.stage,
+        )
+
+    def describe(self):
+        return f"Distinct({self.keys})"
+
+
+class TopKNode(Node):
+    def __init__(self, parents, schema, by, k, descending):
+        super().__init__(parents, schema)
+        self.by = by
+        self.k = k
+        self.descending = descending
+
+    def lower(self, ctx, graph, actor_of, node_id):
+        from quokka_tpu.executors.sql_execs import TopKExecutor
+
+        by, k, desc = self.by, self.k, self.descending
+        local = graph.new_exec_node(
+            lambda: TopKExecutor(by, k, desc),
+            {0: (actor_of[self.parents[0]], _passthrough_edge())},
+            self.channels or ctx.exec_channels,
+            self.stage,
+        )
+        actor_of[node_id] = graph.new_exec_node(
+            lambda: TopKExecutor(by, k, desc),
+            {0: (local, _passthrough_edge())},
+            1,
+            self.stage,
+        )
+
+    def describe(self):
+        return f"TopK({self.by}, k={self.k})"
+
+
+class SortNode(Node):
+    """Global sort: single-channel blocking sort (external merge later)."""
+
+    def __init__(self, parents, schema, by, descending):
+        super().__init__(parents, schema)
+        self.by = by
+        self.descending = descending
+
+    def lower(self, ctx, graph, actor_of, node_id):
+        from quokka_tpu.executors.sql_execs import SortExecutor
+
+        by, desc = self.by, self.descending
+        actor_of[node_id] = graph.new_exec_node(
+            lambda: SortExecutor(by, desc),
+            {0: (actor_of[self.parents[0]], _passthrough_edge())},
+            1,
+            self.stage,
+        )
+        self.sorted_by = list(by)
+
+    def describe(self):
+        return f"Sort({self.by})"
+
+
+class SinkNode(Node):
+    """Blocking collect target (DataSetNode in the reference)."""
+
+    def __init__(self, parents, schema):
+        super().__init__(parents, schema)
+
+    def lower(self, ctx, graph, actor_of, node_id):
+        from quokka_tpu.executors.sql_execs import StorageExecutor
+
+        actor_of[node_id] = graph.new_exec_node(
+            StorageExecutor,
+            {0: (actor_of[self.parents[0]], _passthrough_edge())},
+            1,
+            self.stage,
+            blocking=True,
+        )
+
+    def describe(self):
+        return "Collect"

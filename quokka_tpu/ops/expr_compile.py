@@ -492,17 +492,19 @@ def _case(e: Case, batch: DeviceBatch):
     )
     if isinstance(default, StrCol):
         raise CompileError("string-valued CASE (todo)")
-    out = default.data
-    kind = default.kind
-    for cond, val in reversed(e.whens):
-        c = evaluate_predicate(cond, batch)
+    conds, vals = [], []
+    for cond, val in e.whens:
+        conds.append(evaluate_predicate(cond, batch))
         vcol = evaluate_to_column(val, batch)
         if isinstance(vcol, StrCol):
             raise CompileError("string-valued CASE (todo)")
-        v, out = jnp.broadcast_arrays(vcol.data, out)
-        out = jnp.where(c, v.astype(out.dtype) if v.dtype != out.dtype else v, out)
-        if vcol.kind == "f":
-            kind = "f"
+        vals.append(vcol)
+    # promote all branches to a common dtype before any where()
+    dtype = jnp.result_type(default.data, *(v.data for v in vals))
+    out = default.data.astype(dtype)
+    kind = "f" if jnp.issubdtype(dtype, jnp.floating) else default.kind
+    for c, vcol in zip(reversed(conds), reversed(vals)):
+        out = jnp.where(c, vcol.data.astype(dtype), out)
     return NumCol(out, kind)
 
 

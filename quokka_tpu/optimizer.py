@@ -1,0 +1,19 @@
+"""Logical-plan optimizer.
+
+Pass lineup mirrors the reference driver (pyquokka/df.py:887-907): ANN
+pushdown, predicate pushdown, early projection, map folding, join merge with
+cardinality ordering, cardinality propagation, stage determination (stage
+assignment lives in context._assign_stages).  Passes land incrementally; each
+is a pure rewrite of the node dict.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from quokka_tpu import logical
+
+
+def optimize(sub: Dict[int, logical.Node], sink_id: int) -> int:
+    """Rewrite the plan in place; returns the (possibly new) sink id."""
+    return sink_id

@@ -1,0 +1,6 @@
+from quokka_tpu.parallel.mesh import (
+    collective_hash_shuffle,
+    distributed_groupby_step,
+    distributed_join_groupby_step,
+    make_mesh,
+)

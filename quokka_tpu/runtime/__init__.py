@@ -1,0 +1,2 @@
+from quokka_tpu.runtime.engine import Engine, TaskGraph
+from quokka_tpu.runtime.tables import ControlStore

@@ -1,0 +1,135 @@
+"""In-memory batch cache + input planning — the data plane for a worker.
+
+Plays the role of the reference's per-machine Arrow Flight server cache and its
+`do_get("cache")` planner (pyquokka/flight.py:96-264): decide which pending
+input batches an executor channel should consume next.  Policy preserved from
+the reference:
+  - only sources at the minimum execution stage are served (flight.py:115-125);
+  - per source channel, batches are delivered contiguously by seq;
+  - for sorted actors (SAT), delivery follows global (seq, channel)-interleaved
+    order so time order is preserved across channels (flight.py:168-206);
+  - accumulation: prefer the source actor with the most ready batches, capped
+    at max_batches (flight.py:132-145).
+
+Here the cache holds DeviceBatches (already on-chip), so a "get" is zero-copy.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+
+class BatchCache:
+    def __init__(self, mem_limit_batches: int = 10_000):
+        self._lock = threading.Lock()
+        self._data: Dict[Tuple, object] = {}  # 6-tuple name -> DeviceBatch
+        # index: (tgt_actor, tgt_ch) -> (src_actor, src_ch) -> set of seqs
+        self._index: Dict[Tuple, Dict[Tuple, Set[int]]] = defaultdict(
+            lambda: defaultdict(set)
+        )
+        self.mem_limit_batches = mem_limit_batches
+
+    def put(self, name: Tuple, batch) -> None:
+        src_actor, src_ch, seq, tgt_actor, _, tgt_ch = name
+        with self._lock:
+            self._data[name] = batch  # dedup: latest write wins (flight.py:67-76)
+            self._index[(tgt_actor, tgt_ch)][(src_actor, src_ch)].add(seq)
+
+    def puttable(self) -> bool:
+        with self._lock:
+            return len(self._data) < self.mem_limit_batches
+
+    def plan_get(
+        self,
+        tgt_actor: int,
+        tgt_ch: int,
+        input_reqs: Dict[int, Dict[int, int]],
+        actor_stages: Dict[int, int],
+        sorted_actors: Set[int],
+        max_batches: int = 8,
+    ) -> Optional[Tuple[int, List[Tuple]]]:
+        """Return (source_actor, [names...]) to consume next, or None."""
+        with self._lock:
+            idx = self._index.get((tgt_actor, tgt_ch))
+            if not idx:
+                return None
+            candidates = []  # (stage, ready_count, src_actor, [names])
+            for src_actor, chans in input_reqs.items():
+                if src_actor in sorted_actors:
+                    names = self._plan_sorted(idx, src_actor, tgt_actor, tgt_ch, chans, max_batches)
+                else:
+                    names = self._plan_contiguous(idx, src_actor, tgt_actor, tgt_ch, chans, max_batches)
+                if names:
+                    candidates.append(
+                        (actor_stages.get(src_actor, 0), -len(names), src_actor, names)
+                    )
+            if not candidates:
+                return None
+            candidates.sort()
+            min_stage = candidates[0][0]
+            candidates = [c for c in candidates if c[0] == min_stage]
+            _, _, src_actor, names = candidates[0]
+            return src_actor, names
+
+    def _plan_contiguous(self, idx, src_actor, tgt_actor, tgt_ch, chans, max_batches):
+        names = []
+        for src_ch, next_seq in chans.items():
+            have = idx.get((src_actor, src_ch), ())
+            s = next_seq
+            while s in have and len(names) < max_batches:
+                names.append((src_actor, src_ch, s, tgt_actor, src_actor, tgt_ch))
+                s += 1
+            if len(names) >= max_batches:
+                break
+        return names
+
+    def _plan_sorted(self, idx, src_actor, tgt_actor, tgt_ch, chans, max_batches):
+        """Global (seq, channel) order across all source channels; stop at the
+        first missing batch so ordering is never violated."""
+        names = []
+        frontier = dict(chans)  # channel -> next needed seq
+        channels = sorted(frontier.keys())
+        if not channels:
+            return names
+        seq = min(frontier.values())
+        while len(names) < max_batches:
+            progressed = False
+            for ch in channels:
+                if frontier[ch] != seq:
+                    continue
+                if seq in idx.get((src_actor, ch), ()):
+                    names.append((src_actor, ch, seq, tgt_actor, src_actor, tgt_ch))
+                    frontier[ch] = seq + 1
+                    progressed = True
+                    if len(names) >= max_batches:
+                        return names
+                else:
+                    return names  # hole: stop to preserve order
+            if not progressed:
+                seq += 1
+                if seq > max(frontier.values(), default=0) + 1_000_000:
+                    break
+        return names
+
+    def get(self, name: Tuple):
+        with self._lock:
+            return self._data.get(name)
+
+    def gc(self, names: Sequence[Tuple]) -> None:
+        with self._lock:
+            for name in names:
+                self._data.pop(name, None)
+                src_actor, src_ch, seq, tgt_actor, _, tgt_ch = name
+                chans = self._index.get((tgt_actor, tgt_ch))
+                if chans is not None:
+                    chans[(src_actor, src_ch)].discard(seq)
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._data)
+
+    def flights_info(self):
+        with self._lock:
+            return sorted(self._data.keys())

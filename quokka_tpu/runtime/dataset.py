@@ -1,0 +1,50 @@
+"""Result dataset: collects blocking-node outputs.
+
+Equivalent of the reference's ArrowDataset Ray actor + client Dataset handle
+(pyquokka/quokka_dataset.py:7,66) for the embedded runtime: outputs accumulate
+as host Arrow tables keyed by producing channel.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+import pyarrow as pa
+
+
+class ResultDataset:
+    def __init__(self, name: str = "result"):
+        self.name = name
+        self._lock = threading.Lock()
+        self._tables: Dict[int, List[pa.Table]] = defaultdict(list)
+
+    def append(self, channel: int, table: pa.Table) -> None:
+        with self._lock:
+            self._tables[channel].append(table)
+
+    def to_arrow(self) -> Optional[pa.Table]:
+        with self._lock:
+            tables = [t for ch in sorted(self._tables) for t in self._tables[ch]]
+        if not tables:
+            return None
+        # unify dictionary-encoded vs plain string columns across chunks
+        tables = [_decode_dicts(t) for t in tables]
+        return pa.concat_tables(tables, promote_options="permissive")
+
+    def to_df(self):
+        t = self.to_arrow()
+        return None if t is None else t.to_pandas()
+
+
+def _decode_dicts(t: pa.Table) -> pa.Table:
+    cols = []
+    changed = False
+    for c in t.columns:
+        if pa.types.is_dictionary(c.type):
+            cols.append(c.cast(c.type.value_type))
+            changed = True
+        else:
+            cols.append(c)
+    return pa.table(cols, names=t.column_names) if changed else t

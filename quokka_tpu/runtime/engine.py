@@ -1,0 +1,414 @@
+"""The embedded push-based runtime: TaskGraph + TaskManager + Coordinator.
+
+This single-process engine carries the reference's full runtime semantics —
+push-based pipelined execution, per-actor channels, partitioned shuffles,
+stage-gated build-before-probe scheduling, consumption-watermark backpressure
+(pyquokka/core.py exec/IO loops, coordinator.py stage advancement,
+quokka_runtime.py TaskGraph) — against the embedded ControlStore and an
+in-memory device BatchCache.  Multi-host deployment replaces the store with a
+served ControlStore and the cache with the gRPC data plane, without changing
+this scheduling logic.
+
+Key invariants preserved from the reference:
+- outputs of each (actor, channel) carry contiguous seq numbers; consumers
+  request contiguous runs per source channel (flight.py do_get semantics);
+- a source is exhausted for a consumer when its channel is in DST and the
+  consumer's next needed seq exceeds the source's last produced seq (LIT);
+- input generation throttles to at most `max_pipeline` batches ahead of the
+  slowest consumer (EWT watermark, core.py:919-925);
+- executors at stage s never run before every actor at stages < s is done
+  (coordinator.py:106-128).
+"""
+
+from __future__ import annotations
+
+import copy
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from quokka_tpu import config
+from quokka_tpu.expression import Expr
+from quokka_tpu.ops import bridge, kernels
+from quokka_tpu.ops.batch import DeviceBatch
+from quokka_tpu.ops.expr_compile import evaluate_predicate
+from quokka_tpu.runtime.cache import BatchCache
+from quokka_tpu.runtime.dataset import ResultDataset
+from quokka_tpu.runtime.tables import ControlStore
+from quokka_tpu.runtime.task import ExecutorTask, TapedInputTask
+from quokka_tpu.target_info import (
+    BroadcastPartitioner,
+    FunctionPartitioner,
+    HashPartitioner,
+    PassThroughPartitioner,
+    RangePartitioner,
+    TargetInfo,
+)
+
+
+class ActorInfo:
+    def __init__(self, actor_id, kind, channels, stage=0, sorted_actor=False):
+        self.id = actor_id
+        self.kind = kind  # 'input' | 'exec'
+        self.channels = channels
+        self.stage = stage
+        self.sorted_actor = sorted_actor
+        self.reader = None
+        self.executor_factory = None
+        self.targets: Dict[int, TargetInfo] = {}  # tgt_actor -> TargetInfo
+        self.source_streams: Dict[int, int] = {}  # src_actor -> stream_id
+        self.blocking_dataset: Optional[ResultDataset] = None
+        self.sorted_by: Optional[List[str]] = None
+
+
+class TaskGraph:
+    """Physical plan builder (quokka_runtime.py:18-392 equivalent)."""
+
+    def __init__(self, exec_config: Optional[dict] = None):
+        self.store = ControlStore()
+        self.cache = BatchCache()
+        self.exec_config = dict(config.DEFAULT_EXEC_CONFIG)
+        if exec_config:
+            self.exec_config.update(exec_config)
+        self.actors: Dict[int, ActorInfo] = {}
+        self._next_actor = 0
+
+    def _new_actor(self, kind, channels, stage, sorted_actor=False) -> ActorInfo:
+        info = ActorInfo(self._next_actor, kind, channels, stage, sorted_actor)
+        self.actors[self._next_actor] = info
+        self._next_actor += 1
+        return info
+
+    def new_input_reader_node(
+        self, reader, channels: int, stage: int = 0, sorted_by: Optional[List[str]] = None
+    ) -> int:
+        info = self._new_actor("input", channels, stage, sorted_actor=sorted_by is not None)
+        info.reader = reader
+        info.sorted_by = sorted_by
+        self.store.tset("FOT", info.id, reader)
+        tapes = reader.get_own_state(channels)
+        for ch in range(channels):
+            lineages = tapes.get(ch, [])
+            for seq, lineage in enumerate(lineages):
+                self.store.tset("LT", (info.id, ch, seq), lineage)
+            self.store.tset("LIT", (info.id, ch), len(lineages) - 1)
+            self.store.ntt_push(info.id, TapedInputTask(info.id, ch, list(range(len(lineages)))))
+        if info.sorted_actor:
+            self.store.sadd("SAT", info.id)
+        self.store.tset("AST", info.id, stage)
+        return info.id
+
+    def new_exec_node(
+        self,
+        executor_factory: Callable[[], object],
+        sources: Dict[int, Tuple[int, TargetInfo]],  # stream_id -> (src_actor, edge spec)
+        channels: int,
+        stage: int = 0,
+        blocking: bool = False,
+        sorted_actor: bool = False,
+    ) -> int:
+        # per-source routing state is keyed by src_actor, so two streams from
+        # the SAME actor (direct self-join / self-union) would collide; give
+        # each extra stream its own pass-through relay actor
+        seen_srcs = set()
+        deduped = {}
+        for stream_id in sorted(sources):
+            src_actor, tinfo = sources[stream_id]
+            if src_actor in seen_srcs:
+                src_actor = self._relay_actor(src_actor, stage)
+            seen_srcs.add(src_actor)
+            deduped[stream_id] = (src_actor, tinfo)
+        sources = deduped
+        info = self._new_actor("exec", channels, stage, sorted_actor)
+        info.executor_factory = executor_factory
+        self.store.tset("FOT", info.id, executor_factory)
+        self.store.tset("AST", info.id, stage)
+        if sorted_actor:
+            self.store.sadd("SAT", info.id)
+        if blocking:
+            info.blocking_dataset = ResultDataset(f"ds-{info.id}")
+        for stream_id, (src_actor, tinfo) in sources.items():
+            src = self.actors[src_actor]
+            src.targets[info.id] = tinfo
+            info.source_streams[src_actor] = stream_id
+            self.store.tset("PFT", (src_actor, info.id), tinfo)
+        for ch in range(channels):
+            reqs = {}
+            for stream_id, (src_actor, tinfo) in sources.items():
+                src = self.actors[src_actor]
+                reqs[src_actor] = {
+                    sch: 0
+                    for sch in range(src.channels)
+                    if _feeds(tinfo.partitioner, sch, ch, channels)
+                }
+            self.store.ntt_push(info.id, ExecutorTask(info.id, ch, 0, 0, reqs))
+        return info.id
+
+    def _relay_actor(self, src_actor: int, stage: int) -> int:
+        from quokka_tpu.executors.sql_execs import StorageExecutor
+        from quokka_tpu.target_info import PassThroughPartitioner
+
+        return self.new_exec_node(
+            StorageExecutor,
+            {0: (src_actor, TargetInfo(PassThroughPartitioner()))},
+            self.actors[src_actor].channels,
+            stage,
+        )
+
+    def run(self, max_batches: Optional[int] = None):
+        Engine(self).run(max_batches=max_batches)
+
+    def result(self, actor_id: int) -> ResultDataset:
+        return self.actors[actor_id].blocking_dataset
+
+
+def _feeds(partitioner, src_ch: int, tgt_ch: int, n_tgt: int) -> bool:
+    if isinstance(partitioner, PassThroughPartitioner):
+        return src_ch % n_tgt == tgt_ch
+    return True  # hash/broadcast/range/function: every source channel
+
+
+# ---------------------------------------------------------------------------
+
+
+class Engine:
+    """TaskManager + Coordinator for the embedded runtime."""
+
+    def __init__(self, graph: TaskGraph):
+        self.g = graph
+        self.store = graph.store
+        self.cache = graph.cache
+        self.max_batches = graph.exec_config.get("max_pipeline_batches", 8)
+        self.execs: Dict[Tuple[int, int], object] = {}
+        self._partition_fns: Dict[Tuple[int, int], Callable] = {}
+        for info in graph.actors.values():
+            if info.kind == "exec":
+                for ch in range(info.channels):
+                    self.execs[(info.id, ch)] = info.executor_factory()
+
+    # -- partition function lowering (quokka_runtime.py:215-312) ------------
+    def _partition_fn(self, src_actor: int, tgt_actor: int) -> Callable:
+        key = (src_actor, tgt_actor)
+        if key in self._partition_fns:
+            return self._partition_fns[key]
+        tinfo: TargetInfo = self.store.tget("PFT", key)
+        n_tgt = self.g.actors[tgt_actor].channels
+        part = tinfo.partitioner
+
+        def fn(batch: DeviceBatch, src_ch: int) -> Dict[int, DeviceBatch]:
+            if tinfo.predicate is not None:
+                batch = kernels.apply_mask(batch, evaluate_predicate(tinfo.predicate, batch))
+            for f in tinfo.batch_funcs:
+                batch = f(batch)
+                if batch is None:
+                    return {}
+            if isinstance(part, PassThroughPartitioner):
+                out = {src_ch % n_tgt: batch}
+            elif isinstance(part, BroadcastPartitioner):
+                out = {ch: batch for ch in range(n_tgt)}
+            elif isinstance(part, HashPartitioner):
+                if n_tgt == 1:
+                    out = {0: batch}
+                else:
+                    pids = kernels.partition_ids(batch, part.keys, n_tgt)
+                    out = dict(enumerate(kernels.split_by_partition(batch, pids, n_tgt)))
+            elif isinstance(part, RangePartitioner):
+                out = self._range_split(batch, part, n_tgt)
+            elif isinstance(part, FunctionPartitioner):
+                out = part.fn(batch, src_ch, n_tgt)
+            else:
+                raise NotImplementedError(type(part))
+            if tinfo.projection is not None:
+                out = {ch: b.select(list(tinfo.projection)) for ch, b in out.items()}
+            return out
+
+        self._partition_fns[key] = fn
+        return fn
+
+    def _range_split(self, batch, part: RangePartitioner, n_tgt: int):
+        import jax.numpy as jnp
+
+        col = batch.columns[part.key]
+        bounds = jnp.asarray(part.boundaries)
+        pids = jnp.searchsorted(bounds, col.data, side="right").astype(jnp.int32)
+        return dict(enumerate(kernels.split_by_partition(batch, pids, n_tgt)))
+
+    # -- push (core.py:276-376) ---------------------------------------------
+    def push(self, actor: int, channel: int, seq: int, batch: DeviceBatch) -> None:
+        info = self.g.actors[actor]
+        for tgt_actor in info.targets:
+            fn = self._partition_fn(actor, tgt_actor)
+            parts = fn(batch, channel)
+            for tgt_ch, part in parts.items():
+                name = (actor, channel, seq, tgt_actor, actor, tgt_ch)
+                self.cache.put(name, part)
+                with self.store.transaction():
+                    self.store.sadd("NOT", (actor, channel), name)
+                    self.store.tset("PT", name, (actor, channel))
+
+    # -- input task (core.py:824-965) ----------------------------------------
+    def handle_input_task(self, task: TapedInputTask) -> bool:
+        info = self.g.actors[task.actor]
+        seq = task.current_seq()
+        if seq is None:
+            self.store.sadd("DST", (task.actor, task.channel), "done")
+            return True
+        if self._throttled(info, task.channel, seq):
+            self.store.ntt_push(task.actor, task)
+            return False
+        lineage = self.store.tget("LT", (task.actor, task.channel, seq))
+        table = info.reader.execute(task.channel, lineage)
+        batch = bridge.arrow_to_device(table, sorted_by=info.sorted_by)
+        self.push(task.actor, task.channel, seq, batch)
+        with self.store.transaction():
+            self.store.sadd("GIT", (task.actor, task.channel), seq)
+        nxt = task.advance()
+        if nxt.tape:
+            self.store.ntt_push(task.actor, nxt)
+        else:
+            self.store.sadd("DST", (task.actor, task.channel), "done")
+        return True
+
+    def _throttled(self, info: ActorInfo, src_ch: int, seq: int) -> bool:
+        max_pipeline = self.g.exec_config["max_pipeline"]
+        if not info.targets:
+            return False
+        if not self.cache.puttable():
+            return True
+        watermark = None
+        for tgt_actor, tinfo in info.targets.items():
+            tgt = self.g.actors[tgt_actor]
+            for tgt_ch in range(tgt.channels):
+                if not _feeds(tinfo.partitioner, src_ch, tgt_ch, tgt.channels):
+                    continue
+                w = self.store.tget("EWT", (info.id, src_ch, tgt_actor, tgt_ch), -1)
+                watermark = w if watermark is None else min(watermark, w)
+        return watermark is not None and seq > watermark + max_pipeline
+
+    # -- exec task (core.py:484-700) -----------------------------------------
+    def handle_exec_task(self, task: ExecutorTask) -> bool:
+        info = self.g.actors[task.actor]
+        executor = self.execs[(task.actor, task.channel)]
+        # prune exhausted sources against DST/LIT; notify the executor so
+        # multi-stream operators can finalize a side (build completion)
+        out_seq = task.out_seq
+        for src in list(task.input_reqs):
+            chans = task.input_reqs[src]
+            for ch in list(chans):
+                if self.store.scontains("DST", (src, ch), "done"):
+                    last = self.store.tget("LIT", (src, ch), -1)
+                    if chans[ch] > last:
+                        del chans[ch]
+            if not chans:
+                del task.input_reqs[src]
+                extra = executor.source_done(info.source_streams[src], task.channel)
+                if extra is not None and extra.count_valid() > 0:
+                    self._emit(info, task.channel, out_seq, extra)
+                    out_seq += 1
+        task.out_seq = out_seq
+        if not task.input_reqs:
+            out = executor.done(task.channel)
+            if out is not None and out.count_valid() > 0:
+                self._emit(info, task.channel, out_seq, out)
+                out_seq += 1
+            with self.store.transaction():
+                self.store.tset("LIT", (task.actor, task.channel), out_seq - 1)
+                self.store.tset("EST", (task.actor, task.channel), task.state_seq)
+                self.store.sadd("DST", (task.actor, task.channel), "done")
+            return True
+        stages = dict(self.store.titems("AST"))
+        plan = self.cache.plan_get(
+            task.actor,
+            task.channel,
+            task.input_reqs,
+            stages,
+            self.store.smembers("SAT"),
+            max_batches=self.max_batches,
+        )
+        if plan is None:
+            self.store.ntt_push(task.actor, task)
+            return False
+        src_actor, names = plan
+        batches = [self.cache.get(n) for n in names]
+        stream_id = info.source_streams[src_actor]
+        out = executor.execute(batches, stream_id, task.channel)
+        out_seq = task.out_seq
+        if out is not None and out.count_valid() > 0:
+            self._emit(info, task.channel, out_seq, out)
+            out_seq += 1
+        consumed: Dict[int, Dict[int, int]] = {src_actor: {}}
+        for (sa, sch, seq, *_rest) in names:
+            consumed[sa][sch] = max(consumed[sa].get(sch, 0), seq + 1)
+        with self.store.transaction():
+            for sch, nxt in consumed[src_actor].items():
+                self.store.tset("EWT", (src_actor, sch, task.actor, task.channel), nxt - 1)
+        self.cache.gc(names)
+        self.store.ntt_push(task.actor, task.advance(consumed, out_seq))
+        return True
+
+    def _emit(self, info: ActorInfo, channel: int, seq: int, out: DeviceBatch) -> None:
+        if info.blocking_dataset is not None:
+            info.blocking_dataset.append(channel, bridge.device_to_arrow(out))
+        else:
+            self.push(info.id, channel, seq, out)
+
+    # -- coordinator loop (coordinator.py:106-165) ----------------------------
+    # Stage discipline follows the reference exactly: INPUT tasks only run when
+    # their actor's stage <= the current execution stage; EXEC tasks always run
+    # (their input requirements + the input gating enforce ordering,
+    # core.py:504 comment); the stage advances when no undone actor remains at
+    # the current stage.
+    def run(self, max_batches: Optional[int] = None, timeout: float = 3600.0) -> None:
+        if max_batches is not None:
+            self.max_batches = max_batches
+        actors = sorted(self.g.actors.values(), key=lambda a: (a.stage, a.id))
+        stages = sorted({a.stage for a in actors})
+        stage_idx = 0
+        t0 = time.time()
+        while True:
+            if time.time() - t0 > timeout:
+                raise TimeoutError(
+                    "engine run exceeded timeout; pending tasks: "
+                    f"{self.store.ntt_total()}"
+                )
+            current = stages[stage_idx]
+            progress = False
+            for info in actors:
+                if info.kind == "input" and info.stage > current:
+                    continue
+                task = self.store.ntt_pop(info.id)
+                if task is None:
+                    continue
+                if task.name == "input":
+                    progress |= self.handle_input_task(task)
+                else:
+                    progress |= self.handle_exec_task(task)
+            if self._all_done(actors):
+                return
+            # advance when nothing undone remains at the current stage
+            while stage_idx < len(stages) - 1 and not self._stage_undone(
+                actors, stages[stage_idx]
+            ):
+                stage_idx += 1
+                progress = True
+            if not progress:
+                raise RuntimeError(
+                    "engine stalled: no task progressed and the stage cannot "
+                    f"advance (stage={stages[stage_idx]}, "
+                    f"pending={self.store.ntt_total()})"
+                )
+
+    def _stage_undone(self, actors, stage) -> bool:
+        for info in actors:
+            if info.stage != stage:
+                continue
+            for ch in range(info.channels):
+                if not self.store.scontains("DST", (info.id, ch), "done"):
+                    return True
+        return False
+
+    def _all_done(self, actors) -> bool:
+        for info in actors:
+            for ch in range(info.channels):
+                if not self.store.scontains("DST", (info.id, ch), "done"):
+                    return False
+        return True

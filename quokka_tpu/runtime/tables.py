@@ -1,0 +1,147 @@
+"""Control-plane tables.
+
+The reference keeps all scheduler state in 17 prefix-namespaced Redis tables
+with MULTI/EXEC transactions (pyquokka/tables.py:8-339, fault-tolerance.md).
+quokka-tpu keeps the same table taxonomy — it is the contract the recovery
+protocol reasons over — behind a ControlStore interface.  The default
+implementation is an embedded in-process store with a global lock providing the
+same serialized-transaction discipline; a networked server can implement the
+same interface later for multi-host deployments without touching the runtime.
+
+Table map (name -> role, reference location in pyquokka/tables.py):
+  CT   cemetery: objects safe to GC                      (103)
+  NOT  node -> object names it must keep                  (121)
+  PT   object name -> producing node                      (138)
+  NTT  (node) -> pending task list                        (152)
+  GIT  generated input seqs per (actor, channel)          (170)
+  LT   lineage: (actor, channel, seq) -> lineage payload  (187)
+  DST  done seqs per (actor, channel)                     (200)
+  LCT  last checkpoint per (actor, channel)               (214)
+  EST  executor state seq per (actor, channel)            (230)
+  CLT  (actor, channel) -> worker/node location           (243)
+  FOT  actor -> pickled reader/executor object            (257)
+  IRT  input requirements at checkpoints                  (266)
+  SAT  set of sorted (order-preserving) actors            (278)
+  PFT  (source actor, target actor) -> partition spec     (292)
+  AST  actor -> execution stage                           (305)
+  LIT  last input seq per (actor, channel)                (318)
+  EWT  consumption watermark per (actor, channel)         (332)
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict, deque
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional, Tuple
+
+TABLE_NAMES = (
+    "CT", "NOT", "PT", "NTT", "GIT", "LT", "DST", "LCT", "EST", "CLT",
+    "FOT", "IRT", "SAT", "PFT", "AST", "LIT", "EWT",
+)
+
+
+class ControlStore:
+    """Embedded transactional KV/table store (single leader semantics)."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self.kv: Dict[str, Any] = {}
+        self.tables: Dict[str, Dict] = {name: {} for name in TABLE_NAMES}
+        # NTT values are deques of tasks
+        self.tables["NTT"] = defaultdict(deque)
+        # set-valued tables
+        self.tables["CT"] = set()
+        self.tables["SAT"] = set()
+        self.tables["NOT"] = defaultdict(set)
+        self.tables["DST"] = defaultdict(set)
+        self.tables["GIT"] = defaultdict(set)
+
+    @contextmanager
+    def transaction(self):
+        """All mutations inside happen atomically w.r.t. other transactions.
+        (Serialized by a single lock — same guarantee Redis MULTI/EXEC gives
+        the reference's commit paths, core.py:553,692.)"""
+        with self._lock:
+            yield self
+
+    # -- generic kv ----------------------------------------------------------
+    def set(self, key: str, value):
+        with self._lock:
+            self.kv[key] = value
+
+    def get(self, key: str, default=None):
+        with self._lock:
+            return self.kv.get(key, default)
+
+    # -- NTT: task queues ----------------------------------------------------
+    def ntt_push(self, node: Tuple, task):
+        with self._lock:
+            self.tables["NTT"][node].append(task)
+
+    def ntt_pop(self, node: Tuple):
+        with self._lock:
+            q = self.tables["NTT"][node]
+            return q.popleft() if q else None
+
+    def ntt_peek_all(self, node: Tuple) -> List:
+        with self._lock:
+            return list(self.tables["NTT"][node])
+
+    def ntt_len(self, node: Tuple) -> int:
+        with self._lock:
+            return len(self.tables["NTT"][node])
+
+    def ntt_total(self) -> int:
+        with self._lock:
+            return sum(len(q) for q in self.tables["NTT"].values())
+
+    # -- simple keyed tables -------------------------------------------------
+    def tset(self, table: str, key, value):
+        with self._lock:
+            self.tables[table][key] = value
+
+    def tget(self, table: str, key, default=None):
+        with self._lock:
+            return self.tables[table].get(key, default)
+
+    def titems(self, table: str):
+        with self._lock:
+            return list(self.tables[table].items())
+
+    # -- set-valued tables ---------------------------------------------------
+    def sadd(self, table: str, key, value=None):
+        with self._lock:
+            t = self.tables[table]
+            if isinstance(t, set):
+                t.add(key)
+            else:
+                t[key].add(value)
+
+    def smembers(self, table: str, key=None):
+        with self._lock:
+            t = self.tables[table]
+            if isinstance(t, set):
+                return set(t)
+            return set(t.get(key, ()))
+
+    def scontains(self, table: str, key, value=None) -> bool:
+        with self._lock:
+            t = self.tables[table]
+            if isinstance(t, set):
+                return key in t
+            return value in t.get(key, ())
+
+    # -- debug ---------------------------------------------------------------
+    def dump(self) -> Dict[str, Any]:
+        """Snapshot of all control tables (the debugger.py:6-41 equivalent)."""
+        with self._lock:
+            out = {"kv": dict(self.kv)}
+            for name, t in self.tables.items():
+                if isinstance(t, set):
+                    out[name] = set(t)
+                elif name == "NTT":
+                    out[name] = {k: list(v) for k, v in t.items()}
+                else:
+                    out[name] = dict(t)
+            return out

@@ -1,0 +1,77 @@
+"""Task types — the unit of scheduling.
+
+Mirrors the reference taxonomy (pyquokka/task.py:47-172): TapedInputTask reads
+one lineage entry per step; ExecutorTask advances an operator channel one
+input-batch-set at a time; Taped variants replay a recorded tape during
+recovery.  Object names are 6-tuples
+(source_actor, source_channel, seq, target_actor, partition_fn, target_channel)
+— the recovery granularity (pyquokka/task.py:5-40).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+
+def object_name(src_actor, src_ch, seq, tgt_actor, pfn, tgt_ch) -> Tuple:
+    return (src_actor, src_ch, seq, tgt_actor, pfn, tgt_ch)
+
+
+@dataclasses.dataclass
+class TapedInputTask:
+    actor: int
+    channel: int
+    tape: List[int]  # remaining seq numbers to generate, in order
+    name = "input"
+
+    def current_seq(self) -> Optional[int]:
+        return self.tape[0] if self.tape else None
+
+    def advance(self) -> "TapedInputTask":
+        return TapedInputTask(self.actor, self.channel, self.tape[1:])
+
+
+@dataclasses.dataclass
+class ExecutorTask:
+    actor: int
+    channel: int
+    state_seq: int
+    out_seq: int
+    # {source_actor: {source_channel: next_seq_needed}}
+    input_reqs: Dict[int, Dict[int, int]]
+    name = "exec"
+
+    def advance(self, consumed: Dict[int, Dict[int, int]], new_out_seq: int) -> "ExecutorTask":
+        reqs = {a: dict(chs) for a, chs in self.input_reqs.items()}
+        for a, chs in consumed.items():
+            for ch, nxt in chs.items():
+                reqs[a][ch] = nxt
+        return ExecutorTask(self.actor, self.channel, self.state_seq + 1, new_out_seq, reqs)
+
+    def drop_source(self, actor: int) -> None:
+        self.input_reqs.pop(actor, None)
+
+
+@dataclasses.dataclass
+class TapedExecutorTask:
+    """Replay variant: re-run an executor channel following a recorded input
+    tape up to last_state_seq, then convert back to a live ExecutorTask."""
+
+    actor: int
+    channel: int
+    state_seq: int
+    out_seq: int
+    last_state_seq: int
+    input_reqs: Dict[int, Dict[int, int]]
+    name = "exectape"
+
+
+@dataclasses.dataclass
+class ReplayTask:
+    """Re-push spilled post-partition objects (HBQ) to their targets."""
+
+    actor: int
+    channel: int
+    replay_specs: List[Tuple]  # object names to re-push
+    name = "replay"

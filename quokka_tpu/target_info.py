@@ -1,0 +1,61 @@
+"""Per-edge routing spec: how one operator's output reaches another.
+
+Equivalent of the reference's TargetInfo + Partitioner taxonomy
+(pyquokka/target_info.py:4-72).  A TargetInfo hangs on every logical-plan edge
+and carries: the partitioner, a post-operator predicate, a projection, and
+batch functions folded in by the optimizer.  At lowering time the runtime turns
+it into a concrete device partition function (predicate mask -> batch_funcs ->
+partition -> projection, same order as pyquokka/core.py:300-313).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, List, Optional, Sequence
+
+from quokka_tpu.expression import Expr
+
+
+class Partitioner:
+    pass
+
+
+@dataclasses.dataclass
+class PassThroughPartitioner(Partitioner):
+    """Source channel i feeds target channel i % n (no data movement when
+    channel counts match)."""
+
+
+@dataclasses.dataclass
+class BroadcastPartitioner(Partitioner):
+    """Every batch goes to every target channel."""
+
+
+@dataclasses.dataclass
+class HashPartitioner(Partitioner):
+    keys: List[str] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class RangePartitioner(Partitioner):
+    key: str = ""
+    boundaries: List = dataclasses.field(default_factory=list)  # n-1 split points
+
+
+@dataclasses.dataclass
+class FunctionPartitioner(Partitioner):
+    fn: Optional[Callable] = None  # fn(batch, src_channel, num_target_channels) -> {ch: batch}
+
+
+@dataclasses.dataclass
+class TargetInfo:
+    partitioner: Partitioner
+    predicate: Optional[Expr] = None
+    projection: Optional[Sequence[str]] = None
+    batch_funcs: List[Callable] = dataclasses.field(default_factory=list)
+
+    def and_predicate(self, pred: Expr) -> "TargetInfo":
+        from quokka_tpu.expression import conjoin
+
+        newp = pred if self.predicate is None else conjoin([self.predicate, pred])
+        return TargetInfo(self.partitioner, newp, self.projection, list(self.batch_funcs))

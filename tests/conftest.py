@@ -4,14 +4,20 @@ initializes (hence top-of-module, before any quokka_tpu import)."""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax
 
+# The axon sitecustomize forces the TPU platform programmatically, overriding
+# the env var — force CPU back before any backend initializes.
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+
+assert jax.default_backend() == "cpu", jax.devices()
+assert jax.device_count() == 8, jax.devices()
 
 import numpy as np
 import pandas as pd

@@ -1,0 +1,234 @@
+"""End-to-end DataStream API tests through the full runtime (logical plan ->
+TaskGraph -> push engine), pandas as oracle."""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from quokka_tpu import QuokkaContext, col, date, lit
+
+from conftest import make_table
+
+
+@pytest.fixture
+def ctx():
+    return QuokkaContext(io_channels=2, exec_channels=2)
+
+
+@pytest.fixture
+def stream(ctx, table):
+    return ctx.from_arrow(table)
+
+
+def sorted_eq(got: pd.DataFrame, exp: pd.DataFrame, by=None, rtol=1e-9):
+    by = by or list(exp.columns)
+    got = got.sort_values(by).reset_index(drop=True)[list(exp.columns)]
+    exp = exp.sort_values(by).reset_index(drop=True)
+    pd.testing.assert_frame_equal(got, exp, check_dtype=False, rtol=rtol)
+
+
+class TestBasics:
+    def test_collect_roundtrip(self, stream, pdf):
+        got = stream.collect()
+        sorted_eq(got, pdf, by=["k", "v"])
+
+    def test_filter_expr(self, stream, pdf):
+        got = stream.filter(col("q") > 25).collect()
+        sorted_eq(got, pdf[pdf.q > 25], by=["k", "v"])
+
+    def test_filter_sql(self, stream, pdf):
+        got = stream.filter_sql("q > 25 and s = 'apple'").collect()
+        sorted_eq(got, pdf[(pdf.q > 25) & (pdf.s == "apple")], by=["k", "v"])
+
+    def test_select_drop(self, stream, pdf):
+        got = stream.select(["k", "v"]).collect()
+        sorted_eq(got, pdf[["k", "v"]], by=["k", "v"])
+        got = stream.drop(["s", "d"]).collect()
+        assert set(got.columns) == {"k", "v", "q"}
+
+    def test_with_columns(self, stream, pdf):
+        got = stream.with_columns({"z": col("v") * 2 + col("q")}).collect()
+        exp = pdf.assign(z=pdf.v * 2 + pdf.q)
+        sorted_eq(got, exp, by=["k", "v"])
+
+    def test_with_columns_sql(self, stream, pdf):
+        got = stream.with_columns_sql("v * 2 as twice, q + 1 as qq").collect()
+        exp = pdf.assign(twice=pdf.v * 2, qq=pdf.q + 1)
+        sorted_eq(got, exp, by=["k", "v"])
+
+    def test_rename(self, stream, pdf):
+        got = stream.rename({"k": "key"}).collect()
+        assert "key" in got.columns and "k" not in got.columns
+
+    def test_count(self, stream, pdf):
+        assert stream.count() == len(pdf)
+
+    def test_distinct(self, stream, pdf):
+        got = stream.select(["k", "s"]).distinct().collect()
+        exp = pdf[["k", "s"]].drop_duplicates()
+        assert len(got) == len(exp)
+
+    def test_sort(self, stream, pdf):
+        got = stream.sort(["k", "v"], [False, True]).collect()
+        exp = pdf.sort_values(["k", "v"], ascending=[True, False]).reset_index(drop=True)
+        pd.testing.assert_frame_equal(got[exp.columns.tolist()], exp, check_dtype=False)
+
+    def test_top_k(self, stream, pdf):
+        got = stream.top_k(["v"], 5, [True]).collect()
+        np.testing.assert_allclose(got.v.to_numpy(), pdf.v.nlargest(5).to_numpy())
+
+    def test_head(self, stream, pdf):
+        got = stream.head(17).collect()
+        assert len(got) == 17
+
+    def test_union(self, ctx, table, pdf):
+        s1 = ctx.from_arrow(table)
+        s2 = ctx.from_arrow(table)
+        got = s1.union(s2).count()
+        assert got == 2 * len(pdf)
+
+    def test_transform_udf(self, stream, pdf):
+        got = stream.transform(
+            lambda df: df[df.q > 40][["k", "q"]], new_schema=["k", "q"]
+        ).collect()
+        sorted_eq(got, pdf[pdf.q > 40][["k", "q"]], by=["k", "q"])
+
+    def test_explain_runs(self, stream):
+        txt = stream.filter(col("q") > 3).explain()
+        assert "Filter" in txt and "Source" in txt
+
+
+class TestAggregations:
+    def test_groupby_agg_dict(self, stream, pdf):
+        got = stream.groupby("k").agg({"v": ["sum", "max"], "*": "count"}).collect()
+        exp = (
+            pdf.groupby("k")
+            .agg(v_sum=("v", "sum"), v_max=("v", "max"), count=("v", "size"))
+            .reset_index()
+        )
+        sorted_eq(got, exp, by=["k"])
+
+    def test_groupby_agg_sql(self, stream, pdf):
+        got = (
+            stream.groupby(["k", "s"])
+            .agg_sql("sum(v) as sv, avg(q) as aq, count(*) as n")
+            .collect()
+        )
+        exp = (
+            pdf.groupby(["k", "s"])
+            .agg(sv=("v", "sum"), aq=("q", "mean"), n=("v", "size"))
+            .reset_index()
+        )
+        sorted_eq(got, exp, by=["k", "s"])
+
+    def test_global_agg(self, stream, pdf):
+        got = stream.agg_sql("sum(v) as sv, count(*) as n, min(q) as mq").collect()
+        assert len(got) == 1
+        np.testing.assert_allclose(got.sv[0], pdf.v.sum())
+        assert got.n[0] == len(pdf)
+        assert got.mq[0] == pdf.q.min()
+
+    def test_sum_shortcut(self, stream, pdf):
+        got = stream.sum("q").collect()
+        assert got.q_sum[0] == pdf.q.sum()
+
+    def test_count_distinct(self, stream, pdf):
+        got = stream.count_distinct("s").collect()
+        assert got["count"][0] == pdf.s.nunique()
+
+
+class TestJoins:
+    def test_inner_join(self, ctx):
+        r = np.random.default_rng(3)
+        left = pa.table(
+            {"key": r.integers(0, 40, 500).astype(np.int64), "x": r.normal(size=500)}
+        )
+        right = pa.table(
+            {"key": np.arange(0, 30, dtype=np.int64), "y": r.normal(size=30)}
+        )
+        got = ctx.from_arrow(left).join(ctx.from_arrow(right), on="key").collect()
+        exp = left.to_pandas().merge(right.to_pandas(), on="key", how="inner")
+        sorted_eq(got, exp, by=["key", "x"])
+
+    def test_join_left_right_on_and_suffix(self, ctx):
+        r = np.random.default_rng(4)
+        left = pa.table(
+            {"a": r.integers(0, 20, 200).astype(np.int64), "x": r.normal(size=200)}
+        )
+        right = pa.table(
+            {"b": np.arange(0, 20, dtype=np.int64), "x": r.normal(size=20)}
+        )
+        got = (
+            ctx.from_arrow(left)
+            .join(ctx.from_arrow(right), left_on="a", right_on="b", suffix="_r")
+            .collect()
+        )
+        exp = (
+            left.to_pandas()
+            .merge(right.to_pandas(), left_on="a", right_on="b", suffixes=("", "_r"))
+            .drop(columns=["b"])
+        )
+        sorted_eq(got, exp, by=["a", "x"])
+
+    def test_semi_anti(self, ctx):
+        r = np.random.default_rng(5)
+        left = pa.table({"key": r.integers(0, 50, 300).astype(np.int64)})
+        right = pa.table({"key": np.arange(0, 25, dtype=np.int64)})
+        ldf = left.to_pandas()
+        semi = ctx.from_arrow(left).join(ctx.from_arrow(right), on="key", how="semi").count()
+        anti = ctx.from_arrow(left).join(ctx.from_arrow(right), on="key", how="anti").count()
+        assert semi == int(ldf.key.isin(range(25)).sum())
+        assert anti == int((~ldf.key.isin(range(25))).sum())
+
+    def test_multi_batch_join(self, ctx):
+        # force multiple input batches through small reader batch size
+        r = np.random.default_rng(6)
+        n = 5000
+        left = pa.table(
+            {"key": r.integers(0, 500, n).astype(np.int64), "x": r.normal(size=n)}
+        )
+        right = pa.table(
+            {"key": np.arange(0, 400, dtype=np.int64), "y": r.normal(size=400)}
+        )
+        from quokka_tpu.dataset.readers import InputArrowDataset
+
+        ls = ctx.read_dataset(InputArrowDataset(left, batch_rows=512))
+        rs = ctx.read_dataset(InputArrowDataset(right, batch_rows=128))
+        got = ls.join(rs, on="key").collect()
+        exp = left.to_pandas().merge(right.to_pandas(), on="key")
+        sorted_eq(got, exp, by=["key", "x"])
+
+    def test_broadcast_join(self, ctx):
+        r = np.random.default_rng(7)
+        left = pa.table(
+            {"key": r.integers(0, 30, 400).astype(np.int64), "x": r.normal(size=400)}
+        )
+        right = pa.table({"key": np.arange(0, 30, dtype=np.int64), "y": r.normal(size=30)})
+        got = ctx.from_arrow(left).broadcast_join(ctx.from_arrow(right), on="key").collect()
+        exp = left.to_pandas().merge(right.to_pandas(), on="key")
+        sorted_eq(got, exp, by=["key", "x"])
+
+    def test_join_then_groupby(self, ctx):
+        r = np.random.default_rng(8)
+        left = pa.table(
+            {"key": r.integers(0, 10, 1000).astype(np.int64), "x": r.normal(size=1000)}
+        )
+        right = pa.table(
+            {"key": np.arange(0, 10, dtype=np.int64), "grp": [f"g{i%3}" for i in range(10)]}
+        )
+        got = (
+            ctx.from_arrow(left)
+            .join(ctx.from_arrow(right), on="key")
+            .groupby("grp")
+            .agg_sql("sum(x) as sx, count(*) as n")
+            .collect()
+        )
+        exp = (
+            left.to_pandas()
+            .merge(right.to_pandas(), on="key")
+            .groupby("grp")
+            .agg(sx=("x", "sum"), n=("x", "size"))
+            .reset_index()
+        )
+        sorted_eq(got, exp, by=["grp"])

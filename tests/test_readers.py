@@ -1,0 +1,125 @@
+"""Reader tests: byte-range boundary ownership, schema inference, pushdown."""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from quokka_tpu import QuokkaContext
+from quokka_tpu.dataset.readers import (
+    InputCSVDataset,
+    InputJSONDataset,
+    InputParquetDataset,
+    _read_line_range,
+)
+from quokka_tpu.expression import col, date
+
+
+def read_all(reader, channels=3):
+    state = reader.get_own_state(channels)
+    tables = []
+    for ch, lineages in state.items():
+        for lin in lineages:
+            t = reader.execute(ch, lin)
+            if t.num_rows:
+                tables.append(t)
+    return pa.concat_tables(tables) if tables else reader.schema.empty_table()
+
+
+class TestLineRangeOwnership:
+    def test_every_row_read_exactly_once_all_strides(self, tmp_path):
+        p = str(tmp_path / "t.csv")
+        lines = [f"{i},{i*i}\n" for i in range(100)]
+        with open(p, "w") as f:
+            f.write("a,b\n")
+            f.writelines(lines)
+        size = os.path.getsize(p)
+        # exhaustively test every stride incl. ones landing exactly on newlines
+        for stride in list(range(3, 40)) + [size - 1, size, size + 10]:
+            r = InputCSVDataset(p, stride=stride)
+            got = read_all(r).to_pandas().sort_values("a").reset_index(drop=True)
+            assert len(got) == 100, f"stride {stride}: {len(got)} rows"
+            assert got.a.tolist() == list(range(100)), f"stride {stride}"
+
+    def test_boundary_exactly_on_newline(self, tmp_path):
+        p = str(tmp_path / "t2.csv")
+        with open(p, "w") as f:
+            f.write("a\n")  # header: 2 bytes
+            for i in range(10):
+                f.write(f"{i}\n")  # 2 bytes each
+        # stride 4 puts boundaries exactly on newlines
+        r = InputCSVDataset(p, stride=4)
+        got = read_all(r).to_pandas()
+        assert sorted(got.a.tolist()) == list(range(10))
+
+    def test_json_ranges(self, tmp_path):
+        p = str(tmp_path / "t.jsonl")
+        with open(p, "w") as f:
+            for i in range(50):
+                f.write('{"x": %d, "s": "v%d"}\n' % (i, i))
+        for stride in (7, 16, 21, 64, 10_000):
+            r = InputJSONDataset(p, stride=stride)
+            got = read_all(r).to_pandas()
+            assert sorted(got.x.tolist()) == list(range(50)), f"stride {stride}"
+
+
+class TestCSV:
+    def test_headerless_with_schema(self, tmp_path):
+        p = str(tmp_path / "nh.csv")
+        with open(p, "w") as f:
+            for i in range(20):
+                f.write(f"{i},{i*2}\n")
+        r = InputCSVDataset(p, schema=["x", "y"], has_header=False, stride=11)
+        got = read_all(r).to_pandas().sort_values("x").reset_index(drop=True)
+        assert got.y.tolist() == [2 * i for i in range(20)]
+
+    def test_read_csv_through_engine(self, tmp_path):
+        p = str(tmp_path / "e.csv")
+        df = pd.DataFrame({"k": np.arange(50) % 5, "v": np.arange(50) * 1.5})
+        df.to_csv(p, index=False)
+        ctx = QuokkaContext()
+        got = ctx.read_csv(p).groupby("k").agg_sql("sum(v) as sv").collect()
+        exp = df.groupby("k").v.sum().reset_index(name="sv")
+        got = got.sort_values("k").reset_index(drop=True)
+        pd.testing.assert_frame_equal(got, exp, check_dtype=False)
+
+
+class TestParquetPushdown:
+    def test_rowgroup_pruning(self, tmp_path):
+        p = str(tmp_path / "p.parquet")
+        t = pa.table({"x": np.arange(10_000, dtype=np.int64), "y": np.ones(10_000)})
+        pq.write_table(t, p, row_group_size=1000)
+        pruned = InputParquetDataset(p, predicate=(col("x") > 8500))
+        state = pruned.get_own_state(1)
+        n_pieces = sum(len(v) for v in state.values())
+        assert n_pieces == 2  # only row groups [8000,9000) and [9000,10000)
+        full = InputParquetDataset(p)
+        assert sum(len(v) for v in full.get_own_state(1).values()) == 10
+
+    def test_columns_projection(self, tmp_path):
+        p = str(tmp_path / "c.parquet")
+        pq.write_table(pa.table({"a": [1, 2], "b": [3, 4], "c": [5, 6]}), p)
+        r = InputParquetDataset(p, columns=["a", "c"])
+        got = read_all(r, channels=1)
+        assert got.column_names == ["a", "c"]
+
+
+class TestSelfJoin:
+    def test_direct_self_join(self):
+        ctx = QuokkaContext()
+        t = pa.table({"k": np.arange(10, dtype=np.int64), "v": np.arange(10) * 1.0})
+        s = ctx.from_arrow(t)
+        got = s.join(s, on="k", suffix="_r").collect()
+        assert len(got) == 10
+        np.testing.assert_allclose(
+            got.sort_values("k").v_r.to_numpy(), np.arange(10) * 1.0
+        )
+
+    def test_self_union(self):
+        ctx = QuokkaContext()
+        t = pa.table({"k": np.arange(10, dtype=np.int64)})
+        s = ctx.from_arrow(t)
+        assert s.union(s).count() == 20

@@ -1,0 +1,265 @@
+"""TPC-H correctness tests (the reference's oracle strategy, SURVEY.md section 4,
+with pandas instead of DuckDB as ground truth).  Queries follow the shapes in
+the reference's apps/tpc-h/tpch.py; data comes from the mini-dbgen in
+tpch_data.py, written to Parquet and read through the full engine."""
+
+import datetime
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from quokka_tpu import QuokkaContext, col, date
+
+import tpch_data
+
+
+@pytest.fixture(scope="module")
+def env(tmp_path_factory):
+    root = tmp_path_factory.mktemp("tpch")
+    tables = tpch_data.generate(sf=0.003, seed=7)
+    paths = tpch_data.write_parquet_dir(tables, str(root))
+    ctx = QuokkaContext(io_channels=2, exec_channels=2)
+    dfs = {k: t.to_pandas() for k, t in tables.items()}
+    return ctx, paths, dfs
+
+
+def streams(env):
+    ctx, paths, _ = env
+    return {name: ctx.read_parquet(p) for name, p in paths.items()}
+
+
+def sorted_eq(got, exp, by, rtol=1e-8):
+    got = got.sort_values(by).reset_index(drop=True)[list(exp.columns)]
+    exp = exp.sort_values(by).reset_index(drop=True)
+    pd.testing.assert_frame_equal(got, exp, check_dtype=False, rtol=rtol)
+
+
+def test_q1(env):
+    ctx, paths, dfs = env
+    li = streams(env)["lineitem"]
+    got = (
+        li.filter_sql("l_shipdate <= date '1998-12-01' - interval '90' day")
+        .groupby(["l_returnflag", "l_linestatus"])
+        .agg_sql(
+            "sum(l_quantity) as sum_qty, "
+            "sum(l_extendedprice) as sum_base_price, "
+            "sum(l_extendedprice * (1 - l_discount)) as sum_disc_price, "
+            "sum(l_extendedprice * (1 - l_discount) * (1 + l_tax)) as sum_charge, "
+            "avg(l_quantity) as avg_qty, "
+            "avg(l_extendedprice) as avg_price, "
+            "avg(l_discount) as avg_disc, "
+            "count(*) as count_order"
+        )
+        .collect()
+    )
+    l = dfs["lineitem"]
+    f = l[l.l_shipdate <= datetime.date(1998, 9, 2)]
+    exp = (
+        f.groupby(["l_returnflag", "l_linestatus"])
+        .apply(
+            lambda d: pd.Series(
+                {
+                    "sum_qty": d.l_quantity.sum(),
+                    "sum_base_price": d.l_extendedprice.sum(),
+                    "sum_disc_price": (d.l_extendedprice * (1 - d.l_discount)).sum(),
+                    "sum_charge": (
+                        d.l_extendedprice * (1 - d.l_discount) * (1 + d.l_tax)
+                    ).sum(),
+                    "avg_qty": d.l_quantity.mean(),
+                    "avg_price": d.l_extendedprice.mean(),
+                    "avg_disc": d.l_discount.mean(),
+                    "count_order": len(d),
+                }
+            ),
+            include_groups=False,
+        )
+        .reset_index()
+    )
+    sorted_eq(got, exp, by=["l_returnflag", "l_linestatus"])
+
+
+def test_q6(env):
+    ctx, paths, dfs = env
+    li = streams(env)["lineitem"]
+    got = (
+        li.filter_sql(
+            "l_shipdate >= date '1994-01-01' and l_shipdate < date '1995-01-01' "
+            "and l_discount between 0.05 and 0.07 and l_quantity < 24"
+        )
+        .agg_sql("sum(l_extendedprice * l_discount) as revenue")
+        .collect()
+    )
+    l = dfs["lineitem"]
+    f = l[
+        (l.l_shipdate >= datetime.date(1994, 1, 1))
+        & (l.l_shipdate < datetime.date(1995, 1, 1))
+        & (l.l_discount >= 0.05)
+        & (l.l_discount <= 0.07)
+        & (l.l_quantity < 24)
+    ]
+    assert len(f) > 0
+    np.testing.assert_allclose(
+        got.revenue[0], (f.l_extendedprice * f.l_discount).sum(), rtol=1e-9
+    )
+
+
+def test_q3(env):
+    ctx, paths, dfs = env
+    s = streams(env)
+    d = date("1995-03-15")
+    got = (
+        s["lineitem"]
+        .filter(col("l_shipdate") > d)
+        .join(
+            s["orders"].filter(col("o_orderdate") < d),
+            left_on="l_orderkey",
+            right_on="o_orderkey",
+        )
+        .join(
+            s["customer"].filter(col("c_mktsegment") == "BUILDING"),
+            left_on="o_custkey",
+            right_on="c_custkey",
+        )
+        .groupby(["l_orderkey", "o_orderdate", "o_shippriority"])
+        .agg_sql("sum(l_extendedprice * (1 - l_discount)) as revenue")
+        .top_k(["revenue"], 10, [True])
+        .collect()
+    )
+    l, o, c = dfs["lineitem"], dfs["orders"], dfs["customer"]
+    cut = datetime.date(1995, 3, 15)
+    merged = (
+        l[l.l_shipdate > cut]
+        .merge(o[o.o_orderdate < cut], left_on="l_orderkey", right_on="o_orderkey")
+        .merge(c[c.c_mktsegment == "BUILDING"], left_on="o_custkey", right_on="c_custkey")
+    )
+    merged["rev"] = merged.l_extendedprice * (1 - merged.l_discount)
+    exp = (
+        merged.groupby(["l_orderkey", "o_orderdate", "o_shippriority"])
+        .rev.sum()
+        .reset_index(name="revenue")
+        .nlargest(10, "revenue")
+    )
+    assert len(exp) > 0
+    got = got.sort_values("revenue", ascending=False).reset_index(drop=True)
+    exp = exp.sort_values("revenue", ascending=False).reset_index(drop=True)
+    np.testing.assert_allclose(got.revenue.to_numpy(), exp.revenue.to_numpy(), rtol=1e-9)
+
+
+def test_q5(env):
+    ctx, paths, dfs = env
+    s = streams(env)
+    got = (
+        s["lineitem"]
+        .join(
+            s["orders"].filter_sql(
+                "o_orderdate >= date '1994-01-01' and o_orderdate < date '1995-01-01'"
+            ),
+            left_on="l_orderkey",
+            right_on="o_orderkey",
+        )
+        .join(s["customer"], left_on="o_custkey", right_on="c_custkey")
+        .join(
+            s["supplier"],
+            left_on=["l_suppkey", "c_nationkey"],
+            right_on=["s_suppkey", "s_nationkey"],
+        )
+        .join(s["nation"], left_on="c_nationkey", right_on="n_nationkey")
+        .join(
+            s["region"].filter(col("r_name") == "ASIA"),
+            left_on="n_regionkey",
+            right_on="r_regionkey",
+        )
+        .groupby("n_name")
+        .agg_sql("sum(l_extendedprice * (1 - l_discount)) as revenue")
+        .collect()
+    )
+    l, o, c = dfs["lineitem"], dfs["orders"], dfs["customer"]
+    su, n, r = dfs["supplier"], dfs["nation"], dfs["region"]
+    of = o[
+        (o.o_orderdate >= datetime.date(1994, 1, 1))
+        & (o.o_orderdate < datetime.date(1995, 1, 1))
+    ]
+    m = (
+        l.merge(of, left_on="l_orderkey", right_on="o_orderkey")
+        .merge(c, left_on="o_custkey", right_on="c_custkey")
+        .merge(
+            su,
+            left_on=["l_suppkey", "c_nationkey"],
+            right_on=["s_suppkey", "s_nationkey"],
+        )
+        .merge(n, left_on="c_nationkey", right_on="n_nationkey")
+        .merge(r[r.r_name == "ASIA"], left_on="n_regionkey", right_on="r_regionkey")
+    )
+    m["rev"] = m.l_extendedprice * (1 - m.l_discount)
+    exp = m.groupby("n_name").rev.sum().reset_index(name="revenue")
+    assert len(exp) > 0
+    sorted_eq(got, exp, by=["n_name"], rtol=1e-9)
+
+
+def test_q12(env):
+    ctx, paths, dfs = env
+    s = streams(env)
+    got = (
+        s["lineitem"]
+        .filter_sql(
+            "l_shipmode in ('MAIL', 'SHIP') and l_commitdate < l_receiptdate "
+            "and l_shipdate < l_commitdate and l_receiptdate >= date '1994-01-01' "
+            "and l_receiptdate < date '1995-01-01'"
+        )
+        .join(s["orders"], left_on="l_orderkey", right_on="o_orderkey")
+        .groupby("l_shipmode")
+        .agg_sql(
+            "sum(case when o_orderpriority = '1-URGENT' or o_orderpriority = '2-HIGH' "
+            "then 1 else 0 end) as high_line_count, "
+            "sum(case when o_orderpriority <> '1-URGENT' and o_orderpriority <> '2-HIGH' "
+            "then 1 else 0 end) as low_line_count"
+        )
+        .collect()
+    )
+    l, o = dfs["lineitem"], dfs["orders"]
+    f = l[
+        l.l_shipmode.isin(["MAIL", "SHIP"])
+        & (l.l_commitdate < l.l_receiptdate)
+        & (l.l_shipdate < l.l_commitdate)
+        & (l.l_receiptdate >= datetime.date(1994, 1, 1))
+        & (l.l_receiptdate < datetime.date(1995, 1, 1))
+    ]
+    m = f.merge(o, left_on="l_orderkey", right_on="o_orderkey")
+    hi = m.o_orderpriority.isin(["1-URGENT", "2-HIGH"])
+    exp = (
+        pd.DataFrame(
+            {"l_shipmode": m.l_shipmode, "high": hi.astype(int), "low": (~hi).astype(int)}
+        )
+        .groupby("l_shipmode")
+        .agg(high_line_count=("high", "sum"), low_line_count=("low", "sum"))
+        .reset_index()
+    )
+    assert len(exp) > 0
+    sorted_eq(got, exp, by=["l_shipmode"])
+
+
+def test_q14(env):
+    ctx, paths, dfs = env
+    s = streams(env)
+    got = (
+        s["lineitem"]
+        .filter_sql("l_shipdate >= date '1995-09-01' and l_shipdate < date '1995-10-01'")
+        .join(s["part"], left_on="l_partkey", right_on="p_partkey")
+        .agg_sql(
+            "100.00 * sum(case when p_type like 'PROMO%' "
+            "then l_extendedprice * (1 - l_discount) else 0 end) / "
+            "sum(l_extendedprice * (1 - l_discount)) as promo_revenue"
+        )
+        .collect()
+    )
+    l, p = dfs["lineitem"], dfs["part"]
+    f = l[
+        (l.l_shipdate >= datetime.date(1995, 9, 1))
+        & (l.l_shipdate < datetime.date(1995, 10, 1))
+    ]
+    m = f.merge(p, left_on="l_partkey", right_on="p_partkey")
+    rev = m.l_extendedprice * (1 - m.l_discount)
+    promo = rev.where(m.p_type.str.startswith("PROMO"), 0.0)
+    exp = 100.0 * promo.sum() / rev.sum()
+    np.testing.assert_allclose(got.promo_revenue[0], exp, rtol=1e-9)

@@ -1,0 +1,205 @@
+"""Miniature TPC-H data generator (dbgen-alike, numpy-based).
+
+Schemas and value domains follow the TPC-H spec closely enough that the
+reference queries (apps/tpc-h/tpch.py shapes) select realistic fractions of
+rows; correctness tests compare against pandas oracles computed on the same
+generated data, so distribution fidelity only affects selectivity, not
+correctness.
+"""
+
+import datetime
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+
+EPOCH = datetime.date(1970, 1, 1)
+
+
+def _dates(r, n, lo="1992-01-01", hi="1998-12-01"):
+    lo_d = (datetime.date.fromisoformat(lo) - EPOCH).days
+    hi_d = (datetime.date.fromisoformat(hi) - EPOCH).days
+    return r.integers(lo_d, hi_d, n).astype(np.int32)
+
+
+REGIONS = ["AFRICA", "AMERICA", "ASIA", "EUROPE", "MIDDLE EAST"]
+NATIONS = [
+    ("ALGERIA", 0), ("ARGENTINA", 1), ("BRAZIL", 1), ("CANADA", 1), ("EGYPT", 4),
+    ("ETHIOPIA", 0), ("FRANCE", 3), ("GERMANY", 3), ("INDIA", 2), ("INDONESIA", 2),
+    ("IRAN", 4), ("IRAQ", 4), ("JAPAN", 2), ("JORDAN", 4), ("KENYA", 0),
+    ("MOROCCO", 0), ("MOZAMBIQUE", 0), ("PERU", 1), ("CHINA", 2), ("ROMANIA", 3),
+    ("SAUDI ARABIA", 4), ("VIETNAM", 2), ("RUSSIA", 3), ("UNITED KINGDOM", 3),
+    ("UNITED STATES", 1),
+]
+SEGMENTS = ["AUTOMOBILE", "BUILDING", "FURNITURE", "MACHINERY", "HOUSEHOLD"]
+PRIORITIES = ["1-URGENT", "2-HIGH", "3-MEDIUM", "4-NOT SPECIFIED", "5-LOW"]
+SHIPMODES = ["REG AIR", "AIR", "RAIL", "SHIP", "TRUCK", "MAIL", "FOB"]
+INSTRUCTS = ["DELIVER IN PERSON", "COLLECT COD", "NONE", "TAKE BACK RETURN"]
+TYPES = [
+    f"{a} {b} {c}"
+    for a in ["STANDARD", "SMALL", "MEDIUM", "LARGE", "ECONOMY", "PROMO"]
+    for b in ["ANODIZED", "BURNISHED", "PLATED", "POLISHED", "BRUSHED"]
+    for c in ["TIN", "NICKEL", "BRASS", "STEEL", "COPPER"]
+]
+CONTAINERS = [
+    f"{a} {b}"
+    for a in ["SM", "LG", "MED", "JUMBO", "WRAP"]
+    for b in ["CASE", "BOX", "BAG", "JAR", "PKG", "PACK", "CAN", "DRUM"]
+]
+
+
+def generate(sf: float = 0.003, seed: int = 0):
+    """Return {table_name: pyarrow.Table}.  sf=1 would be full TPC-H scale."""
+    r = np.random.default_rng(seed)
+    n_orders = max(int(1_500_000 * sf), 50)
+    n_cust = max(int(150_000 * sf), 20)
+    n_part = max(int(200_000 * sf), 25)
+    n_supp = max(int(10_000 * sf), 10)
+    n_nation = 25
+
+    region = pa.table(
+        {
+            "r_regionkey": np.arange(5, dtype=np.int64),
+            "r_name": REGIONS,
+            "r_comment": [f"region {i}" for i in range(5)],
+        }
+    )
+    nation = pa.table(
+        {
+            "n_nationkey": np.arange(n_nation, dtype=np.int64),
+            "n_name": [n for n, _ in NATIONS],
+            "n_regionkey": np.array([rg for _, rg in NATIONS], dtype=np.int64),
+            "n_comment": [f"nation {i}" for i in range(n_nation)],
+        }
+    )
+    supplier = pa.table(
+        {
+            "s_suppkey": np.arange(1, n_supp + 1, dtype=np.int64),
+            "s_name": [f"Supplier#{i:09d}" for i in range(1, n_supp + 1)],
+            "s_address": [f"addr {i}" for i in range(n_supp)],
+            "s_nationkey": r.integers(0, n_nation, n_supp).astype(np.int64),
+            "s_phone": [f"{r.integers(10,35)}-{i:07d}" for i in range(n_supp)],
+            "s_acctbal": np.round(r.uniform(-999, 9999, n_supp), 2),
+            "s_comment": [
+                ("Customer Complaints" if r.random() < 0.02 else f"supp comment {i}")
+                for i in range(n_supp)
+            ],
+        }
+    )
+    part = pa.table(
+        {
+            "p_partkey": np.arange(1, n_part + 1, dtype=np.int64),
+            "p_name": [
+                f"{r.choice(['tomato','blue','green','red','ivory','forest'])} "
+                f"{r.choice(['metallic','polished','sandy','spring','misty'])} part{i}"
+                for i in range(n_part)
+            ],
+            "p_mfgr": [f"Manufacturer#{r.integers(1,6)}" for _ in range(n_part)],
+            "p_brand": [f"Brand#{r.integers(1,6)}{r.integers(1,6)}" for _ in range(n_part)],
+            "p_type": [TYPES[i] for i in r.integers(0, len(TYPES), n_part)],
+            "p_size": r.integers(1, 51, n_part).astype(np.int64),
+            "p_container": [CONTAINERS[i] for i in r.integers(0, len(CONTAINERS), n_part)],
+            "p_retailprice": np.round(900 + r.uniform(0, 1200, n_part), 2),
+            "p_comment": [f"part comment {i}" for i in range(n_part)],
+        }
+    )
+    n_ps = n_part * 4
+    partsupp = pa.table(
+        {
+            "ps_partkey": np.repeat(np.arange(1, n_part + 1, dtype=np.int64), 4),
+            "ps_suppkey": (
+                (np.repeat(np.arange(0, n_part, dtype=np.int64), 4)
+                 + np.tile(np.arange(4, dtype=np.int64) * (n_supp // 4 + 1), n_part))
+                % n_supp + 1
+            ),
+            "ps_availqty": r.integers(1, 10000, n_ps).astype(np.int64),
+            "ps_supplycost": np.round(r.uniform(1, 1000, n_ps), 2),
+            "ps_comment": [f"ps comment {i}" for i in range(n_ps)],
+        }
+    )
+    customer = pa.table(
+        {
+            "c_custkey": np.arange(1, n_cust + 1, dtype=np.int64),
+            "c_name": [f"Customer#{i:09d}" for i in range(1, n_cust + 1)],
+            "c_address": [f"caddr {i}" for i in range(n_cust)],
+            "c_nationkey": r.integers(0, n_nation, n_cust).astype(np.int64),
+            "c_phone": [
+                f"{k}-{r.integers(100,999)}-{r.integers(100,999)}-{r.integers(1000,9999)}"
+                for k in r.integers(10, 35, n_cust)
+            ],
+            "c_acctbal": np.round(r.uniform(-999, 9999, n_cust), 2),
+            "c_mktsegment": [SEGMENTS[i] for i in r.integers(0, 5, n_cust)],
+            "c_comment": [f"cust comment {i}" for i in range(n_cust)],
+        }
+    )
+    o_orderdate = _dates(r, n_orders, "1992-01-01", "1998-08-02")
+    orders = pa.table(
+        {
+            "o_orderkey": np.arange(1, n_orders + 1, dtype=np.int64) * 4,
+            "o_custkey": r.integers(1, n_cust + 1, n_orders).astype(np.int64),
+            "o_orderstatus": [["F", "O", "P"][i] for i in r.integers(0, 3, n_orders)],
+            "o_totalprice": np.round(r.uniform(1000, 400000, n_orders), 2),
+            "o_orderdate": pa.array(o_orderdate, type=pa.int32()).cast(pa.date32()),
+            "o_orderpriority": [PRIORITIES[i] for i in r.integers(0, 5, n_orders)],
+            "o_clerk": [f"Clerk#{r.integers(1,1000):09d}" for _ in range(n_orders)],
+            "o_shippriority": np.zeros(n_orders, dtype=np.int64),
+            "o_comment": [
+                ("special requests" if r.random() < 0.05 else f"order comment {i}")
+                for i in range(n_orders)
+            ],
+        }
+    )
+    # lineitem: 1-7 lines per order
+    lines_per = r.integers(1, 8, n_orders)
+    n_li = int(lines_per.sum())
+    l_orderkey = np.repeat(orders.column("o_orderkey").to_numpy(), lines_per)
+    l_linenumber = np.concatenate([np.arange(1, k + 1) for k in lines_per]).astype(np.int64)
+    odate = np.repeat(o_orderdate, lines_per)
+    l_shipdate = odate + r.integers(1, 122, n_li)
+    l_commitdate = odate + r.integers(30, 91, n_li)
+    l_receiptdate = l_shipdate + r.integers(1, 31, n_li)
+    qty = r.integers(1, 51, n_li).astype(np.float64)
+    price = np.round(qty * (900 + r.uniform(0, 1200, n_li)) / 10, 2)
+    lineitem = pa.table(
+        {
+            "l_orderkey": l_orderkey,
+            "l_partkey": r.integers(1, n_part + 1, n_li).astype(np.int64),
+            "l_suppkey": r.integers(1, n_supp + 1, n_li).astype(np.int64),
+            "l_linenumber": l_linenumber,
+            "l_quantity": qty,
+            "l_extendedprice": price,
+            "l_discount": np.round(r.uniform(0, 0.1, n_li), 2),
+            "l_tax": np.round(r.uniform(0, 0.08, n_li), 2),
+            "l_returnflag": [["A", "N", "R"][i] for i in r.integers(0, 3, n_li)],
+            "l_linestatus": [["F", "O"][i] for i in r.integers(0, 2, n_li)],
+            "l_shipdate": pa.array(l_shipdate.astype(np.int32), type=pa.int32()).cast(pa.date32()),
+            "l_commitdate": pa.array(l_commitdate.astype(np.int32), type=pa.int32()).cast(pa.date32()),
+            "l_receiptdate": pa.array(l_receiptdate.astype(np.int32), type=pa.int32()).cast(pa.date32()),
+            "l_shipinstruct": [INSTRUCTS[i] for i in r.integers(0, 4, n_li)],
+            "l_shipmode": [SHIPMODES[i] for i in r.integers(0, 7, n_li)],
+            "l_comment": [f"li comment {i}" for i in range(n_li)],
+        }
+    )
+    return {
+        "region": region,
+        "nation": nation,
+        "supplier": supplier,
+        "part": part,
+        "partsupp": partsupp,
+        "customer": customer,
+        "orders": orders,
+        "lineitem": lineitem,
+    }
+
+
+def write_parquet_dir(tables, root, row_group_size: int = 4096):
+    import os
+
+    import pyarrow.parquet as pq
+
+    paths = {}
+    for name, t in tables.items():
+        p = os.path.join(root, f"{name}.parquet")
+        pq.write_table(t, p, row_group_size=row_group_size)
+        paths[name] = p
+    return paths
