@@ -1,0 +1,92 @@
+"""Catalog: sampling-based cardinality estimation.
+
+Reference role (pyquokka/catalog.py:12-98): sample a slice of each source,
+run the pushed-down predicate on the sample, scale the selectivity by the
+full-source size.  Used by the optimizer to order joins and choose broadcast
+vs shuffle builds.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import pyarrow as pa
+
+from quokka_tpu.expression import Expr
+from quokka_tpu.ops import bridge, kernels
+from quokka_tpu.ops.expr_compile import CompileError, evaluate_predicate
+
+SAMPLE_ROWS = 8192
+
+
+class Catalog:
+    def __init__(self):
+        self._cache: Dict[tuple, Optional[float]] = {}
+
+    def estimate_source(self, reader, predicate: Optional[Expr]) -> Optional[float]:
+        """Estimated output rows of a source under `predicate`; None if the
+        reader can't report size.  Cached per (reader, predicate) so repeated
+        optimize() calls don't re-read Parquet footers and samples."""
+        key = (id(reader), predicate.sql() if predicate is not None else None)
+        if key in self._cache:
+            return self._cache[key]
+        est = self._estimate(reader, predicate)
+        self._cache[key] = est
+        return est
+
+    def _estimate(self, reader, predicate: Optional[Expr]) -> Optional[float]:
+        total = self._total_rows(reader)
+        if total is None:
+            return None
+        if predicate is None:
+            return float(total)
+        sample = self._sample(reader)
+        if sample is None or sample.num_rows == 0:
+            return float(total)
+        try:
+            b = bridge.arrow_to_device(sample)
+            mask = evaluate_predicate(predicate, b)
+            kept = kernels.apply_mask(b, mask).count_valid()
+        except CompileError:
+            return float(total)
+        sel = kept / sample.num_rows
+        return float(total) * sel
+
+    def _total_rows(self, reader) -> Optional[int]:
+        import pyarrow.parquet as pq
+
+        from quokka_tpu.dataset.readers import (
+            InputArrowDataset,
+            InputParquetDataset,
+            _expand_paths,
+        )
+
+        if isinstance(reader, InputArrowDataset):
+            return reader.table.num_rows
+        if isinstance(reader, InputParquetDataset):
+            n = 0
+            for f in _expand_paths(reader.path):
+                n += pq.ParquetFile(f).metadata.num_rows
+            return n
+        return None
+
+    def _sample(self, reader) -> Optional[pa.Table]:
+        import pyarrow.parquet as pq
+
+        from quokka_tpu.dataset.readers import (
+            InputArrowDataset,
+            InputParquetDataset,
+            _expand_paths,
+        )
+
+        if isinstance(reader, InputArrowDataset):
+            return reader.table.slice(0, SAMPLE_ROWS)
+        if isinstance(reader, InputParquetDataset):
+            f = _expand_paths(reader.path)[0]
+            pf = pq.ParquetFile(f)
+            batches = pf.iter_batches(batch_size=SAMPLE_ROWS)
+            try:
+                return pa.Table.from_batches([next(batches)])
+            except StopIteration:
+                return pf.schema_arrow.empty_table()
+        return None

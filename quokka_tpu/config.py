@@ -18,6 +18,21 @@ import os
 import jax
 import jax.numpy as jnp
 
+# Persistent XLA compilation cache: first-compile of the fused kernels is slow
+# (tens of seconds per program over a remote TPU runtime); cache executables on
+# disk so they amortize across processes and queries.
+_cache_dir = os.environ.get("QUOKKA_JAX_CACHE_DIR", "")
+if not _cache_dir and os.environ.get("JAX_PLATFORMS", "") in ("axon", "tpu"):
+    _cache_dir = os.path.expanduser("~/.cache/quokka_tpu_jax")
+if _cache_dir and _cache_dir != "0":
+    try:
+        os.makedirs(_cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception:
+        pass
+
 # ---------------------------------------------------------------------------
 # Padding buckets
 # ---------------------------------------------------------------------------

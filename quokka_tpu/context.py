@@ -75,6 +75,35 @@ class QuokkaContext:
         reader = InputJSONDataset(path)
         return self.new_stream(logical.SourceNode(reader, list(reader.schema.names)))
 
+    def read_sorted_parquet(self, path, sorted_by: str, columns=None,
+                            mode: str = "stride") -> "OrderedStream":
+        """Time-ordered Parquet scan: row groups ordered by min/max stats on
+        `sorted_by`, non-overlap asserted (reference df.py:790)."""
+        from quokka_tpu.dataset.ordered import InputSortedParquetDataset
+
+        reader = InputSortedParquetDataset(path, sorted_by, columns=columns, mode=mode)
+        schema = list(columns) if columns else [f for f in reader.schema.names]
+        return self.new_stream(
+            logical.SourceNode(reader, schema, sorted_by=[sorted_by]), ordered=True
+        )
+
+    def read_sorted_csv(self, path, sorted_by: str, schema=None, has_header=True,
+                        sep: str = ",") -> "OrderedStream":
+        """Ordered CSV scan: byte ranges are in file order; the caller asserts
+        the file is sorted by `sorted_by` (reference read_sorted_csv)."""
+        reader = InputCSVDataset(path, schema=schema, has_header=has_header, sep=sep)
+        return self.new_stream(
+            logical.SourceNode(reader, list(reader.schema.names), sorted_by=[sorted_by]),
+            ordered=True,
+        )
+
+    def from_arrow_sorted(self, table: pa.Table, sorted_by: str) -> "OrderedStream":
+        reader = InputArrowDataset(table)
+        return self.new_stream(
+            logical.SourceNode(reader, list(table.column_names), sorted_by=[sorted_by]),
+            ordered=True,
+        )
+
     def from_arrow(self, table: pa.Table) -> DataStream:
         reader = InputArrowDataset(table)
         return self.new_stream(logical.SourceNode(reader, list(table.column_names)))
@@ -173,6 +202,13 @@ class QuokkaContext:
     def explain(self, node_id: int) -> str:
         sub, _ = self._copy_subgraph(node_id)
         sink_id = node_id
+        # wrap in a sink exactly like execute_node: optimizer rewrites assume
+        # the root has a consumer (a root filter would otherwise re-push its
+        # predicate on every fixpoint round)
+        if not isinstance(sub[sink_id], logical.SinkNode):
+            sink = logical.SinkNode([sink_id], sub[sink_id].schema)
+            sink_id = max(sub) + 1
+            sub[sink_id] = sink
         if self.optimize_plans:
             from quokka_tpu.optimizer import optimize
 

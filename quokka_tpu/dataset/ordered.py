@@ -1,0 +1,67 @@
+"""Sorted input readers.
+
+Reference parity: InputSortedEC2ParquetDataset (pyquokka/dataset/
+ordered_readers.py:3-150): infer global time order from Parquet row-group
+statistics, assert non-overlap, and assign row groups to channels either
+round-robin in time order ("stride" — channels interleave, the cache's SAT
+delivery reconstructs global order) or as contiguous time ranges ("range").
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import pyarrow.parquet as pq
+
+from quokka_tpu.dataset.readers import InputParquetDataset, _expand_paths
+
+
+class InputSortedParquetDataset(InputParquetDataset):
+    def __init__(self, path, sorted_by: str, columns=None, predicate=None,
+                 mode: str = "stride"):
+        super().__init__(path, columns=columns, predicate=predicate)
+        self.sorted_by = sorted_by
+        if mode not in ("stride", "range"):
+            raise ValueError(mode)
+        self.mode = mode
+
+    def get_own_state(self, num_channels: int) -> Dict[int, List]:
+        pieces = []  # (min_stat, file, rg)
+        for f in _expand_paths(self.path):
+            pf = pq.ParquetFile(f)
+            meta = pf.metadata
+            schema = pf.schema_arrow
+            col_idx = {meta.row_group(0).column(i).path_in_schema: i
+                       for i in range(meta.num_columns)} if meta.num_row_groups else {}
+            if self.sorted_by not in col_idx:
+                raise ValueError(f"sort column {self.sorted_by} not in {f}")
+            for rg in range(meta.num_row_groups):
+                rgm = meta.row_group(rg)
+                st = rgm.column(col_idx[self.sorted_by]).statistics
+                if st is None or not st.has_min_max:
+                    raise ValueError(
+                        f"row group {rg} of {f} lacks min/max stats on "
+                        f"{self.sorted_by}; cannot order"
+                    )
+                if self.predicate is not None:
+                    from quokka_tpu.dataset.readers import _rowgroup_prunable
+
+                    if _rowgroup_prunable(rgm, self.predicate, schema):
+                        continue
+                pieces.append((st.min, st.max, f, rg))
+        pieces.sort(key=lambda p: p[0])
+        # assert global non-overlap (the reference does the same,
+        # unordered_readers.py:351)
+        for a, b in zip(pieces, pieces[1:]):
+            if a[1] > b[0]:
+                raise ValueError(
+                    f"row groups overlap on {self.sorted_by}: "
+                    f"[{a[0]}, {a[1]}] vs [{b[0]}, {b[1]}]"
+                )
+        lineages = [(f, rg) for _, _, f, rg in pieces]
+        if self.mode == "stride":
+            return {ch: lineages[ch::num_channels] for ch in range(num_channels)}
+        per = (len(lineages) + num_channels - 1) // max(num_channels, 1)
+        return {
+            ch: lineages[ch * per : (ch + 1) * per] for ch in range(num_channels)
+        }

@@ -201,16 +201,17 @@ class DataStream:
         for c in right_on:
             if c not in right.schema:
                 raise ValueError(f"right join key {c} not in {right.schema}")
+        rename = None
         if how in ("semi", "anti"):
             out_schema = self.schema
         else:
             rpayload = [c for c in right.schema if c not in set(right_on)]
-            out_schema = self.schema + [
-                c + suffix if c in set(self.schema) else c for c in rpayload
-            ]
+            rename = {c: c + suffix for c in rpayload if c in set(self.schema)}
+            out_schema = self.schema + [rename.get(c, c) for c in rpayload]
         return self._child(
             logical.JoinNode(
-                [self.node_id, right.node_id], out_schema, left_on, right_on, how, suffix
+                [self.node_id, right.node_id], out_schema, left_on, right_on, how,
+                suffix, rename=rename,
             )
         )
 
@@ -384,9 +385,172 @@ class GroupedDataStream:
 
 
 class OrderedStream(DataStream):
-    """Sorted-stream subclass (orderedstream.py:3); time-series verbs attach
-    here (asof joins, windows, CEP) — see quokka_tpu.ts (task tier)."""
+    """Sorted-stream subclass (reference: pyquokka/orderedstream.py:3-191).
+    Carries time-order metadata through the plan; time-series verbs (asof
+    join, window aggregation, pattern recognition, shift) attach here."""
 
     @property
     def sorted_by(self):
         return self._node.sorted_by
+
+    @property
+    def time_col(self) -> str:
+        sb = self.sorted_by
+        if not sb:
+            raise ValueError("ordered stream has no sort column metadata")
+        return sb[0]
+
+    def _ordered(self, node: logical.Node) -> "OrderedStream":
+        node.sorted_by = self.sorted_by
+        nid = self.ctx.add_node(node)
+        return OrderedStream(self.ctx, nid)
+
+    def _rewrap(self, ds: DataStream) -> "OrderedStream":
+        """Reuse the DataStream verb, then mark the node ordered — unless the
+        sort column was projected away (the result is no longer ordered)."""
+        node = ds._node
+        if self.sorted_by and all(c in node.schema for c in self.sorted_by):
+            node.sorted_by = self.sorted_by
+            return OrderedStream(self.ctx, ds.node_id)
+        return ds
+
+    # order-preserving relational verbs stay ordered
+    def filter(self, predicate):
+        return self._rewrap(DataStream.filter(self, predicate))
+
+    def filter_sql(self, sql):
+        return self.filter(sql)
+
+    def select(self, columns):
+        return self._rewrap(DataStream.select(self, columns))
+
+    def with_columns(self, exprs):
+        return self._rewrap(DataStream.with_columns(self, exprs))
+
+    # -- asof join (orderedstream.py:37 join_asof) ---------------------------
+    def join_asof(
+        self,
+        right: "OrderedStream",
+        on: Optional[str] = None,
+        left_on: Optional[str] = None,
+        right_on: Optional[str] = None,
+        by=None,
+        left_by=None,
+        right_by=None,
+        suffix: str = "_2",
+        direction: str = "backward",
+    ) -> "OrderedStream":
+        from quokka_tpu.executors.ts_execs import SortedAsofExecutor
+        from quokka_tpu.target_info import HashPartitioner, PassThroughPartitioner
+
+        if direction != "backward":
+            raise NotImplementedError("join_asof currently supports backward")
+        left_on = left_on or on or self.time_col
+        right_on = right_on or on or right.time_col
+        if by is not None:
+            left_by = right_by = [by] if isinstance(by, str) else list(by)
+        left_by = [left_by] if isinstance(left_by, str) else list(left_by or [])
+        right_by = [right_by] if isinstance(right_by, str) else list(right_by or [])
+        rpayload = [c for c in right.schema if c not in set(right_by) and c != right_on]
+        out_schema = self.schema + [
+            c + suffix if c in set(self.schema) else c for c in rpayload
+        ]
+        if left_by:
+            parts = {0: HashPartitioner(left_by), 1: HashPartitioner(right_by)}
+        else:
+            parts = {0: PassThroughPartitioner(), 1: PassThroughPartitioner()}
+        node = logical.StatefulNode(
+            [self.node_id, right.node_id],
+            out_schema,
+            lambda: SortedAsofExecutor(left_on, right_on, left_by, right_by, suffix),
+            partitioners=parts,
+            sorted_output=[left_on],
+        )
+        nid = self.ctx.add_node(node)
+        return OrderedStream(self.ctx, nid)
+
+    # -- window aggregation (datastream.py:1650 windowed_transform +
+    #    windowtypes compilation) --------------------------------------------
+    def window_agg(self, window, aggs_sql: str, by=None, trigger=None) -> DataStream:
+        from quokka_tpu import windows as W
+        from quokka_tpu.executors.ts_execs import (
+            HoppingWindowExecutor,
+            SessionWindowExecutor,
+            SlidingWindowExecutor,
+        )
+        from quokka_tpu.target_info import HashPartitioner, PassThroughPartitioner
+
+        by = [by] if isinstance(by, str) else list(by or [])
+        time_col = self.time_col
+        exprs = sqlparse.parse_select_list(aggs_sql)
+        named = [e if isinstance(e, Alias) else Alias(e, f"col{i}") for i, e in enumerate(exprs)]
+        plan = plan_aggregation(named)
+        if isinstance(window, (W.TumblingWindow, W.HoppingWindow)):
+            factory = lambda: HoppingWindowExecutor(time_col, by, window, plan, trigger)
+            extra = ["window_start", "window_end"]
+        elif isinstance(window, W.SessionWindow):
+            factory = lambda: SessionWindowExecutor(time_col, by, window, plan)
+            extra = ["session_start", "session_end"]
+        elif isinstance(window, W.SlidingWindow):
+            factory = lambda: SlidingWindowExecutor(time_col, by, window, plan)
+            extra = []
+        else:
+            raise TypeError(f"unknown window type {type(window)}")
+        if isinstance(window, W.SlidingWindow):
+            out_schema = self.schema + [n for n, _ in plan.finals]
+            out_sorted = [time_col]  # per-event output keeps the time column
+        else:
+            out_schema = by + extra + [n for n, _ in plan.finals]
+            out_sorted = [extra[0]]  # windows emit ordered by their start
+        node = logical.StatefulNode(
+            [self.node_id],
+            out_schema,
+            factory,
+            partitioners={0: HashPartitioner(by) if by else PassThroughPartitioner()},
+            sorted_output=out_sorted,
+        )
+        nid = self.ctx.add_node(node)
+        return OrderedStream(self.ctx, nid)
+
+    def windowed_transform(self, window, aggs_sql: str, by=None, trigger=None):
+        return self.window_agg(window, aggs_sql, by=by, trigger=trigger)
+
+    # -- shift (orderedstream.py:13) -----------------------------------------
+    def shift(self, columns, n: int = 1, by=None, fill_value=None) -> "OrderedStream":
+        from quokka_tpu.executors.ts_execs import ShiftExecutor
+        from quokka_tpu.target_info import HashPartitioner, PassThroughPartitioner
+
+        columns = [columns] if isinstance(columns, str) else list(columns)
+        by = [by] if isinstance(by, str) else list(by or [])
+        out_schema = self.schema + [f"{c}_shifted_{n}" for c in columns]
+        time_col = self.time_col
+        node = logical.StatefulNode(
+            [self.node_id],
+            out_schema,
+            lambda: ShiftExecutor(time_col, by, columns, n),
+            partitioners={0: HashPartitioner(by) if by else PassThroughPartitioner()},
+            sorted_output=[time_col],
+        )
+        return self._ordered(node)
+
+    # -- pattern recognition (CEP, orderedstream.py:55 pattern_recognize) -----
+    def pattern_recognize(self, events, within, by=None) -> DataStream:
+        from quokka_tpu.executors.cep import CEPExecutor
+        from quokka_tpu.target_info import HashPartitioner, PassThroughPartitioner
+
+        by = [by] if isinstance(by, str) else list(by or [])
+        time_col = self.time_col
+        names = [n for n, _ in events]
+        out_schema = by + [f"{n}_{time_col}" for n in names]
+        node = logical.StatefulNode(
+            [self.node_id],
+            out_schema,
+            lambda: CEPExecutor(time_col, events, within, by),
+            partitioners={0: HashPartitioner(by) if by else PassThroughPartitioner()},
+        )
+        return self._child(node)
+
+    def stateful_transform_sorted(self, executor, new_schema, by=None):
+        ds = self.stateful_transform(executor, new_schema, by=by)
+        ds._node.sorted_by = self.sorted_by
+        return OrderedStream(self.ctx, ds.node_id)

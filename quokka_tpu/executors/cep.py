@@ -1,0 +1,181 @@
+"""Complex-event-processing (pattern recognition) executor.
+
+Reference parity: CEPExecutor / nfa_cep (pyquokka/executors/cep_executors.py:
+13-272): given an ordered event pattern [(name, condition), ...] and a time
+bound, find row sequences e1 < e2 < ... < ek within `within` time units where
+each condition holds; conditions may reference prior events' bound values as
+``name.column``.
+
+TPU-hybrid design (SURVEY.md hard-part #6): per-event row predicates that
+depend only on the current row are evaluated as vectorized device masks (one
+fused pass over the batch); the genuinely sequential NFA walk then runs on the
+host but only over the sparse candidate rows that passed some mask.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from quokka_tpu import sqlparse
+from quokka_tpu.executors.base import Executor
+from quokka_tpu.expression import Expr
+from quokka_tpu.ops import bridge, kernels
+from quokka_tpu.ops.batch import DeviceBatch
+from quokka_tpu.ops.expr_compile import CompileError, evaluate_predicate
+
+_BINDING_RE = re.compile(r"\b([A-Za-z_][A-Za-z_0-9]*)\.([A-Za-z_][A-Za-z_0-9]*)\b")
+
+
+class CEPExecutor(Executor):
+    """Match an event pattern on a time-ordered stream.
+
+    events: [(name, condition_sql)]; conditions may use `prior.col` bindings.
+    Emits one row per match: {<name>_<time_col> for each event} + key columns.
+    Matching semantics: each event binds the FIRST row satisfying its
+    condition after the previous event (skip-till-next-match), all within
+    `within` of the first event.
+    """
+
+    def __init__(self, time_col: str, events: Sequence[Tuple[str, str]],
+                 within, by: Optional[Sequence[str]] = None):
+        self.time_col = time_col
+        self.within = within
+        self.by = list(by or [])
+        self.names = [n for n, _ in events]
+        self.conds = [c for _, c in events]
+        # split each condition into a self-only device prefilter and a
+        # binding-dependent host residual
+        self.device_pred: List[Optional[Expr]] = []
+        self.host_cond: List[Optional[str]] = []
+        for cond in self.conds:
+            if _BINDING_RE.search(cond):
+                self.device_pred.append(None)
+                self.host_cond.append(cond)
+            else:
+                self.device_pred.append(sqlparse.parse_expression(cond))
+                self.host_cond.append(None)
+        self.buffer: List = []  # host rows pending (may match future events)
+        self.schema_cols: Optional[List[str]] = None
+
+    def execute(self, batches, stream_id, channel):
+        import pandas as pd
+
+        import jax.numpy as jnp
+
+        rows = []
+        for b in batches:
+            if b is None or b.count_valid() == 0:
+                continue
+            # device prefilter: keep only rows that can participate in ANY
+            # event (sparse candidates for the host NFA)
+            any_mask = jnp.zeros(b.padded_len, dtype=bool)
+            for pred in self.device_pred:
+                if pred is None:
+                    any_mask = b.valid
+                    break
+                any_mask = any_mask | evaluate_predicate(pred, b)
+            df = bridge.to_pandas(kernels.compact(kernels.apply_mask(b, any_mask)))
+            rows.append(df)
+        if not rows:
+            return None
+        if self.buffer:
+            rows = self.buffer + rows
+        df = pd.concat(rows, ignore_index=True) if len(rows) > 1 else rows[0]
+        self.schema_cols = list(df.columns)
+        # matches starting after (watermark - within) may still grow with
+        # future rows: emit only fully-determined matches, carry the tail
+        watermark = df[self.time_col].max()
+        cutoff = watermark - self.within
+        matches = self._scan(df, start_cutoff=cutoff)
+        self.buffer = [df[df[self.time_col] > cutoff]]
+        if matches is None or len(matches) == 0:
+            return None
+        import pyarrow as pa
+
+        return bridge.arrow_to_device(pa.Table.from_pandas(matches, preserve_index=False))
+
+    def done(self, channel):
+        import pandas as pd
+
+        if not self.buffer:
+            return None
+        df = pd.concat(self.buffer, ignore_index=True)
+        self.buffer = []
+        if len(df) == 0:
+            return None
+        self.schema_cols = list(df.columns)
+        matches = self._scan(df)
+        if matches is None or len(matches) == 0:
+            return None
+        import pyarrow as pa
+
+        return bridge.arrow_to_device(pa.Table.from_pandas(matches, preserve_index=False))
+
+    def _eval_cond(self, cond: str, row, bound: Dict[str, Dict]) -> bool:
+        expr = cond
+        env = {}
+        for name, b in bound.items():
+            env[name] = b
+
+        def repl(m):
+            return f"__b['{m.group(1)}']['{m.group(2)}']"
+
+        py = _BINDING_RE.sub(repl, expr)
+        py = re.sub(r"\band\b", " and ", py)
+        py = re.sub(r"\bor\b", " or ", py)
+        py = re.sub(r"(?<![<>!=])=(?!=)", "==", py)
+        try:
+            cols = {c: row[c] for c in self.schema_cols or []}
+            return bool(eval(py, {"__b": env, "__builtins__": {}}, cols))
+        except Exception:
+            return False
+
+    def _scan(self, df, start_cutoff=None):
+        import pandas as pd
+
+        out = []
+        groups = df.groupby(self.by) if self.by else [((), df)]
+        for gkey, g in groups:
+            g = g.sort_values(self.time_col)
+            recs = g.to_dict("records")
+            n = len(recs)
+            k = len(self.names)
+            for i, start in enumerate(recs):
+                if start_cutoff is not None and start[self.time_col] > start_cutoff:
+                    continue  # not yet determined; retried from the carry
+                if not self._row_matches(0, start, {}):
+                    continue
+                bound = {self.names[0]: start}
+                t0 = start[self.time_col]
+                j = i + 1
+                level = 1
+                while level < k and j < n:
+                    row = recs[j]
+                    if row[self.time_col] - t0 > self.within:
+                        break
+                    if self._row_matches(level, row, bound):
+                        bound[self.names[level]] = row
+                        level += 1
+                    j += 1
+                if level == k:
+                    rec = {}
+                    if self.by:
+                        keyvals = gkey if isinstance(gkey, tuple) else (gkey,)
+                        for c, v in zip(self.by, keyvals):
+                            rec[c] = v
+                    for name in self.names:
+                        rec[f"{name}_{self.time_col}"] = bound[name][self.time_col]
+                    out.append(rec)
+        if not out:
+            return None
+        return pd.DataFrame(out)
+
+    def _row_matches(self, level: int, row, bound) -> bool:
+        cond = self.conds[level]
+        if self.host_cond[level] is None:
+            # pure self-condition: re-evaluate cheaply on host
+            return self._eval_cond(cond, row, {})
+        return self._eval_cond(cond, row, bound)

@@ -64,16 +64,24 @@ class PartialAggExecutor(Executor):
         self.keys = list(keys)
         self.plan = plan
         self.state: Optional[DeviceBatch] = None
+        from quokka_tpu.ops.fuse import FusedPartialAgg
+
+        self._fused = FusedPartialAgg(self.keys, plan)
 
     def _partial(self, batch: DeviceBatch) -> DeviceBatch:
-        b = batch
-        for name, e in self.plan.pre:
-            b = b.with_column(name, evaluate_to_column(e, b))
-        aggs = [
-            (p, op, None if tmp is None else b.columns[tmp].data)
-            for (p, op, tmp) in self.plan.partials
-        ]
-        g = kernels.groupby_aggregate(b, self.keys, aggs)
+        from quokka_tpu.ops.expr_compile import CompileError
+
+        try:
+            g = self._fused(batch)
+        except CompileError:
+            b = batch
+            for name, e in self.plan.pre:
+                b = b.with_column(name, evaluate_to_column(e, b))
+            aggs = [
+                (p, op, None if tmp is None else b.columns[tmp].data)
+                for (p, op, tmp) in self.plan.partials
+            ]
+            g = kernels.groupby_aggregate(b, self.keys, aggs)
         return kernels.compact(g.select(self.keys + [p for p, _, _ in self.plan.partials]))
 
     def _recombine(self, parts: List[DeviceBatch]) -> DeviceBatch:
@@ -188,11 +196,15 @@ class BuildProbeJoinExecutor(Executor):
         right_on: Sequence[str],
         how: str = "inner",
         suffix: str = "_2",
+        rename: Optional[Dict[str, str]] = None,
     ):
         self.left_on = list(left_on)
         self.right_on = list(right_on)
         self.how = how
         self.suffix = suffix
+        # plan-time rename of clashing build columns; None -> detect at
+        # runtime from the first probe batch (raw TaskGraph usage)
+        self.planned_rename = rename
         self.build_parts: List[DeviceBatch] = []
         self.build: Optional[DeviceBatch] = None
         self.build_done = False
@@ -213,7 +225,10 @@ class BuildProbeJoinExecutor(Executor):
         self.build_parts = []
         # payload = build columns minus its join keys; rename clashes
         payload = [c for c in b.names if c not in self.right_on]
-        self.rename = {c: c + self.suffix for c in payload if c in probe_cols}
+        if self.planned_rename is not None:
+            self.rename = {c: n for c, n in self.planned_rename.items() if c in payload}
+        else:
+            self.rename = {c: c + self.suffix for c in payload if c in probe_cols}
         if self.rename:
             b = b.rename(self.rename)
             payload = [self.rename.get(c, c) for c in payload]

@@ -1,0 +1,618 @@
+"""Time-series executors: asof join, tumbling/hopping/sliding/session windows.
+
+Reference parity: pyquokka/executors/ts_executors.py — SortedAsofExecutor:324,
+HoppingWindowExecutor:12, SlidingWindowExecutor:147, SessionWindowExecutor:197.
+The sequential frontier walks become batched device kernels (merged sort +
+segmented scans, ops/asof.py); executors keep only watermark state and the
+buffered tail that future batches can still affect.
+
+All executors assume their channel receives a per-key time-ordered stream —
+guaranteed by sorted sources (SAT interleaved delivery, runtime/cache.py) and
+hash-by-key partitioning.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+
+from quokka_tpu.executors.base import Executor
+from quokka_tpu.ops import asof as asof_ops
+from quokka_tpu.ops import bridge, kernels
+from quokka_tpu.ops.batch import DeviceBatch, NumCol
+from quokka_tpu.ops.expr_compile import AggPlan, evaluate_to_column
+from quokka_tpu.windows import (
+    HoppingWindow,
+    OnCompletionTrigger,
+    SessionWindow,
+    SlidingWindow,
+    TumblingWindow,
+    Trigger,
+    Window,
+)
+
+
+def _time_max(batch: DeviceBatch, col: str) -> float:
+    c = batch.columns[col]
+    return float(kernels.reduce_array(c.data, batch.valid, "max"))
+
+
+class SortedAsofExecutor(Executor):
+    """Streaming backward asof join.  Stream 0 = left/trades, stream 1 =
+    right/quotes.  Trades are emitted once the quote watermark passes their
+    timestamp; the quote buffer is pruned to the last quote per key below the
+    frontier plus everything above it."""
+
+    def __init__(self, left_on: str, right_on: str, left_by, right_by,
+                 suffix: str = "_2", keep_unmatched: bool = False):
+        self.left_on = left_on
+        self.right_on = right_on
+        self.left_by = list(left_by or [])
+        self.right_by = list(right_by or [])
+        self.suffix = suffix
+        self.keep_unmatched = keep_unmatched
+        self.trades: Optional[DeviceBatch] = None
+        self.quotes: Optional[DeviceBatch] = None
+        self.q_watermark: Optional[float] = None
+        self.t_watermark: Optional[float] = None
+        self.q_done = False
+        self.payload: Optional[List[str]] = None
+        self.rename: Dict[str, str] = {}
+
+    def _append(self, buf, batches):
+        live = [b for b in batches if b is not None and b.count_valid() > 0]
+        if not live:
+            return buf
+        parts = ([buf] if buf is not None and buf.count_valid() > 0 else []) + live
+        return bridge.concat_batches(parts) if len(parts) > 1 else parts[0]
+
+    def execute(self, batches, stream_id, channel):
+        if stream_id == 1:
+            self.quotes = self._append(self.quotes, batches)
+            if self.quotes is not None:
+                self.q_watermark = _time_max(self.quotes, self.right_on)
+            return self._flush()
+        self.trades = self._append(self.trades, batches)
+        if self.trades is not None:
+            self.t_watermark = _time_max(self.trades, self.left_on)
+        return self._flush()
+
+    def source_done(self, stream_id, channel):
+        if stream_id == 1:
+            self.q_done = True
+            return self._flush()
+        return None
+
+    def done(self, channel):
+        self.q_done = True
+        return self._flush(final=True)
+
+    def _flush(self, final: bool = False):
+        if self.trades is None or self.trades.count_valid() == 0:
+            return None
+        if self.quotes is None:
+            if self.q_done:
+                out, self.trades = self.trades, None
+                return out if self.keep_unmatched else None
+            return None
+        if self.q_done:
+            safe = float("inf")
+        elif self.q_watermark is None:
+            return None
+        else:
+            safe = self.q_watermark
+        tcol = self.trades.columns[self.left_on].data
+        # strictly below the quote watermark: a future quote batch can still
+        # contain quotes at exactly `safe` (ties must win per backward-asof)
+        ready_mask = self.trades.valid & (
+            (tcol <= safe) if safe == float("inf") else (tcol < safe)
+        )
+        ready = kernels.compact(kernels.apply_mask(self.trades, ready_mask))
+        if ready.count_valid() == 0:
+            return None
+        rest = kernels.compact(kernels.apply_mask(self.trades, self.trades.valid & ~ready_mask))
+        self.trades = rest if rest.count_valid() > 0 else None
+        if self.payload is None:
+            payload = [c for c in self.quotes.names
+                       if c not in set(self.right_by) and c != self.right_on]
+            self.rename = {c: c + self.suffix for c in payload if c in ready.names}
+            self.payload = [self.rename.get(c, c) for c in payload]
+        quotes = self.quotes.rename(self.rename) if self.rename else self.quotes
+        out = asof_ops.asof_join(
+            ready, quotes, self.left_on, self.right_on,
+            self.left_by, self.right_by, self.payload,
+        )
+        matched = out.columns.pop("__asof_matched__")
+        if not self.keep_unmatched:
+            out = kernels.apply_mask(out, matched.data)
+        # prune only below what BOTH streams have passed: future trades can
+        # still arrive below the quote watermark when quotes run ahead
+        prune_to = safe
+        if self.t_watermark is not None:
+            prune_to = min(prune_to, self.t_watermark)
+        self._prune_quotes(prune_to)
+        return out
+
+    def _prune_quotes(self, safe: float):
+        if self.quotes is None or safe == float("inf"):
+            if self.q_done:
+                self.quotes = None
+            return
+        q = self.quotes
+        qt = q.columns[self.right_on].data
+        above = q.valid & (qt > safe)
+        if self.right_by:
+            # the latest quote per key at/below the frontier must be kept
+            below = kernels.apply_mask(q, q.valid & (qt <= safe))
+            g = kernels.groupby_aggregate(
+                below, self.right_by, [("__maxt", "max", qt)]
+            )
+            g = kernels.compact(g)
+            keep_last = asof_ops.asof_join(
+                q, g, self.right_on, "__maxt", self.right_by, self.right_by, ["__maxt"],
+            )
+            is_last = keep_last.columns["__asof_matched__"].data & (
+                qt == keep_last.columns["__maxt"].data
+            )
+            keep = above | (q.valid & is_last)
+        else:
+            maxt = kernels.reduce_array(jnp.where(q.valid & (qt <= safe), qt, -jnp.inf if jnp.issubdtype(qt.dtype, jnp.floating) else jnp.iinfo(qt.dtype).min), q.valid, "max")
+            keep = above | (q.valid & (qt == maxt))
+        pruned = kernels.compact(kernels.apply_mask(q, keep))
+        self.quotes = pruned if pruned.count_valid() > 0 else None
+
+    def checkpoint(self):
+        return {
+            "trades": None if self.trades is None else bridge.device_to_arrow(self.trades),
+            "quotes": None if self.quotes is None else bridge.device_to_arrow(self.quotes),
+            "q_watermark": self.q_watermark,
+            "q_done": self.q_done,
+        }
+
+    def restore(self, state):
+        if state is None:
+            return
+        self.trades = None if state["trades"] is None else bridge.arrow_to_device(state["trades"])
+        self.quotes = None if state["quotes"] is None else bridge.arrow_to_device(state["quotes"])
+        self.q_watermark = state["q_watermark"]
+        self.q_done = state["q_done"]
+
+
+class _PartialWindowAgg:
+    """Shared helper: turn a raw batch into partial-agg rows over
+    (keys + window id), and recombine partial batches."""
+
+    def __init__(self, keys: Sequence[str], plan: AggPlan, wid_col: str = "__wid"):
+        self.keys = list(keys)
+        self.plan = plan
+        self.wid_col = wid_col
+
+    def partial(self, batch: DeviceBatch) -> DeviceBatch:
+        b = batch
+        for name, e in self.plan.pre:
+            b = b.with_column(name, evaluate_to_column(e, b))
+        aggs = [
+            (p, op, None if tmp is None else b.columns[tmp].data)
+            for (p, op, tmp) in self.plan.partials
+        ]
+        g = kernels.groupby_aggregate(b, self.keys + [self.wid_col], aggs)
+        return kernels.compact(
+            g.select(self.keys + [self.wid_col] + [p for p, _, _ in self.plan.partials])
+        )
+
+    def recombine(self, parts: List[DeviceBatch]) -> DeviceBatch:
+        merged = bridge.concat_batches(parts) if len(parts) > 1 else parts[0]
+        aggs = [(p, op, merged.columns[p].data) for (p, op) in self.plan.recombine]
+        g = kernels.groupby_aggregate(merged, self.keys + [self.wid_col], aggs)
+        return kernels.compact(
+            g.select(self.keys + [self.wid_col] + [p for p, _ in self.plan.recombine])
+        )
+
+    def finalize(self, g: DeviceBatch, extra: Sequence[str] = ()) -> DeviceBatch:
+        for name, e in self.plan.finals:
+            g = g.with_column(name, evaluate_to_column(e, g))
+        cols = self.keys + list(extra) + [n for n, _ in self.plan.finals]
+        seen, out = set(), []
+        for c in cols:
+            if c not in seen:
+                seen.add(c)
+                out.append(c)
+        return g.select(out)
+
+
+class HoppingWindowExecutor(Executor):
+    """Hopping (and tumbling: hop == size) window aggregation.  Rows are
+    replicated size//hop times onto their covering windows (static factor),
+    partially aggregated, and windows are emitted once the watermark passes
+    their end (OnEventTrigger) or all at done (OnCompletionTrigger)."""
+
+    def __init__(self, time_col: str, keys: Sequence[str], window: Window,
+                 plan: AggPlan, trigger: Optional[Trigger] = None):
+        if isinstance(window, TumblingWindow):
+            self.size, self.hop = window.size, window.size
+        elif isinstance(window, HoppingWindow):
+            self.size, self.hop = window.size, window.hop
+        else:
+            raise TypeError(f"expected Tumbling/HoppingWindow, got {type(window)}")
+        self.time_col = time_col
+        self.keys = list(keys)
+        self.plan = plan
+        self.emit_incremental = not isinstance(trigger, OnCompletionTrigger)
+        self.helper = _PartialWindowAgg(self.keys, plan)
+        self.state: Optional[DeviceBatch] = None
+
+    def _assign_windows(self, batch: DeviceBatch) -> DeviceBatch:
+        k = self.size // self.hop
+        t = batch.columns[self.time_col].data
+        reps = []
+        for j in range(k):
+            wid = t // self.hop - j
+            ok = (wid >= 0) & (t < (wid * self.hop + self.size)) & (t >= wid * self.hop)
+            b = batch.with_column("__wid", NumCol(wid.astype(jnp.int32), "i"))
+            reps.append(kernels.apply_mask(b, ok))
+        return bridge.concat_batches(reps) if len(reps) > 1 else reps[0]
+
+    def execute(self, batches, stream_id, channel):
+        parts = []
+        watermark = None
+        for b in batches:
+            if b is None or b.count_valid() == 0:
+                continue
+            watermark = _time_max(b, self.time_col)
+            parts.append(self.helper.partial(self._assign_windows(b)))
+        if self.state is not None:
+            parts.append(self.state)
+        if not parts:
+            return None
+        self.state = self.helper.recombine(parts)
+        if not self.emit_incremental or watermark is None:
+            return None
+        # windows fully below the watermark cannot receive future rows
+        wid = self.state.columns["__wid"].data
+        closed = self.state.valid & ((wid * self.hop + self.size) <= watermark)
+        ready = kernels.compact(kernels.apply_mask(self.state, closed))
+        if ready.count_valid() == 0:
+            return None
+        rest = kernels.compact(kernels.apply_mask(self.state, self.state.valid & ~closed))
+        self.state = rest if rest.count_valid() > 0 else None
+        return self._emit(ready)
+
+    def _emit(self, g: DeviceBatch) -> DeviceBatch:
+        start = g.columns["__wid"].data * self.hop
+        g = g.with_column("window_start", NumCol(start, "i"))
+        g = g.with_column(
+            "window_end", NumCol(start + self.size, "i")
+        )
+        out = self.helper.finalize(g, extra=["window_start", "window_end"])
+        return out
+
+    def done(self, channel):
+        if self.state is None:
+            return None
+        out, self.state = self._emit(self.state), None
+        return out
+
+
+TumblingWindowExecutor = HoppingWindowExecutor
+
+
+class SessionWindowExecutor(Executor):
+    """Gap-based session windows: sessions close when the per-key gap exceeds
+    the timeout; open sessions are carried as partial rows across batches
+    (ts_executors.py:197 semantics, batched)."""
+
+    def __init__(self, time_col: str, keys: Sequence[str], window: SessionWindow,
+                 plan: AggPlan):
+        self.time_col = time_col
+        self.keys = list(keys)
+        self.timeout = window.timeout
+        self.plan = plan
+        self.open: Optional[DeviceBatch] = None  # partial rows of open sessions
+        self.watermark: Optional[float] = None
+
+    def _to_partial_rows(self, batch: DeviceBatch) -> DeviceBatch:
+        """Raw rows -> partial-agg rows (count=1 etc.) + first/last time."""
+        b = batch
+        for name, e in self.plan.pre:
+            b = b.with_column(name, evaluate_to_column(e, b))
+        t = b.columns[self.time_col].data
+        cols = {k: b.columns[k] for k in self.keys}
+        for pname, op, tmp in self.plan.partials:
+            if op == "count":
+                cols[pname] = NumCol(
+                    b.valid.astype(jnp.int32), "i"
+                )
+            else:
+                cols[pname] = b.columns[tmp]
+        cols["__first_t"] = NumCol(t, "i")
+        cols["__last_t"] = NumCol(t, "i")
+        return DeviceBatch(cols, b.valid, b.nrows, None)
+
+    def _sessionize(self, rows: DeviceBatch) -> DeviceBatch:
+        """Assign session ids over key+time-sorted partial rows and combine."""
+        s = kernels.sort_batch(rows, self.keys + ["__last_t"])
+        from quokka_tpu.ops.batch import key_limbs
+
+        limbs = key_limbs(s, self.keys) if self.keys else []
+        n = s.padded_len
+        iota = jnp.arange(n, dtype=jnp.int32)
+        key_changed = jnp.zeros(n, dtype=bool)
+        for l in limbs:
+            key_changed = key_changed | (l != jnp.roll(l, 1))
+        first_t = s.columns["__first_t"].data
+        last_t = s.columns["__last_t"].data
+        prev_last = jnp.roll(last_t, 1)
+        gap = first_t - prev_last
+        new_sess = (iota == 0) | key_changed | (gap > self.timeout)
+        sess_id = jnp.cumsum(new_sess.astype(jnp.int32)) - 1
+        s = s.with_column("__sess", NumCol(sess_id, "i"))
+        aggs = [(p, op, s.columns[p].data) for (p, op) in self.plan.recombine]
+        aggs += [("__first_t", "min", first_t), ("__last_t", "max", last_t)]
+        g = kernels.groupby_aggregate(s, self.keys + ["__sess"], aggs)
+        return kernels.compact(
+            g.select(self.keys + [p for p, _ in self.plan.recombine]
+                     + ["__first_t", "__last_t"])
+        )
+
+    def execute(self, batches, stream_id, channel):
+        parts = []
+        for b in batches:
+            if b is None or b.count_valid() == 0:
+                continue
+            self.watermark = _time_max(b, self.time_col)
+            parts.append(self._to_partial_rows(b))
+        if self.open is not None:
+            parts.append(self.open)
+        if not parts:
+            return None
+        merged = bridge.concat_batches(parts) if len(parts) > 1 else parts[0]
+        sessions = self._sessionize(merged)
+        if self.watermark is None:
+            self.open = sessions
+            return None
+        last = sessions.columns["__last_t"].data
+        closed = sessions.valid & (last < self.watermark - self.timeout)
+        ready = kernels.compact(kernels.apply_mask(sessions, closed))
+        rest = kernels.compact(kernels.apply_mask(sessions, sessions.valid & ~closed))
+        self.open = rest if rest.count_valid() > 0 else None
+        if ready.count_valid() == 0:
+            return None
+        return self._emit(ready)
+
+    def _emit(self, g: DeviceBatch) -> DeviceBatch:
+        g = g.rename({"__first_t": "session_start", "__last_t": "session_end"})
+        helper = _PartialWindowAgg(self.keys, self.plan, wid_col="session_start")
+        return helper.finalize(g, extra=["session_start", "session_end"])
+
+    def done(self, channel):
+        if self.open is None:
+            return None
+        out, self.open = self._emit(self.open), None
+        return out
+
+
+class SlidingWindowExecutor(Executor):
+    """Per-event trailing window [t - size, t] aggregates (groupby_rolling,
+    ts_executors.py:147).  Sum/count/avg via segmented prefix sums + a
+    vectorized lower-bound search; each batch needs the previous tail rows,
+    kept in state."""
+
+    def __init__(self, time_col: str, keys: Sequence[str], window: SlidingWindow,
+                 plan: AggPlan):
+        self.time_col = time_col
+        self.keys = list(keys)
+        self.size = window.size_before
+        self.plan = plan
+        for _, op, _ in plan.partials:
+            if op not in ("sum", "count"):
+                raise NotImplementedError(
+                    "sliding windows support sum/count/avg aggregates (min/max todo)"
+                )
+        self.tail: Optional[DeviceBatch] = None
+
+    def execute(self, batches, stream_id, channel):
+        outs = []
+        for b in batches:
+            if b is None or b.count_valid() == 0:
+                continue
+            out = self._process(b)
+            if out is not None:
+                outs.append(out)
+        if not outs:
+            return None
+        return bridge.concat_batches(outs) if len(outs) > 1 else outs[0]
+
+    def _process(self, batch: DeviceBatch) -> Optional[DeviceBatch]:
+        b = batch
+        for name, e in self.plan.pre:
+            b = b.with_column(name, evaluate_to_column(e, b))
+        b = b.with_column("__new", NumCol(jnp.ones(b.padded_len, dtype=jnp.bool_), "b"))
+        if self.tail is not None:
+            t0 = self.tail
+            t0 = t0.with_column(
+                "__new", NumCol(jnp.zeros(t0.padded_len, dtype=jnp.bool_), "b")
+            )
+            missing = [c for c in b.names if c not in t0.columns]
+            for c in missing:
+                col = b.columns[c]
+                if isinstance(col, NumCol):
+                    t0 = t0.with_column(
+                        c, NumCol(jnp.zeros(t0.padded_len, col.data.dtype), col.kind)
+                    )
+            merged = bridge.concat_batches([t0.select(b.names), b])
+        else:
+            merged = b
+        out = self._rolling(merged)
+        # new tail: rows within `size` of the max time
+        wm = _time_max(batch, self.time_col)
+        t = merged.columns[self.time_col].data
+        tail_mask = merged.valid & (t >= wm - self.size)
+        tail = kernels.compact(kernels.apply_mask(merged, tail_mask))
+        self.tail = tail.drop(["__new"]) if tail.count_valid() > 0 else None
+        return out
+
+    def _rolling(self, merged: DeviceBatch) -> Optional[DeviceBatch]:
+        s = kernels.sort_batch(merged, self.keys + [self.time_col])
+        from quokka_tpu.ops.batch import key_limbs
+
+        n = s.padded_len
+        iota = jnp.arange(n, dtype=jnp.int32)
+        limbs = key_limbs(s, self.keys) if self.keys else []
+        key_changed = jnp.zeros(n, dtype=bool)
+        for l in limbs:
+            key_changed = key_changed | (l != jnp.roll(l, 1))
+        seg_start_flag = key_changed | (iota == 0)
+        seg_start = asof_ops._seg_fill_forward(
+            jnp.where(seg_start_flag, iota, -1), seg_start_flag
+        )
+        t = s.columns[self.time_col].data
+        lo_t = t - self.size
+        # window rows within the key segment: [first time >= t-size, last time == t]
+        left = _bisect_left_segmented(t, lo_t, seg_start, iota)
+        n_total = s.padded_len
+        seg_end = iota + _rows_from_segment_end(iota, seg_start_flag, n_total)
+        right = _bisect_right_segmented(t, t, iota, seg_end)
+        outs = {}
+        for pname, op, tmp in self.plan.partials:
+            if op == "count":
+                x = s.valid.astype(jnp.float32 if not kernels.config.x64_enabled() else jnp.float64)
+            else:
+                x = jnp.where(s.valid, s.columns[tmp].data, 0)
+            cs = jnp.cumsum(x)
+            before = jnp.where(left > 0, cs[jnp.maximum(left - 1, 0)], 0)
+            outs[pname] = cs[right] - before
+        g = s
+        for pname in outs:
+            g = g.with_column(pname, NumCol(outs[pname], "f"))
+        for name, e in self.plan.finals:
+            g = g.with_column(name, evaluate_to_column(e, g))
+        only_new = kernels.apply_mask(g, g.valid & g.columns["__new"].data)
+        keep = [c for c in merged.names if c != "__new" and not c.startswith("__pre")]
+        keep += [nm for nm, _ in self.plan.finals if nm not in keep]
+        keep = [c for c in keep if c in g.columns and not c.startswith("__agg")]
+        return kernels.compact(only_new.select(keep))
+
+    def done(self, channel):
+        self.tail = None
+        return None
+
+
+class ShiftExecutor(Executor):
+    """Per-key lag: value of `columns` n rows earlier within the key partition
+    (orderedstream.py:13 shift).  Keeps the last n rows per key as carry."""
+
+    def __init__(self, time_col: str, keys: Sequence[str], columns: Sequence[str], n: int):
+        self.time_col = time_col
+        self.keys = list(keys)
+        self.columns = list(columns)
+        self.n = n
+        self.tail: Optional[DeviceBatch] = None
+
+    def execute(self, batches, stream_id, channel):
+        outs = []
+        for b in batches:
+            if b is None or b.count_valid() == 0:
+                continue
+            out = self._process(b)
+            if out is not None:
+                outs.append(out)
+        if not outs:
+            return None
+        return bridge.concat_batches(outs) if len(outs) > 1 else outs[0]
+
+    def _process(self, batch: DeviceBatch) -> Optional[DeviceBatch]:
+        b = batch.with_column(
+            "__new", NumCol(jnp.ones(batch.padded_len, dtype=jnp.bool_), "b")
+        )
+        if self.tail is not None:
+            t0 = self.tail.with_column(
+                "__new", NumCol(jnp.zeros(self.tail.padded_len, dtype=jnp.bool_), "b")
+            )
+            merged = bridge.concat_batches([t0.select(b.names), b])
+        else:
+            merged = b
+        s = kernels.sort_batch(merged, self.keys + [self.time_col])
+        from quokka_tpu.ops.batch import key_limbs
+
+        n = s.padded_len
+        iota = jnp.arange(n, dtype=jnp.int32)
+        limbs = key_limbs(s, self.keys) if self.keys else []
+        key_changed = jnp.zeros(n, dtype=bool)
+        for l in limbs:
+            key_changed = key_changed | (l != jnp.roll(l, 1))
+        seg_start_flag = key_changed | (iota == 0)
+        seg_start = asof_ops._seg_fill_forward(
+            jnp.where(seg_start_flag, iota, -1), seg_start_flag
+        )
+        src = iota - self.n
+        ok = src >= seg_start
+        src = jnp.clip(src, 0, n - 1)
+        out = s
+        for c in self.columns:
+            col = s.columns[c]
+            taken = col.take(src)
+            if isinstance(taken, NumCol) and taken.kind == "f":
+                taken = NumCol(jnp.where(ok, taken.data, jnp.nan), "f")
+            out = out.with_column(f"{c}_shifted_{self.n}", taken)
+        # keep last n rows per key as the next batch's carry
+        rank_from_end = _rows_from_segment_end(iota, seg_start_flag, n)
+        tail_mask = s.valid & (rank_from_end < self.n)
+        tail = kernels.compact(kernels.apply_mask(s, tail_mask))
+        self.tail = tail.select(batch.names) if tail.count_valid() > 0 else None
+        only_new = kernels.apply_mask(out, out.valid & out.columns["__new"].data)
+        keep = [c for c in out.names if not c.startswith("__")]
+        return kernels.compact(only_new.select(keep))
+
+
+def _rows_from_segment_end(iota, seg_start_flag, n):
+    """Distance from each row to its segment's last row (0 = last).  The
+    segment end is (next start strictly after i) - 1, found with a suffix-min
+    scan over start indices."""
+    import jax
+
+    starts_idx = jnp.where(seg_start_flag, iota, n)
+    suffix_min = jnp.flip(jax.lax.associative_scan(jnp.minimum, jnp.flip(starts_idx)))
+    after = jnp.concatenate([suffix_min[1:], jnp.array([n], dtype=suffix_min.dtype)])
+    seg_end = after - 1
+    return seg_end - iota
+
+
+def _bisect_left_segmented(times, targets, seg_start, iota):
+    """For each i: smallest j in [seg_start[i], i] with times[j] >= targets[i]
+    (times sorted within segments)."""
+    import jax
+
+    lo = seg_start
+    hi = iota
+
+    def body(_, carry):
+        lo, hi = carry
+        mid = (lo + hi) // 2
+        go_right = times[mid] < targets[iota]
+        lo = jnp.where(go_right, mid + 1, lo)
+        hi = jnp.where(go_right, hi, mid)
+        return lo, hi
+
+    lo, hi = jax.lax.fori_loop(0, 32, body, (lo, hi))
+    return lo
+
+
+def _bisect_right_segmented(times, targets, iota, seg_end):
+    """For each i: largest j in [i, seg_end[i]] with times[j] <= targets[i]."""
+    import jax
+
+    lo = iota
+    hi = seg_end
+
+    def body(_, carry):
+        lo, hi = carry
+        # find first j with times[j] > target, then step back
+        mid = (lo + hi + 1) // 2
+        le = times[jnp.clip(mid, 0, times.shape[0] - 1)] <= targets[iota]
+        lo = jnp.where(le, mid, lo)
+        hi = jnp.where(le, hi, mid - 1)
+        return lo, hi
+
+    lo, hi = jax.lax.fori_loop(0, 32, body, (lo, hi))
+    return lo

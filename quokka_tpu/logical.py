@@ -46,14 +46,31 @@ class SourceNode(Node):
         super().__init__([], schema)
         self.reader = reader
         self.sorted_by = sorted_by
+        self.predicate: Optional[Expr] = None  # pushed-down filter
+        self.projection: Optional[List[str]] = None  # pushed-down column set
 
     def lower(self, ctx, graph, actor_of, node_id):
+        reader = self.reader
+        if self.predicate is not None and hasattr(reader, "predicate"):
+            reader.predicate = self.predicate  # row-group pruning
+        if self.projection is not None and hasattr(reader, "columns"):
+            reader.columns = list(self.projection)
         actor_of[node_id] = graph.new_input_reader_node(
-            self.reader, self.channels or ctx.io_channels, self.stage, self.sorted_by
+            reader,
+            self.channels or ctx.io_channels,
+            self.stage,
+            self.sorted_by,
+            predicate=self.predicate,
+            projection=self.projection,
         )
 
     def describe(self):
-        return f"Source({type(self.reader).__name__})"
+        d = f"Source({type(self.reader).__name__}"
+        if self.predicate is not None:
+            d += f", filter={self.predicate.sql()}"
+        if self.projection is not None:
+            d += f", cols={self.projection}"
+        return d + ")"
 
 
 def _passthrough_edge():
@@ -67,15 +84,19 @@ class FilterNode(Node):
 
     def lower(self, ctx, graph, actor_of, node_id):
         from quokka_tpu.executors.sql_execs import UDFExecutor
+        from quokka_tpu.ops.fuse import FusedPredicate
 
         pred = self.predicate
+
+        def factory():
+            return UDFExecutor(FusedPredicate(pred))
+
         actor_of[node_id] = graph.new_exec_node(
-            lambda: UDFExecutor(
-                lambda b: kernels.apply_mask(b, evaluate_predicate(pred, b))
-            ),
+            factory,
             {0: (actor_of[self.parents[0]], _passthrough_edge())},
             self.channels or ctx.exec_channels,
             self.stage,
+            sorted_actor=self.sorted_by is not None,
         )
 
     def describe(self):
@@ -95,6 +116,7 @@ class ProjectionNode(Node):
             {0: (actor_of[self.parents[0]], _passthrough_edge())},
             self.channels or ctx.exec_channels,
             self.stage,
+            sorted_actor=self.sorted_by is not None,
         )
 
     def describe(self):
@@ -119,6 +141,7 @@ class MapNode(Node):
             {0: (actor_of[self.parents[0]], _passthrough_edge())},
             self.channels or ctx.exec_channels,
             self.stage,
+            sorted_actor=self.sorted_by is not None,
         )
 
     def describe(self):
@@ -156,19 +179,24 @@ class StatefulNode(Node):
 class JoinNode(Node):
     """Binary hash join; parents[0] = probe (stream 0), parents[1] = build."""
 
-    def __init__(self, parents, schema, left_on, right_on, how="inner", suffix="_2", broadcast=False):
+    def __init__(self, parents, schema, left_on, right_on, how="inner", suffix="_2",
+                 broadcast=False, rename=None):
         super().__init__(parents, schema)
         self.left_on = list(left_on)
         self.right_on = list(right_on)
         self.how = how
         self.suffix = suffix
         self.broadcast = broadcast
+        # plan-time build-column renames (so runtime behavior is stable even
+        # when the optimizer prunes the clashing probe column)
+        self.rename = rename
         self.build_parents = [1]
 
     def lower(self, ctx, graph, actor_of, node_id):
         from quokka_tpu.executors.sql_execs import BuildProbeJoinExecutor
 
         left_on, right_on, how, suffix = self.left_on, self.right_on, self.how, self.suffix
+        rename = self.rename
         if self.broadcast:
             edges = {
                 0: (actor_of[self.parents[0]], _passthrough_edge()),
@@ -180,7 +208,7 @@ class JoinNode(Node):
                 1: (actor_of[self.parents[1]], TargetInfo(HashPartitioner(right_on))),
             }
         actor_of[node_id] = graph.new_exec_node(
-            lambda: BuildProbeJoinExecutor(left_on, right_on, how, suffix),
+            lambda: BuildProbeJoinExecutor(left_on, right_on, how, suffix, rename),
             edges,
             self.channels or ctx.exec_channels,
             self.stage,
@@ -310,8 +338,18 @@ class SinkNode(Node):
     def lower(self, ctx, graph, actor_of, node_id):
         from quokka_tpu.executors.sql_execs import StorageExecutor
 
+        schema = list(self.schema)
+
+        class _SelectingStorage(StorageExecutor):
+            def execute(self, batches, stream_id, channel):
+                out = StorageExecutor.execute(self, batches, stream_id, channel)
+                if out is None:
+                    return None
+                keep = [c for c in schema if c in out.columns]
+                return out.select(keep)
+
         actor_of[node_id] = graph.new_exec_node(
-            StorageExecutor,
+            _SelectingStorage,
             {0: (actor_of[self.parents[0]], _passthrough_edge())},
             1,
             self.stage,

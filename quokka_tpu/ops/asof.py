@@ -1,0 +1,113 @@
+"""As-of join kernel.
+
+The reference's SortedAsofExecutor walks trade/quote frontiers sequentially
+per batch (pyquokka/executors/ts_executors.py:324-383).  The TPU formulation is
+data-parallel: concatenate both sides, sort once by (key, time, side), then a
+segmented fill-forward scan (jax.lax.associative_scan) carries the most recent
+quote position within each key segment onto every trade row.  One sort + one
+log-depth scan — no sequential loop.
+
+Direction 'backward' matches quotes with time <= trade time (quotes sort before
+trades on ties); 'forward' is the mirror (run on negated times).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from quokka_tpu.ops import kernels
+from quokka_tpu.ops.batch import DeviceBatch, NumCol, StrCol, key_limbs
+from quokka_tpu.ops.kernels import dense_rank
+
+
+def _seg_fill_forward(values: jax.Array, seg_start: jax.Array) -> jax.Array:
+    """Within each segment (seg_start marks first element), running max of
+    `values` — used to propagate the latest quote position forward."""
+
+    def combine(a, b):
+        av, as_ = a
+        bv, bs = b
+        v = jnp.where(bs, bv, jnp.maximum(av, bv))
+        return v, as_ | bs
+
+    out, _ = lax.associative_scan(combine, (values, seg_start))
+    return out
+
+
+@functools.partial(jax.jit, static_argnames=("t",))
+def _asof_match(limbs: Tuple[jax.Array, ...], times: jax.Array, is_trade: jax.Array,
+                valid: jax.Array, t: int):
+    """Returns per-trade-row (quote_row_idx, matched) for backward asof.
+    Arrays are the concatenation [trades | quotes]; `t` = trade padded len."""
+    n = valid.shape[0]
+    ranks, _ = dense_rank(limbs, valid)
+    iota = jnp.arange(n, dtype=jnp.int32)
+    inv = (~valid).astype(jnp.int32)
+    # sort by (validity, key rank, time, side): quotes (0) before trades (1)
+    # at equal times -> backward asof includes same-timestamp quotes
+    side = is_trade.astype(jnp.int32)
+    sorted_ops = lax.sort([inv, ranks, times, side, iota], num_keys=4)
+    perm = sorted_ops[-1]
+    valid_s = sorted_ops[0] == 0
+    ranks_s = sorted_ops[1]
+    side_s = sorted_ops[3]
+    seg_start = (ranks_s != jnp.roll(ranks_s, 1)) | (iota == 0)
+    quote_pos = jnp.where(valid_s & (side_s == 0), iota, -1)
+    last_quote_pos = _seg_fill_forward(quote_pos, seg_start)
+    # for each sorted position, the original row of the latest quote <= here
+    quote_orig = perm[jnp.clip(last_quote_pos, 0, n - 1)]
+    matched_s = valid_s & (side_s == 1) & (last_quote_pos >= 0)
+    # scatter back to original (concat) positions
+    match_orig = jnp.zeros(n, dtype=jnp.int32).at[perm].set(quote_orig)
+    matched = jnp.zeros(n, dtype=bool).at[perm].set(matched_s)
+    return match_orig[:t], matched[:t]
+
+
+def asof_join(
+    trades: DeviceBatch,
+    quotes: DeviceBatch,
+    left_on: str,
+    right_on: str,
+    left_by: Sequence[str],
+    right_by: Sequence[str],
+    payload: Sequence[str],
+    direction: str = "backward",
+) -> DeviceBatch:
+    """Probe-aligned asof join: each valid trade row gains the payload of its
+    most recent quote (per key).  Unmatched trades keep NaN/zero payload and a
+    false mask is NOT applied (matches polars join_asof semantics: unmatched
+    rows survive with null payload — floats become NaN)."""
+    t = trades.padded_len
+    lt = key_limbs(trades, list(left_by)) if left_by else []
+    lq = key_limbs(quotes, list(right_by)) if right_by else []
+    if left_by:
+        limbs = [jnp.concatenate([a, b.astype(a.dtype)]) for a, b in zip(lt, lq)]
+    else:
+        limbs = [jnp.zeros(t + quotes.padded_len, dtype=jnp.int32)]
+    t_time = trades.columns[left_on].data
+    q_time = quotes.columns[right_on].data
+    if direction == "forward":
+        t_time, q_time = -t_time, -q_time
+    elif direction != "backward":
+        raise ValueError(direction)
+    times = jnp.concatenate([t_time, q_time.astype(t_time.dtype)])
+    is_trade = jnp.concatenate(
+        [jnp.ones(t, dtype=bool), jnp.zeros(quotes.padded_len, dtype=bool)]
+    )
+    valid = jnp.concatenate([trades.valid, quotes.valid])
+    match_orig, matched = _asof_match(tuple(limbs), times, is_trade, valid, t)
+    quote_idx = jnp.clip(match_orig - t, 0, quotes.padded_len - 1)
+    cols = dict(trades.columns)
+    for name in payload:
+        c = quotes.columns[name]
+        taken = c.take(quote_idx)
+        if isinstance(taken, NumCol) and taken.kind == "f":
+            taken = NumCol(jnp.where(matched, taken.data, jnp.nan), "f")
+        cols[name] = taken
+    cols["__asof_matched__"] = NumCol(matched, "b")
+    return DeviceBatch(cols, trades.valid, trades.nrows, trades.sorted_by)

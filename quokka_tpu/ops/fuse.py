@@ -1,0 +1,311 @@
+"""Whole-pipeline fusion: run a batch's expression+aggregate work as ONE
+jitted XLA program.
+
+Why: per-op jit dispatch costs dominate on TPU (each call is a host->device
+round trip; over a remote runtime each is milliseconds).  XLA wants one big
+program it can fuse (SURVEY.md build plan: "let XLA fuse — don't hand-schedule").
+
+Two-phase design:
+- HOST PREPASS (per batch): anything that depends on string dictionary VALUES
+  (LIKE/contains/equality masks, in-lists, string transforms) is evaluated
+  once over the (small) dictionary and gathered by code into a device array,
+  which becomes an extra input column.  The expression tree is rewritten to
+  reference these bound columns.  Key string columns contribute their hash
+  limb arrays the same way.
+- TRACED PHASE: the rewritten, now purely-numeric expression graph plus the
+  sort/segment group-by runs inside a single jit, cached per
+  (padded_len, column signature, plan id).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from quokka_tpu.expression import (
+    Agg,
+    Alias,
+    BinOp,
+    Case,
+    Cast,
+    ColRef,
+    DateLit,
+    DtField,
+    Expr,
+    Func,
+    InList,
+    IntervalLit,
+    IsNull,
+    Literal,
+    StrOp,
+    UnaryOp,
+    _rebuild,
+)
+from quokka_tpu.ops import expr_compile, kernels
+from quokka_tpu.ops.batch import DeviceBatch, NumCol, StrCol
+
+
+def _is_string_dependent(e: Expr, batch: DeviceBatch) -> bool:
+    """Does evaluating e require dictionary VALUES (host data)?"""
+    if isinstance(e, (StrOp,)):
+        return True
+    if isinstance(e, InList):
+        return _refs_string(e.expr, batch)
+    if isinstance(e, IsNull):
+        return _refs_string(e.expr, batch)
+    if isinstance(e, BinOp) and e.op in ("=", "!=", "<", "<=", ">", ">="):
+        if _refs_string(e.left, batch) or _refs_string(e.right, batch):
+            return True
+    return False
+
+
+def _refs_string(e: Expr, batch: DeviceBatch) -> bool:
+    if isinstance(e, ColRef):
+        return isinstance(batch.columns.get(e.name), StrCol)
+    if isinstance(e, Literal):
+        return isinstance(e.value, str)
+    return any(_refs_string(c, batch) for c in e.children())
+
+
+class Prepass:
+    """Rewrites expressions against a concrete batch: string-dependent
+    subtrees are evaluated NOW (host dict work + one gather) and replaced by
+    references to bound device columns."""
+
+    def __init__(self, batch: DeviceBatch):
+        self.batch = batch
+        self.bound: Dict[str, jnp.ndarray] = {}
+        self._memo: Dict[str, str] = {}
+
+    def rewrite(self, e: Expr) -> Expr:
+        if isinstance(e, Alias):
+            return Alias(self.rewrite(e.expr), e.name)
+        if _is_string_dependent(e, self.batch):
+            return ColRef(self._bind(e))
+        kids = e.children()
+        if not kids:
+            return e
+        return _rebuild(e, [self.rewrite(k) for k in kids])
+
+    def _bind(self, e: Expr) -> str:
+        key = e.sql()
+        if key in self._memo:
+            return self._memo[key]
+        col = expr_compile.evaluate_to_column(e, self.batch)
+        if isinstance(col, StrCol):
+            # string-valued transform: bind its hash limbs? not needed for
+            # numeric pipelines; fall back to codes (equality-safe only within
+            # this batch) — callers needing more go through the unfused path
+            raise expr_compile.CompileError("string-valued expr in fused pipeline")
+        name = f"__b{len(self.bound)}"
+        self.bound[name] = col.data
+        self._memo[key] = name
+        return name
+
+
+class _ShimBatch:
+    """Duck-typed DeviceBatch over traced arrays for expr_compile.evaluate."""
+
+    def __init__(self, columns: Dict[str, object], padded_len: int, valid):
+        self.columns = columns
+        self._padded = padded_len
+        self.valid = valid
+
+    @property
+    def padded_len(self):
+        return self._padded
+
+    @property
+    def names(self):
+        return list(self.columns.keys())
+
+
+def _signature(batch: DeviceBatch, names: Sequence[str]) -> Tuple:
+    sig = [batch.padded_len]
+    for n in names:
+        c = batch.columns[n]
+        if isinstance(c, StrCol):
+            sig.append((n, "str"))
+        else:
+            sig.append((n, c.kind, str(c.data.dtype), c.hi is not None))
+    return tuple(sig)
+
+
+# Fused programs are cached GLOBALLY by full structural signature so separate
+# executor instances (and separate queries) reuse the same jitted callable —
+# jax's trace cache is keyed by function identity, so per-instance closures
+# would recompile on every query.
+_FUSED_PROGRAMS: Dict[Tuple, object] = {}
+
+
+class FusedPartialAgg:
+    """One-jit partial group-by-aggregate: pre-expressions + dense-rank +
+    segment reduces, compiled per (batch signature)."""
+
+    def __init__(self, keys: List[str], plan):
+        self.keys = keys
+        self.plan = plan
+        self._cache = _FUSED_PROGRAMS
+
+    def __call__(self, batch: DeviceBatch) -> DeviceBatch:
+        pre = Prepass(batch)
+        pre_exprs = [(name, pre.rewrite(e)) for name, e in self.plan.pre]
+        # inputs: numeric columns referenced + bound columns + key limbs
+        needed = set()
+        for _, e in pre_exprs:
+            needed |= e.required_columns()
+        num_inputs = {}
+        for n in sorted(needed):
+            c = batch.columns.get(n)
+            if c is None:
+                continue  # bound column
+            assert isinstance(c, NumCol), n
+            num_inputs[n] = c
+        key_limbs: List[jnp.ndarray] = []
+        for k in self.keys:
+            c = batch.columns[k]
+            if isinstance(c, StrCol):
+                # within one batch, dictionary codes ARE the key identity:
+                # one limb instead of two hash limbs (cross-batch identity is
+                # restored at recombine time via hash limbs on the small
+                # partial batches)
+                key_limbs.append(c.codes)
+            else:
+                if c.hi is not None:
+                    key_limbs.append(c.hi)
+                key_limbs.append(c.data)
+        sig = (
+            "partial_agg",
+            _signature(batch, list(num_inputs)),
+            tuple(sorted(pre.bound)),
+            tuple(str(l.dtype) for l in key_limbs),
+            tuple((n, e.sql()) for n, e in pre_exprs),
+            tuple((p, op, tmp) for p, op, tmp in self.plan.partials),
+            bool(self.keys),
+        )
+        fn = self._cache.get(sig)
+        if fn is None:
+            fn = self._build(pre_exprs, list(num_inputs), sorted(pre.bound), len(key_limbs))
+            self._cache[sig] = fn
+        hi_arrays = tuple(
+            c.hi if c.hi is not None else jnp.zeros(0, jnp.int32) for c in num_inputs.values()
+        )
+        outs = fn(
+            tuple(c.data for c in num_inputs.values()),
+            hi_arrays,
+            tuple(pre.bound[k] for k in sorted(pre.bound)),
+            tuple(key_limbs),
+            batch.valid,
+        )
+        *agg_arrays, rep, num = outs
+        cols = {}
+        for k in self.keys:
+            cols[k] = batch.columns[k].take(rep)
+        for (pname, _, _), arr in zip(self.plan.partials, agg_arrays):
+            cols[pname] = NumCol(
+                arr, "f" if jnp.issubdtype(arr.dtype, jnp.floating) else "i"
+            )
+        gvalid = jnp.arange(batch.padded_len) < num
+        return DeviceBatch(cols, gvalid, None, None)
+
+    def _build(self, pre_exprs, num_names, bound_names, n_limbs):
+        plan = self.plan
+        has_keys = bool(self.keys)
+
+        @jax.jit
+        def fused(num_arrays, hi_arrays, bound_arrays, limbs, valid):
+            n = valid.shape[0]
+            cols = {}
+            for name, arr, hi in zip(num_names, num_arrays, hi_arrays):
+                cols[name] = NumCol(arr, _infer_kind(arr), hi=hi if hi.shape[0] else None)
+            for name, arr in zip(bound_names, bound_arrays):
+                cols[name] = NumCol(arr, _infer_kind(arr))
+            shim = _ShimBatch(cols, n, valid)
+            pre_cols = {}
+            for name, e in pre_exprs:
+                pre_cols[name] = expr_compile.evaluate_to_column(e, shim)
+            arrays = tuple(
+                pre_cols[tmp].data if tmp is not None else jnp.zeros(n, jnp.int32)
+                for (_, _, tmp) in plan.partials
+            )
+            ops = tuple(op for (_, op, _) in plan.partials)
+            if has_keys:
+                outs, counts, rep, num = kernels.sorted_groupby(
+                    tuple(limbs), arrays, ops, valid
+                )
+            else:
+                ranks = jnp.zeros(n, dtype=jnp.int32)
+                num = jnp.minimum(jnp.sum(valid), 1).astype(jnp.int32)
+                outs, counts, rep = kernels._segment_aggs(ranks, valid, arrays, ops)
+            return (*outs, rep, num)
+
+        return fused
+
+
+def _infer_kind(arr):
+    if arr.dtype == jnp.bool_:
+        return "b"
+    if jnp.issubdtype(arr.dtype, jnp.floating):
+        return "f"
+    return "i"
+
+
+class FusedPredicate:
+    """One-jit filter mask evaluation (plus prepass-bound string masks)."""
+
+    def __init__(self, expr: Expr):
+        self.expr = expr
+        self._cache = _FUSED_PROGRAMS
+
+    def __call__(self, batch: DeviceBatch) -> DeviceBatch:
+        pre = Prepass(batch)
+        try:
+            e = pre.rewrite(self.expr)
+        except expr_compile.CompileError:
+            mask = expr_compile.evaluate_predicate(self.expr, batch)
+            return kernels.apply_mask(batch, mask)
+        needed = sorted(
+            n for n in e.required_columns() if n in batch.columns
+        )
+        num_inputs = {}
+        ok = True
+        for n in needed:
+            c = batch.columns[n]
+            if not isinstance(c, NumCol) or c.hi is not None:
+                ok = False
+                break
+            num_inputs[n] = c
+        if not ok:
+            mask = expr_compile.evaluate_predicate(self.expr, batch)
+            return kernels.apply_mask(batch, mask)
+        sig = (
+            "predicate",
+            _signature(batch, list(num_inputs)),
+            tuple(sorted(pre.bound)),
+            e.sql(),
+        )
+        fn = self._cache.get(sig)
+        if fn is None:
+            names, bnames = list(num_inputs), sorted(pre.bound)
+
+            @jax.jit
+            def fused(arrays, barrays, valid):
+                cols = {}
+                for name, arr in zip(names, arrays):
+                    cols[name] = NumCol(arr, _infer_kind(arr))
+                for name, arr in zip(bnames, barrays):
+                    cols[name] = NumCol(arr, _infer_kind(arr))
+                shim = _ShimBatch(cols, valid.shape[0], valid)
+                return valid & expr_compile.evaluate_predicate(e, shim)
+
+            fn = fused
+            self._cache[sig] = fn
+        mask = fn(
+            tuple(num_inputs[n].data for n in num_inputs),
+            tuple(pre.bound[k] for k in sorted(pre.bound)),
+            batch.valid,
+        )
+        return DeviceBatch(batch.columns, mask, None, batch.sorted_by)

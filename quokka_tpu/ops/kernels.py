@@ -138,6 +138,71 @@ AGG_OPS = ("sum", "count", "min", "max", "mean", "first")
 
 
 @functools.partial(jax.jit, static_argnames=("ops",))
+def sorted_groupby(limbs: Tuple[jax.Array, ...], arrays: Tuple[jax.Array, ...],
+                   ops: Tuple[str, ...], valid: jax.Array):
+    """Group-by-aggregate in sorted segment order.
+
+    One multi-operand sort, then segment reductions over CONTIGUOUS segments
+    (indices_are_sorted=True) — this avoids random-order scatter-adds, which
+    serialize badly on TPU.  Returns (agg_outputs, counts, rep_indices, num):
+    outputs indexed by dense rank, `rep` maps rank -> an original row index
+    holding the group's key values."""
+    n = valid.shape[0]
+    iota = jnp.arange(n, dtype=jnp.int32)
+    inv = (~valid).astype(jnp.int32)
+    sorted_ops = lax.sort([inv, *limbs, iota], num_keys=1 + len(limbs))
+    perm = sorted_ops[-1]
+    valid_s = sorted_ops[0] == 0
+    changed = jnp.zeros(n, dtype=bool)
+    for limb_sorted in sorted_ops[1:-1]:
+        changed = changed | (limb_sorted != jnp.roll(limb_sorted, 1))
+    starts = valid_s & (changed | (iota == 0))
+    ranks_sorted = jnp.maximum(jnp.cumsum(starts.astype(jnp.int32)) - 1, 0)
+    num = jnp.max(jnp.where(valid_s, ranks_sorted, -1)) + 1
+    counts = jax.ops.segment_sum(
+        valid_s.astype(jnp.int32), ranks_sorted, num_segments=n, indices_are_sorted=True
+    )
+    rep = jax.ops.segment_min(
+        jnp.where(valid_s, perm, n - 1), ranks_sorted, num_segments=n,
+        indices_are_sorted=True,
+    )
+    outs = []
+    for arr, op in zip(arrays, ops):
+        arr_s = arr[perm]
+        if op == "count":
+            if jnp.issubdtype(arr.dtype, jnp.floating):
+                c = jax.ops.segment_sum(
+                    (valid_s & ~jnp.isnan(arr_s)).astype(jnp.int32),
+                    ranks_sorted, num_segments=n, indices_are_sorted=True,
+                )
+            else:
+                c = counts
+            outs.append(c)
+        elif op == "sum":
+            x = jnp.where(valid_s, arr_s, jnp.zeros((), arr.dtype))
+            outs.append(jax.ops.segment_sum(x, ranks_sorted, num_segments=n,
+                                            indices_are_sorted=True))
+        elif op == "mean":
+            x = jnp.where(valid_s, arr_s, jnp.zeros((), arr.dtype))
+            s = jax.ops.segment_sum(x, ranks_sorted, num_segments=n,
+                                    indices_are_sorted=True)
+            outs.append(s / jnp.maximum(counts, 1).astype(s.dtype))
+        elif op == "min":
+            x = jnp.where(valid_s, arr_s, _max_sentinel(arr.dtype))
+            outs.append(jax.ops.segment_min(x, ranks_sorted, num_segments=n,
+                                            indices_are_sorted=True))
+        elif op == "max":
+            x = jnp.where(valid_s, arr_s, _min_sentinel(arr.dtype))
+            outs.append(jax.ops.segment_max(x, ranks_sorted, num_segments=n,
+                                            indices_are_sorted=True))
+        elif op == "first":
+            outs.append(arr[rep])
+        else:
+            raise ValueError(f"unknown agg {op}")
+    return tuple(outs), counts, rep, num
+
+
+@functools.partial(jax.jit, static_argnames=("ops",))
 def _segment_aggs(ranks, valid, arrays: Tuple[jax.Array, ...], ops: Tuple[str, ...]):
     n = ranks.shape[0]
     outs = []
@@ -195,17 +260,17 @@ def groupby_aggregate(
     """aggs: list of (output_name, op, input_array_or_None_for_count).
     Returns a grouped batch (padded to input size; compact() to shrink)."""
     n = batch.padded_len
-    if keys:
-        limbs = key_limbs(batch, keys)
-        ranks, num = dense_rank(limbs, batch.valid)
-    else:
-        ranks = jnp.zeros(n, dtype=jnp.int32)
-        num = jnp.minimum(jnp.sum(batch.valid), 1).astype(jnp.int32)
     arrays = tuple(
         a if a is not None else jnp.zeros(n, dtype=jnp.int32) for (_, _, a) in aggs
     )
     ops = tuple(op for (_, op, _) in aggs)
-    outs, counts, rep = _segment_aggs(ranks, batch.valid, arrays, ops)
+    if keys:
+        limbs = key_limbs(batch, keys)
+        outs, counts, rep, num = sorted_groupby(tuple(limbs), arrays, ops, batch.valid)
+    else:
+        ranks = jnp.zeros(n, dtype=jnp.int32)
+        num = jnp.minimum(jnp.sum(batch.valid), 1).astype(jnp.int32)
+        outs, counts, rep = _segment_aggs(ranks, batch.valid, arrays, ops)
     cols = {}
     for k in keys:
         cols[k] = batch.columns[k].take(rep)

@@ -1,19 +1,295 @@
 """Logical-plan optimizer.
 
-Pass lineup mirrors the reference driver (pyquokka/df.py:887-907): ANN
-pushdown, predicate pushdown, early projection, map folding, join merge with
-cardinality ordering, cardinality propagation, stage determination (stage
-assignment lives in context._assign_stages).  Passes land incrementally; each
-is a pure rewrite of the node dict.
+Pass lineup mirrors the reference driver (pyquokka/df.py:887-907):
+  1. push_filters      — predicate pushdown per CNF conjunct, through
+                         projections/maps/joins down into source readers
+                         (df.py:1029-1139 + parquet pushdown)
+  2. early_projection  — column-requirement analysis; prunes the column set
+                         each source actually reads (df.py:1141-1262)
+  3. choose_broadcast  — catalog-estimated small build sides switch their
+                         shuffle join to a broadcast join (the cardinality
+                         role of df.py:1401-1513's join ordering)
+Stage assignment (df.py:1530-1621) runs afterwards in context._assign_stages.
+All passes are pure rewrites of the node dict; unreachable nodes are simply
+never lowered.
 """
 
 from __future__ import annotations
 
-from typing import Dict
+from typing import Dict, List, Optional, Set
 
 from quokka_tpu import logical
+from quokka_tpu.expression import Expr, conjoin, rename_columns, split_conjuncts, substitute_columns
+
+BROADCAST_THRESHOLD = 65_536  # build rows below this skip the probe-side shuffle
 
 
 def optimize(sub: Dict[int, logical.Node], sink_id: int) -> int:
-    """Rewrite the plan in place; returns the (possibly new) sink id."""
+    push_filters(sub, sink_id)
+    early_projection(sub, sink_id)
+    choose_broadcast(sub, sink_id)
     return sink_id
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+
+def _consumers(sub: Dict[int, logical.Node], sink_id: int) -> Dict[int, List[int]]:
+    cons: Dict[int, List[int]] = {nid: [] for nid in _reachable(sub, sink_id)}
+    for nid in list(cons):
+        for p in sub[nid].parents:
+            cons[p].append(nid)
+    return cons
+
+
+def _reachable(sub: Dict[int, logical.Node], sink_id: int) -> List[int]:
+    out, seen = [], set()
+
+    def rec(nid):
+        if nid in seen:
+            return
+        seen.add(nid)
+        for p in sub[nid].parents:
+            rec(p)
+        out.append(nid)
+
+    rec(sink_id)
+    return out
+
+
+def _relink(sub, sink_id, old: int, new: int) -> None:
+    """Point every consumer of `old` at `new` (removing `old` from the plan)."""
+    for nid in _reachable(sub, sink_id):
+        node = sub[nid]
+        node.parents = [new if p == old else p for p in node.parents]
+
+
+# ---------------------------------------------------------------------------
+# 1. predicate pushdown
+# ---------------------------------------------------------------------------
+
+
+def push_filters(sub: Dict[int, logical.Node], sink_id: int) -> None:
+    changed = True
+    rounds = 0
+    while changed and rounds < 100:
+        changed = False
+        rounds += 1
+        for nid in _reachable(sub, sink_id):
+            node = sub.get(nid)
+            if not isinstance(node, logical.FilterNode):
+                continue
+            cons = _consumers(sub, sink_id)
+            if not cons.get(nid):
+                continue  # a root filter cannot be removed after its push
+            parent = sub[node.parents[0]]
+            if _try_push_one(sub, sink_id, nid, node, node.parents[0], parent, cons):
+                changed = True
+                break
+
+
+def _try_push_one(sub, sink_id, fid, fnode, pid, parent, cons) -> bool:
+    pred = fnode.predicate
+    parent_shared = len(cons.get(pid, [])) > 1
+
+    if isinstance(parent, logical.FilterNode):
+        parent_pred = parent.predicate
+        if parent_shared:
+            return False
+        fnode.predicate = conjoin([parent_pred, pred])
+        fnode.parents = list(parent.parents)
+        return True
+
+    if isinstance(parent, logical.SourceNode):
+        if parent_shared:
+            return False
+        parent.predicate = (
+            pred if parent.predicate is None else conjoin([parent.predicate, pred])
+        )
+        _relink(sub, sink_id, fid, pid)
+        return True
+
+    if isinstance(parent, (logical.ProjectionNode, logical.SortNode, logical.DistinctNode)):
+        if parent_shared:
+            return False
+        # swap: filter below, parent above
+        fnode.parents = list(parent.parents)
+        parent.parents = [fid]
+        _relink_except(sub, sink_id, fid, pid, skip=pid)
+        return True
+
+    if isinstance(parent, logical.MapNode) and parent.exprs is not None:
+        if parent_shared:
+            return False
+        new_pred = substitute_columns(pred, parent.exprs)
+        fnode.predicate = new_pred
+        fnode.parents = list(parent.parents)
+        parent.parents = [fid]
+        _relink_except(sub, sink_id, fid, pid, skip=pid)
+        return True
+
+    if isinstance(parent, logical.JoinNode):
+        left_schema = set(sub[parent.parents[0]].schema)
+        right = sub[parent.parents[1]]
+        rename = parent.rename or {}
+        unsuffix = {}
+        for c in right.schema:
+            if c in set(parent.right_on):
+                continue
+            unsuffix[rename.get(c, c)] = c
+        remaining = []
+        pushed = False
+        for conj in split_conjuncts(pred):
+            req = conj.required_columns()
+            if req <= left_schema and parent.how in ("inner", "left", "semi", "anti"):
+                _insert_filter_above(sub, parent, 0, conj)
+                pushed = True
+            elif req <= set(unsuffix) and parent.how == "inner":
+                _insert_filter_above(sub, parent, 1, rename_columns(conj, unsuffix))
+                pushed = True
+            else:
+                remaining.append(conj)
+        if not pushed:
+            return False
+        if remaining:
+            fnode.predicate = conjoin(remaining)
+        else:
+            _relink(sub, sink_id, fid, pid)
+        return True
+
+    return False
+
+
+def _relink_except(sub, sink_id, fid, pid, skip):
+    """After swapping filter below `pid`: consumers of fid (other than pid)
+    should now consume pid."""
+    for nid in _reachable(sub, sink_id):
+        if nid in (fid, skip):
+            continue
+        node = sub[nid]
+        node.parents = [pid if p == fid else p for p in node.parents]
+
+
+def _insert_filter_above(sub, join_node: logical.JoinNode, side: int, conj: Expr):
+    parent_id = join_node.parents[side]
+    new_id = max(sub) + 1
+    sub[new_id] = logical.FilterNode([parent_id], list(sub[parent_id].schema), conj)
+    join_node.parents[side] = new_id
+
+
+# ---------------------------------------------------------------------------
+# 2. early projection
+# ---------------------------------------------------------------------------
+
+
+def early_projection(sub: Dict[int, logical.Node], sink_id: int) -> None:
+    order = _reachable(sub, sink_id)
+    req: Dict[int, Set[str]] = {nid: set() for nid in order}
+    req[sink_id] = set(sub[sink_id].schema)
+    for nid in reversed(order):
+        node = sub[nid]
+        need = req[nid] | set()
+        if isinstance(node, logical.SinkNode):
+            need = set(node.schema)
+        for i, pid in enumerate(node.parents):
+            req[pid] |= _needed_from_parent(sub, node, i, need)
+    for nid in order:
+        node = sub[nid]
+        if isinstance(node, logical.SourceNode):
+            needed = [c for c in node.schema if c in req[nid]]
+            if node.predicate is not None:
+                pred_cols = node.predicate.required_columns()
+                needed = [c for c in node.schema if c in req[nid] or c in pred_cols]
+            if 0 < len(needed) < len(node.schema):
+                node.projection = needed
+                node.schema = needed
+
+
+def _needed_from_parent(sub, node: logical.Node, i: int, need: Set[str]) -> Set[str]:
+    parent_schema = set(sub[node.parents[i]].schema)
+    if isinstance(node, logical.FilterNode):
+        return (need | node.predicate.required_columns()) & parent_schema
+    if isinstance(node, logical.ProjectionNode):
+        return set(node.schema) & parent_schema
+    if isinstance(node, logical.MapNode):
+        if node.exprs is None:
+            return parent_schema  # opaque UDF: keep everything
+        out = set()
+        for c in need:
+            if c in node.exprs:
+                out |= node.exprs[c].required_columns()
+            else:
+                out.add(c)
+        return out & parent_schema
+    if isinstance(node, logical.AggNode):
+        out = set(node.keys)
+        for _, e in node.plan.pre:
+            out |= e.required_columns()
+        return out & parent_schema
+    if isinstance(node, logical.JoinNode):
+        if i == 0:
+            return ((need & parent_schema) | set(node.left_on)) & parent_schema
+        right = sub[node.parents[1]]
+        rename = node.rename or {}
+        out = set(node.right_on)
+        for c in right.schema:
+            if rename.get(c, c) in need:
+                out.add(c)
+        return out & parent_schema
+    if isinstance(node, (logical.SortNode, logical.TopKNode)):
+        return (need | set(node.by)) & parent_schema
+    if isinstance(node, logical.DistinctNode):
+        return set(node.keys) & parent_schema
+    if isinstance(node, logical.StatefulNode):
+        return parent_schema
+    return need & parent_schema if need else parent_schema
+
+
+# ---------------------------------------------------------------------------
+# 3. broadcast join selection
+# ---------------------------------------------------------------------------
+
+
+_CATALOG = None
+
+
+def choose_broadcast(sub: Dict[int, logical.Node], sink_id: int) -> None:
+    from quokka_tpu.catalog import Catalog
+
+    global _CATALOG
+    if _CATALOG is None:
+        _CATALOG = Catalog()
+    cat = _CATALOG
+    for nid in _reachable(sub, sink_id):
+        node = sub[nid]
+        if not isinstance(node, logical.JoinNode) or node.broadcast:
+            continue
+        if node.how not in ("inner", "semi", "anti", "left"):
+            continue
+        est = _estimate_subtree(sub, node.parents[1], cat)
+        if est is not None and est <= BROADCAST_THRESHOLD:
+            node.broadcast = True
+
+
+def _estimate_subtree(sub, nid: int, cat) -> Optional[float]:
+    """Estimate rows flowing out of a Filter/Projection/Map chain over one
+    source; None when the shape is more complex."""
+    node = sub[nid]
+    preds: List[Expr] = []
+    guard = 0
+    while guard < 64:
+        guard += 1
+        if isinstance(node, logical.SourceNode):
+            pred = conjoin(preds + ([node.predicate] if node.predicate is not None else []))
+            return cat.estimate_source(node.reader, pred)
+        if isinstance(node, logical.FilterNode):
+            preds.append(node.predicate)
+            node = sub[node.parents[0]]
+            continue
+        if isinstance(node, (logical.ProjectionNode, logical.MapNode)):
+            node = sub[node.parents[0]]
+            continue
+        return None
+    return None

@@ -35,6 +35,7 @@ from quokka_tpu.runtime.cache import BatchCache
 from quokka_tpu.runtime.dataset import ResultDataset
 from quokka_tpu.runtime.tables import ControlStore
 from quokka_tpu.runtime.task import ExecutorTask, TapedInputTask
+from quokka_tpu.utils import tracing
 from quokka_tpu.target_info import (
     BroadcastPartitioner,
     FunctionPartitioner,
@@ -58,6 +59,8 @@ class ActorInfo:
         self.source_streams: Dict[int, int] = {}  # src_actor -> stream_id
         self.blocking_dataset: Optional[ResultDataset] = None
         self.sorted_by: Optional[List[str]] = None
+        self.predicate = None  # pushed-down source filter (device mask post-read)
+        self.projection: Optional[List[str]] = None
 
 
 class TaskGraph:
@@ -79,11 +82,22 @@ class TaskGraph:
         return info
 
     def new_input_reader_node(
-        self, reader, channels: int, stage: int = 0, sorted_by: Optional[List[str]] = None
+        self,
+        reader,
+        channels: int,
+        stage: int = 0,
+        sorted_by: Optional[List[str]] = None,
+        predicate=None,
+        projection: Optional[List[str]] = None,
     ) -> int:
         info = self._new_actor("input", channels, stage, sorted_actor=sorted_by is not None)
         info.reader = reader
         info.sorted_by = sorted_by
+        if predicate is not None:
+            from quokka_tpu.ops.fuse import FusedPredicate
+
+            info.predicate = FusedPredicate(predicate)
+        info.projection = projection
         self.store.tset("FOT", info.id, reader)
         tapes = reader.get_own_state(channels)
         for ch in range(channels):
@@ -194,9 +208,15 @@ class Engine:
         n_tgt = self.g.actors[tgt_actor].channels
         part = tinfo.partitioner
 
+        fused_pred = None
+        if tinfo.predicate is not None:
+            from quokka_tpu.ops.fuse import FusedPredicate
+
+            fused_pred = FusedPredicate(tinfo.predicate)
+
         def fn(batch: DeviceBatch, src_ch: int) -> Dict[int, DeviceBatch]:
-            if tinfo.predicate is not None:
-                batch = kernels.apply_mask(batch, evaluate_predicate(tinfo.predicate, batch))
+            if fused_pred is not None:
+                batch = fused_pred(batch)
             for f in tinfo.batch_funcs:
                 batch = f(batch)
                 if batch is None:
@@ -256,9 +276,18 @@ class Engine:
             self.store.ntt_push(task.actor, task)
             return False
         lineage = self.store.tget("LT", (task.actor, task.channel, seq))
-        table = info.reader.execute(task.channel, lineage)
-        batch = bridge.arrow_to_device(table, sorted_by=info.sorted_by)
-        self.push(task.actor, task.channel, seq, batch)
+        with tracing.span("reader.execute"):
+            table = info.reader.execute(task.channel, lineage)
+        if info.projection is not None:
+            keep = [c for c in info.projection if c in table.column_names]
+            table = table.select(keep)
+        with tracing.span("bridge.to_device"):
+            batch = bridge.arrow_to_device(table, sorted_by=info.sorted_by)
+        if info.predicate is not None:
+            with tracing.span("source.predicate"):
+                batch = info.predicate(batch)
+        with tracing.span("push.input"):
+            self.push(task.actor, task.channel, seq, batch)
         with self.store.transaction():
             self.store.sadd("GIT", (task.actor, task.channel), seq)
         nxt = task.advance()
@@ -330,10 +359,12 @@ class Engine:
         src_actor, names = plan
         batches = [self.cache.get(n) for n in names]
         stream_id = info.source_streams[src_actor]
-        out = executor.execute(batches, stream_id, task.channel)
+        with tracing.span(f"exec.{type(executor).__name__}"):
+            out = executor.execute(batches, stream_id, task.channel)
         out_seq = task.out_seq
         if out is not None and out.count_valid() > 0:
-            self._emit(info, task.channel, out_seq, out)
+            with tracing.span("push.exec"):
+                self._emit(info, task.channel, out_seq, out)
             out_seq += 1
         consumed: Dict[int, Dict[int, int]] = {src_actor: {}}
         for (sa, sch, seq, *_rest) in names:

@@ -5,6 +5,7 @@ initializes (hence top-of-module, before any quokka_tpu import)."""
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["QUOKKA_JAX_CACHE_DIR"] = "0"  # persistent cache is for TPU runs only
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()

@@ -96,7 +96,8 @@ class TestBasics:
 
     def test_explain_runs(self, stream):
         txt = stream.filter(col("q") > 3).explain()
-        assert "Filter" in txt and "Source" in txt
+        # the optimizer pushes the root filter into the source
+        assert "Source" in txt and ("Filter" in txt or "filter=" in txt)
 
 
 class TestAggregations:

@@ -1,0 +1,177 @@
+"""Optimizer pass tests: pushdown reaches readers, projection prunes columns,
+broadcast selection fires, and optimized plans stay correct vs unoptimized."""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from quokka_tpu import QuokkaContext, col, date, logical
+from quokka_tpu.optimizer import optimize
+
+
+@pytest.fixture(scope="module")
+def pq_env(tmp_path_factory):
+    root = tmp_path_factory.mktemp("opt")
+    r = np.random.default_rng(11)
+    n = 20_000
+    fact = pa.table(
+        {
+            "k": r.integers(0, 100, n).astype(np.int64),
+            "x": r.normal(size=n),
+            "big": [f"payload-{i}" for i in range(n)],  # should get pruned
+            "d": pa.array(r.integers(8000, 12000, n).astype(np.int32), type=pa.int32()).cast(
+                pa.date32()
+            ),
+        }
+    )
+    dim = pa.table(
+        {
+            "k": np.arange(100, dtype=np.int64),
+            "name": [f"n{i}" for i in range(100)],
+        }
+    )
+    fp, dp = str(root / "fact.parquet"), str(root / "dim.parquet")
+    pq.write_table(fact, fp, row_group_size=2048)
+    pq.write_table(dim, dp)
+    return fp, dp, fact.to_pandas(), dim.to_pandas()
+
+
+def optimized_plan(stream):
+    ctx = stream.ctx
+    sub, _ = ctx._copy_subgraph(stream.node_id)
+    sink = logical.SinkNode([stream.node_id], sub[stream.node_id].schema)
+    sid = max(sub) + 1
+    sub[sid] = sink
+    optimize(sub, sid)
+    return sub, sid
+
+
+def find_nodes(sub, sid, cls):
+    from quokka_tpu.optimizer import _reachable
+
+    return [sub[n] for n in _reachable(sub, sid) if isinstance(sub[n], cls)]
+
+
+class TestPushdown:
+    def test_filter_reaches_source(self, pq_env):
+        fp, dp, fdf, ddf = pq_env
+        ctx = QuokkaContext()
+        q = ctx.read_parquet(fp).filter(col("k") > 50).filter(col("x") > 0)
+        sub, sid = optimized_plan(q)
+        srcs = find_nodes(sub, sid, logical.SourceNode)
+        assert len(srcs) == 1
+        assert srcs[0].predicate is not None
+        assert not find_nodes(sub, sid, logical.FilterNode)
+
+    def test_filter_pushes_through_join(self, pq_env):
+        fp, dp, fdf, ddf = pq_env
+        ctx = QuokkaContext()
+        f = ctx.read_parquet(fp)
+        d = ctx.read_parquet(dp)
+        q = f.join(d, on="k", suffix="_r").filter(col("x") > 1.0)
+        sub, sid = optimized_plan(q)
+        srcs = find_nodes(sub, sid, logical.SourceNode)
+        fact_src = [s for s in srcs if "x" in s.schema][0]
+        assert fact_src.predicate is not None and "x" in fact_src.predicate.sql()
+
+    def test_pushdown_correctness(self, pq_env):
+        fp, dp, fdf, ddf = pq_env
+        for opt in (True, False):
+            ctx = QuokkaContext(optimize=opt)
+            got = (
+                ctx.read_parquet(fp)
+                .join(ctx.read_parquet(dp), on="k")
+                .filter(col("x") > 1.0)
+                .groupby("name")
+                .agg_sql("count(*) as n, sum(x) as sx")
+                .collect()
+            )
+            m = fdf[fdf.x > 1.0].merge(ddf, on="k")
+            exp = m.groupby("name").agg(n=("x", "size"), sx=("x", "sum")).reset_index()
+            got = got.sort_values("name").reset_index(drop=True)
+            exp = exp.sort_values("name").reset_index(drop=True)
+            pd.testing.assert_frame_equal(got, exp, check_dtype=False, rtol=1e-9)
+
+    def test_rowgroup_pruning_happens(self, pq_env):
+        fp, dp, fdf, ddf = pq_env
+        ctx = QuokkaContext()
+        # d > all data -> every row group pruned -> zero rows, fast
+        got = ctx.read_parquet(fp).filter(col("d") > date("2200-01-01")).count()
+        assert got == 0
+        g = ctx.latest_graph
+        src = [a for a in g.actors.values() if a.kind == "input"][0]
+        n_pieces = sum(
+            len(v) for v in src.reader.get_own_state(1).values()
+        )
+        assert n_pieces == 0  # all row groups excluded by min/max stats
+
+
+class TestProjection:
+    def test_source_prunes_columns(self, pq_env):
+        fp, dp, fdf, ddf = pq_env
+        ctx = QuokkaContext()
+        q = (
+            ctx.read_parquet(fp)
+            .filter(col("k") > 10)
+            .groupby("k")
+            .agg_sql("sum(x) as sx")
+        )
+        sub, sid = optimized_plan(q)
+        src = find_nodes(sub, sid, logical.SourceNode)[0]
+        assert src.projection is not None
+        assert "big" not in src.projection
+        assert "x" in src.projection and "k" in src.projection
+
+    def test_projection_correctness(self, pq_env):
+        fp, dp, fdf, ddf = pq_env
+        ctx = QuokkaContext()
+        got = (
+            ctx.read_parquet(fp)
+            .filter(col("k") > 10)
+            .groupby("k")
+            .agg_sql("sum(x) as sx")
+            .collect()
+        )
+        exp = fdf[fdf.k > 10].groupby("k").x.sum().reset_index(name="sx")
+        got = got.sort_values("k").reset_index(drop=True)
+        pd.testing.assert_frame_equal(got, exp, check_dtype=False, rtol=1e-9)
+
+
+class TestJoinSuffixUnderProjection:
+    def test_pruned_clash_column_keeps_planned_suffix(self):
+        # left(a, c) join right(k, c): selecting only the RIGHT c (c_2) prunes
+        # the left c; the planned rename must still apply (regression: the
+        # runtime collision detection used to emit 'c' and crash the select)
+        ctx = QuokkaContext()
+        left = pa.table({"a": np.arange(10, dtype=np.int64),
+                         "c": np.arange(10, dtype=np.float64)})
+        right = pa.table({"k": np.arange(10, dtype=np.int64),
+                          "c": np.arange(10, dtype=np.float64) * 10})
+        got = (
+            ctx.from_arrow(left)
+            .join(ctx.from_arrow(right), left_on="a", right_on="k")
+            .select(["a", "c_2"])
+            .collect()
+        )
+        got = got.sort_values("a").reset_index(drop=True)
+        np.testing.assert_allclose(got.c_2.to_numpy(), np.arange(10) * 10.0)
+
+
+class TestBroadcast:
+    def test_small_build_becomes_broadcast(self, pq_env):
+        fp, dp, fdf, ddf = pq_env
+        ctx = QuokkaContext()
+        q = ctx.read_parquet(fp).join(ctx.read_parquet(dp), on="k")
+        sub, sid = optimized_plan(q)
+        joins = find_nodes(sub, sid, logical.JoinNode)
+        assert len(joins) == 1
+        assert joins[0].broadcast  # dim is 100 rows < threshold
+
+    def test_broadcast_correctness(self, pq_env):
+        fp, dp, fdf, ddf = pq_env
+        ctx = QuokkaContext()
+        got = ctx.read_parquet(fp).join(ctx.read_parquet(dp), on="k").count()
+        exp = len(fdf.merge(ddf, on="k"))
+        assert got == exp

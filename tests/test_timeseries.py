@@ -1,0 +1,296 @@
+"""Time-series tier tests: asof join, windows, shift, CEP — pandas oracles
+(pandas.merge_asof for asof, manual rolling/session computations otherwise)."""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from quokka_tpu import QuokkaContext
+from quokka_tpu.windows import (
+    HoppingWindow,
+    OnCompletionTrigger,
+    SessionWindow,
+    SlidingWindow,
+    TumblingWindow,
+)
+
+
+def make_ticks(n_trades=3000, n_quotes=6000, n_symbols=5, seed=3):
+    r = np.random.default_rng(seed)
+    syms = np.array([f"SYM{i}" for i in range(n_symbols)])
+    trades = pa.table(
+        {
+            "time": np.sort(r.integers(0, 100_000, n_trades)).astype(np.int64),
+            "symbol": syms[r.integers(0, n_symbols, n_trades)],
+            "size": r.integers(1, 500, n_trades).astype(np.int64),
+        }
+    )
+    # unique quote times: duplicate (symbol, time) quotes make the asof result
+    # order-dependent in pandas' oracle too (ties are covered by a dedicated
+    # deterministic test below)
+    qtimes = np.sort(r.choice(100_000, n_quotes, replace=False)).astype(np.int64)
+    quotes = pa.table(
+        {
+            "time": qtimes,
+            "symbol": syms[r.integers(0, n_symbols, n_quotes)],
+            "bid": r.uniform(10, 20, n_quotes).round(3),
+        }
+    )
+    return trades, quotes
+
+
+@pytest.fixture(scope="module")
+def ticks(tmp_path_factory):
+    root = tmp_path_factory.mktemp("ticks")
+    trades, quotes = make_ticks()
+    tp, qp = str(root / "trades.parquet"), str(root / "quotes.parquet")
+    pq.write_table(trades, tp, row_group_size=512)
+    pq.write_table(quotes, qp, row_group_size=512)
+    return tp, qp, trades.to_pandas(), quotes.to_pandas()
+
+
+class TestAsof:
+    def test_asof_join_parquet(self, ticks):
+        tp, qp, tdf, qdf = ticks
+        ctx = QuokkaContext()
+        t = ctx.read_sorted_parquet(tp, sorted_by="time")
+        q = ctx.read_sorted_parquet(qp, sorted_by="time")
+        got = t.join_asof(q, on="time", by="symbol").collect()
+        exp = pd.merge_asof(
+            tdf.sort_values("time"),
+            qdf.sort_values("time"),
+            on="time",
+            by="symbol",
+            direction="backward",
+        ).dropna(subset=["bid"])
+        got = got.sort_values(["time", "symbol", "size"]).reset_index(drop=True)
+        exp = exp.sort_values(["time", "symbol", "size"]).reset_index(drop=True)
+        assert len(got) == len(exp)
+        np.testing.assert_allclose(got.bid.to_numpy(), exp.bid.to_numpy(), rtol=1e-9)
+
+    def test_asof_then_agg(self, ticks):
+        tp, qp, tdf, qdf = ticks
+        ctx = QuokkaContext()
+        t = ctx.read_sorted_parquet(tp, sorted_by="time")
+        q = ctx.read_sorted_parquet(qp, sorted_by="time")
+        got = (
+            t.join_asof(q, on="time", by="symbol")
+            .with_columns_sql("bid * size as notional")
+            .groupby("symbol")
+            .agg_sql("sum(notional) as total")
+            .collect()
+        )
+        exp = pd.merge_asof(
+            tdf.sort_values("time"), qdf.sort_values("time"), on="time",
+            by="symbol", direction="backward",
+        ).dropna(subset=["bid"])
+        exp = (
+            (exp.bid * exp["size"]).groupby(exp.symbol).sum().reset_index(name="total")
+        )
+        got = got.sort_values("symbol").reset_index(drop=True)
+        exp = exp.rename(columns={"symbol": "symbol"}).sort_values("symbol").reset_index(drop=True)
+        np.testing.assert_allclose(got.total.to_numpy(), exp.total.to_numpy(), rtol=1e-9)
+
+
+class TestAsofTies:
+    def test_equal_time_quote_wins_and_last_duplicate_used(self):
+        ctx = QuokkaContext()
+        trades = pa.table(
+            {"time": np.array([5, 10], dtype=np.int64), "symbol": ["A", "A"]}
+        )
+        quotes = pa.table(
+            {
+                "time": np.array([5, 10, 10], dtype=np.int64),
+                "symbol": ["A", "A", "A"],
+                "bid": [1.0, 2.0, 3.0],
+            }
+        )
+        t = ctx.from_arrow_sorted(trades, sorted_by="time")
+        q = ctx.from_arrow_sorted(quotes, sorted_by="time")
+        got = t.join_asof(q, on="time", by="symbol").collect().sort_values("time")
+        # equal-time quote matches (backward includes ties); among duplicates
+        # at the same time, the later row wins
+        assert got.bid.tolist() == [1.0, 3.0]
+
+
+class TestWindows:
+    def _oracle_tumbling(self, df, size):
+        d = df.copy()
+        d["w"] = (d.time // size) * size
+        return (
+            d.groupby(["symbol", "w"])
+            .agg(total=("size", "sum"), n=("size", "size"))
+            .reset_index()
+        )
+
+    def test_tumbling(self, ticks):
+        tp, qp, tdf, qdf = ticks
+        ctx = QuokkaContext()
+        t = ctx.read_sorted_parquet(tp, sorted_by="time")
+        got = t.window_agg(
+            TumblingWindow(10_000), "sum(size) as total, count(*) as n", by="symbol"
+        ).collect()
+        exp = self._oracle_tumbling(tdf, 10_000)
+        got = got.sort_values(["symbol", "window_start"]).reset_index(drop=True)
+        exp = exp.sort_values(["symbol", "w"]).reset_index(drop=True)
+        assert len(got) == len(exp)
+        np.testing.assert_array_equal(got.window_start.to_numpy(), exp.w.to_numpy())
+        np.testing.assert_array_equal(got.total.to_numpy(), exp.total.to_numpy())
+        np.testing.assert_array_equal(got.n.to_numpy(), exp.n.to_numpy())
+
+    def test_tumbling_completion_trigger(self, ticks):
+        tp, qp, tdf, qdf = ticks
+        ctx = QuokkaContext()
+        t = ctx.read_sorted_parquet(tp, sorted_by="time")
+        got = t.window_agg(
+            TumblingWindow(10_000), "sum(size) as total", by="symbol",
+            trigger=OnCompletionTrigger(),
+        ).collect()
+        exp = self._oracle_tumbling(tdf, 10_000)
+        assert len(got) == len(exp)
+
+    def test_hopping(self, ticks):
+        tp, qp, tdf, qdf = ticks
+        ctx = QuokkaContext()
+        t = ctx.read_sorted_parquet(tp, sorted_by="time")
+        size, hop = 20_000, 10_000
+        got = t.window_agg(
+            HoppingWindow(size, hop), "count(*) as n", by="symbol"
+        ).collect()
+        # oracle: each row belongs to 2 windows
+        d = tdf.copy()
+        frames = []
+        for j in range(size // hop):
+            dd = d.copy()
+            dd["w"] = (dd.time // hop - j) * hop
+            dd = dd[(dd.w >= 0) & (dd.time < dd.w + size)]
+            frames.append(dd)
+        exp = (
+            pd.concat(frames).groupby(["symbol", "w"]).size().reset_index(name="n")
+        )
+        got = got.sort_values(["symbol", "window_start"]).reset_index(drop=True)
+        exp = exp.sort_values(["symbol", "w"]).reset_index(drop=True)
+        assert len(got) == len(exp)
+        np.testing.assert_array_equal(got.n.to_numpy(), exp.n.to_numpy())
+
+    def test_session(self):
+        ctx = QuokkaContext()
+        t = pa.table(
+            {
+                "time": np.array([0, 5, 8, 100, 103, 500, 1000, 1004, 1009], dtype=np.int64),
+                "symbol": ["A"] * 9,
+                "size": np.arange(1, 10, dtype=np.int64),
+            }
+        )
+        s = ctx.from_arrow_sorted(t, sorted_by="time")
+        got = s.window_agg(
+            SessionWindow(50), "sum(size) as total, count(*) as n", by="symbol"
+        ).collect()
+        got = got.sort_values("session_start").reset_index(drop=True)
+        # sessions: [0,5,8], [100,103], [500], [1000,1004,1009]
+        assert got.session_start.tolist() == [0, 100, 500, 1000]
+        assert got.session_end.tolist() == [8, 103, 500, 1009]
+        assert got.total.tolist() == [6, 9, 6, 24]
+        assert got.n.tolist() == [3, 2, 1, 3]
+
+    def test_sliding(self, ticks):
+        tp, qp, tdf, qdf = ticks
+        ctx = QuokkaContext()
+        t = ctx.read_sorted_parquet(tp, sorted_by="time")
+        size = 5_000
+        got = t.window_agg(
+            SlidingWindow(size), "sum(size) as roll_sum, count(*) as roll_n",
+            by="symbol",
+        ).collect()
+        d = tdf.sort_values(["symbol", "time"]).reset_index(drop=True)
+        exp_rows = []
+        for sym, g in d.groupby("symbol"):
+            times = g.time.to_numpy()
+            sizes = g["size"].to_numpy()
+            for i in range(len(g)):
+                m = (times >= times[i] - size) & (times <= times[i])
+                exp_rows.append((sym, times[i], sizes[i], sizes[m].sum(), m.sum()))
+        exp = pd.DataFrame(
+            exp_rows, columns=["symbol", "time", "size", "roll_sum", "roll_n"]
+        )
+        got = got.sort_values(["symbol", "time", "size"]).reset_index(drop=True)
+        exp = exp.sort_values(["symbol", "time", "size"]).reset_index(drop=True)
+        assert len(got) == len(exp)
+        np.testing.assert_allclose(
+            got.roll_sum.to_numpy(), exp.roll_sum.to_numpy(), rtol=1e-6
+        )
+
+
+class TestOrderedMetadata:
+    def test_window_output_sorted_by_window_start(self, ticks):
+        tp, qp, tdf, qdf = ticks
+        ctx = QuokkaContext()
+        t = ctx.read_sorted_parquet(tp, sorted_by="time")
+        w = t.window_agg(TumblingWindow(10_000), "sum(size) as vol", by="symbol")
+        assert w.sorted_by == ["window_start"]
+
+    def test_select_dropping_time_col_demotes_to_plain_stream(self):
+        from quokka_tpu.datastream import OrderedStream
+
+        ctx = QuokkaContext()
+        t = pa.table({"time": np.arange(5, dtype=np.int64), "v": np.ones(5)})
+        s = ctx.from_arrow_sorted(t, sorted_by="time")
+        assert isinstance(s.select(["time", "v"]), OrderedStream)
+        plain = s.select(["v"])
+        assert not isinstance(plain, OrderedStream)
+
+    def test_ordered_select_validates_columns(self):
+        ctx = QuokkaContext()
+        t = pa.table({"time": np.arange(5, dtype=np.int64), "v": np.ones(5)})
+        s = ctx.from_arrow_sorted(t, sorted_by="time")
+        with pytest.raises(ValueError):
+            s.select(["nope"])
+
+
+class TestShift:
+    def test_shift_by_key(self):
+        ctx = QuokkaContext()
+        t = pa.table(
+            {
+                "time": np.arange(12, dtype=np.int64),
+                "sym": (["A", "B"] * 6),
+                "px": np.arange(12, dtype=np.float64) * 1.5,
+            }
+        )
+        s = ctx.from_arrow_sorted(t, sorted_by="time")
+        got = s.shift("px", n=1, by="sym").collect()
+        df = t.to_pandas()
+        df["px_shifted_1"] = df.groupby("sym").px.shift(1)
+        got = got.sort_values("time").reset_index(drop=True)
+        exp = df.sort_values("time").reset_index(drop=True)
+        np.testing.assert_allclose(
+            got.px_shifted_1.to_numpy(), exp.px_shifted_1.to_numpy(), equal_nan=True
+        )
+
+
+class TestCEP:
+    def test_rise_pattern(self):
+        ctx = QuokkaContext()
+        px = np.array([10, 11, 9, 12, 13, 8, 9, 10, 14, 7], dtype=np.float64)
+        t = pa.table(
+            {
+                "time": np.arange(10, dtype=np.int64),
+                "sym": ["A"] * 10,
+                "px": px,
+            }
+        )
+        s = ctx.from_arrow_sorted(t, sorted_by="time")
+        events = [
+            ("low", "px < 10"),
+            ("rise", "px > low.px + 2"),
+        ]
+        got = s.pattern_recognize(events, within=5, by="sym").collect()
+        got = got.sort_values("low_time").reset_index(drop=True)
+        # low at t=2 (px 9) -> first rise px > 11 within 5: t=3 (12)
+        # low at t=5 (px 8) -> rise px > 10: t=8 (14)
+        # low at t=6 (px 9) -> rise px > 11: t=8 (14)
+        # low at t=9 (px 7): nothing after
+        assert got.low_time.tolist() == [2, 5, 6]
+        assert got.rise_time.tolist() == [3, 8, 8]
