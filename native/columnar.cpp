@@ -1,0 +1,50 @@
+// Native host-side columnar helpers for quokka-tpu.
+//
+// These cover the host chores that sit off the device path and are too slow in
+// Python: bulk FNV-1a string hashing (dictionary encoding feeds every string
+// join/group-by) and newline scanning for CSV byte-range readers.  Loaded via
+// ctypes (quokka_tpu/utils/native.py); Python fallbacks exist everywhere.
+//
+// Build: make -C native   (g++ -O3 -shared -fPIC)
+
+#include <cstdint>
+#include <cstddef>
+
+extern "C" {
+
+// Hash n strings stored as concatenated utf-8 bytes with (n+1) int64 offsets.
+// out[i] = FNV-1a 64 of bytes[offsets[i]..offsets[i+1]).
+void qk_fnv1a64_many(const uint8_t* bytes, const int64_t* offsets, int64_t n,
+                     uint64_t* out) {
+    const uint64_t kOffset = 0xcbf29ce484222325ULL;
+    const uint64_t kPrime = 0x100000001b3ULL;
+    for (int64_t i = 0; i < n; ++i) {
+        uint64_t h = kOffset;
+        for (int64_t j = offsets[i]; j < offsets[i + 1]; ++j) {
+            h ^= (uint64_t)bytes[j];
+            h *= kPrime;
+        }
+        out[i] = h;
+    }
+}
+
+// Index of the first '\n' in data[0..len), or -1.
+int64_t qk_find_newline(const uint8_t* data, int64_t len) {
+    for (int64_t i = 0; i < len; ++i) {
+        if (data[i] == '\n') return i;
+    }
+    return -1;
+}
+
+// Histogram of partition ids (for host-side shuffle planning): counts[p] +=
+// number of ids equal to p.  ids in [0, n_parts).
+void qk_partition_histogram(const int32_t* ids, int64_t n, int32_t n_parts,
+                            int64_t* counts) {
+    for (int32_t p = 0; p < n_parts; ++p) counts[p] = 0;
+    for (int64_t i = 0; i < n; ++i) {
+        int32_t p = ids[i];
+        if (p >= 0 && p < n_parts) counts[p]++;
+    }
+}
+
+}  // extern "C"

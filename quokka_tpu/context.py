@@ -24,6 +24,14 @@ from quokka_tpu.dataset.readers import (
 from quokka_tpu.runtime.engine import TaskGraph
 
 
+def _contains_agg(e) -> bool:
+    from quokka_tpu.expression import Agg
+
+    if isinstance(e, Agg):
+        return True
+    return any(_contains_agg(c) for c in e.children())
+
+
 class QuokkaContext:
     def __init__(
         self,
@@ -119,6 +127,95 @@ class QuokkaContext:
             logical.SourceNode(reader, schema, sorted_by=sorted_by),
             ordered=sorted_by is not None,
         )
+
+    # -- SQL frontend (reference: pyquokka/sql.py experimental tier) -----------
+    def register(self, name: str, stream) -> None:
+        """Register a DataStream as a SQL-visible table."""
+        if not hasattr(self, "_tables"):
+            self._tables = {}
+        self._tables[name] = stream
+
+    def sql(self, query: str):
+        """SELECT ... FROM registered tables -> DataStream.  Supports joins
+        with equi-conditions, WHERE, GROUP BY aggregates, HAVING, ORDER BY,
+        LIMIT, DISTINCT."""
+        from quokka_tpu import sqlparse
+        from quokka_tpu.expression import Agg, Alias, BinOp, ColRef
+
+        st = sqlparse.parse_select(query)
+        tables = getattr(self, "_tables", {})
+        if st.table not in tables:
+            raise ValueError(f"unknown table {st.table}; register() it first")
+        stream = tables[st.table]
+        for how, tname, cond in st.joins:
+            if tname not in tables:
+                raise ValueError(f"unknown table {tname}")
+            right = tables[tname]
+            if not (isinstance(cond, BinOp) and cond.op == "="):
+                raise NotImplementedError("JOIN ON supports equi-conditions")
+            lcol, rcol = cond.left, cond.right
+            if not (isinstance(lcol, ColRef) and isinstance(rcol, ColRef)):
+                raise NotImplementedError("JOIN ON supports column = column")
+            # route each side to the schema that owns it
+            if lcol.name in right.schema and rcol.name in stream.schema:
+                lcol, rcol = rcol, lcol
+            stream = stream.join(right, left_on=lcol.name, right_on=rcol.name, how=how)
+        if st.where is not None:
+            stream = stream.filter(st.where)
+        has_agg = any(_contains_agg(e) for e in st.select)
+        if st.group_by or has_agg:
+            from quokka_tpu.datastream import GroupedDataStream
+
+            named = []
+            keys = list(st.group_by)
+            desired = []  # output columns in SELECT order (with key aliases)
+            key_alias = {}
+            for i, e in enumerate(st.select):
+                inner = e.expr if isinstance(e, Alias) else e
+                name = e.name if isinstance(e, Alias) else (
+                    inner.name if isinstance(inner, ColRef) else f"col{i}"
+                )
+                desired.append(name)
+                if isinstance(inner, ColRef) and inner.name in keys:
+                    if name != inner.name:
+                        key_alias[inner.name] = name
+                    continue  # group key passes through
+                named.append(Alias(inner, name))
+            # ORDER BY may use the alias; resolve back to the key name
+            alias_inv = {v: k for k, v in key_alias.items()}
+            order_by = [(alias_inv.get(n, n), d) for n, d in st.order_by] or None
+            out = GroupedDataStream(stream, keys, None)._agg_exprs(
+                named, having=st.having, order_by=order_by, limit=st.limit
+            )
+            if key_alias:
+                out = out.rename(key_alias)
+            if list(out.schema) != desired:
+                out = out.select(desired)
+            return out
+        # projection-only select
+        names, exprs = [], {}
+        for i, e in enumerate(st.select):
+            inner = e.expr if isinstance(e, Alias) else e
+            name = e.name if isinstance(e, Alias) else (
+                inner.name if isinstance(inner, ColRef) else f"col{i}"
+            )
+            names.append(name)
+            if not (isinstance(inner, ColRef) and inner.name == name):
+                exprs[name] = inner
+        out = stream.with_columns(exprs) if exprs else stream
+        out = out.select(names)
+        if st.distinct:
+            out = out.distinct()
+        if st.order_by:
+            if st.limit is not None:
+                out = out.top_k([n for n, _ in st.order_by], st.limit,
+                                [d for _, d in st.order_by])
+            else:
+                out = out.sort([n for n, _ in st.order_by],
+                               [d for _, d in st.order_by])
+        elif st.limit is not None:
+            out = out.head(st.limit)
+        return out
 
     # -- execution -------------------------------------------------------------
     def execute_node(self, node_id: int):

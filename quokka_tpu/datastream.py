@@ -257,6 +257,103 @@ class DataStream:
         columns = [columns] if isinstance(columns, str) else list(columns)
         return self.agg_sql(", ".join(f"avg({c}) as {c}_mean" for c in columns))
 
+    # -- writers (datastream.py:129/205 write_csv / write_parquet) ------------
+    def write_parquet(self, path: str, rows_per_file: int = 1 << 20):
+        """Execute and write Parquet files under `path`; returns the written
+        filenames as a DataFrame."""
+        return self._write(path, "parquet", rows_per_file)
+
+    def write_csv(self, path: str, rows_per_file: int = 1 << 20):
+        return self._write(path, "csv", rows_per_file)
+
+    def _write(self, path: str, fmt: str, rows_per_file: int):
+        from quokka_tpu.executors.output import OutputExecutor
+
+        node = logical.StatefulNode(
+            [self.node_id],
+            ["filename"],
+            lambda: OutputExecutor(path, fmt, rows_per_file),
+        )
+        return self._child(node).collect()
+
+    # -- vectors (datastream.py:396 vector_nn_join) ---------------------------
+    def nearest_neighbors(self, queries, vec_col: str, k: int,
+                          payload=None) -> "DataStream":
+        """Top-k cosine matches of each query vector against this stream's
+        `vec_col` vectors (brute force on the MXU)."""
+        import numpy as _np
+
+        from quokka_tpu.executors.vector import (
+            GlobalTopKReduceExecutor,
+            NearestNeighborExecutor,
+        )
+
+        queries = _np.asarray(queries)
+        payload_cols = list(payload) if payload else [
+            c for c in self.schema if c != vec_col
+        ]
+        out_schema = ["query_idx", "score"] + payload_cols
+        local = logical.StatefulNode(
+            [self.node_id],
+            out_schema,
+            lambda: NearestNeighborExecutor(queries, vec_col, k, payload_cols),
+        )
+        local_id = self.ctx.add_node(local)
+        reduce_node = logical.StatefulNode(
+            [local_id], out_schema, lambda: GlobalTopKReduceExecutor(k)
+        )
+        reduce_node.channels = 1
+        return DataStream(self.ctx, self.ctx.add_node(reduce_node))
+
+    vector_nn_join = nearest_neighbors
+
+    # -- numeric extras (datastream.py:1033/1100/921) -------------------------
+    def gramian(self, columns) -> "DataStream":
+        return self._gramian(columns, covariance=False)
+
+    def covariance(self, columns) -> "DataStream":
+        return self._gramian(columns, covariance=True)
+
+    def _gramian(self, columns, covariance: bool):
+        from quokka_tpu.executors.linalg import (
+            CombineGramianExecutor,
+            GramianExecutor,
+        )
+
+        columns = [columns] if isinstance(columns, str) else list(columns)
+        out_schema = ["column"] + columns
+        local = logical.StatefulNode(
+            [self.node_id],
+            ["__row"] + columns,
+            lambda: GramianExecutor(columns, covariance),
+        )
+        local_id = self.ctx.add_node(local)
+        combine = logical.StatefulNode(
+            [local_id], out_schema, lambda: CombineGramianExecutor(columns, covariance)
+        )
+        combine.channels = 1
+        return DataStream(self.ctx, self.ctx.add_node(combine))
+
+    def approximate_quantile(self, column: str, quantiles) -> "DataStream":
+        from quokka_tpu.executors.linalg import (
+            CombineQuantileExecutor,
+            ReservoirQuantileExecutor,
+        )
+
+        quantiles = [quantiles] if isinstance(quantiles, (int, float)) else list(quantiles)
+        out_schema = ["quantile", column]
+        local = logical.StatefulNode(
+            [self.node_id],
+            out_schema,
+            lambda: ReservoirQuantileExecutor(column, quantiles),
+        )
+        local_id = self.ctx.add_node(local)
+        combine = logical.StatefulNode(
+            [local_id], out_schema, lambda: CombineQuantileExecutor(column, quantiles)
+        )
+        combine.channels = 1
+        return DataStream(self.ctx, self.ctx.add_node(combine))
+
     # -- ordering --------------------------------------------------------------
     def top_k(self, by, k: int, descending=None) -> "DataStream":
         by = [by] if isinstance(by, str) else list(by)
@@ -367,19 +464,22 @@ class GroupedDataStream:
     aggregate = agg
     aggregate_sql = agg_sql
 
-    def _agg_exprs(self, exprs: List[Alias]) -> DataStream:
+    def _agg_exprs(self, exprs: List[Alias], having=None, order_by=None,
+                   limit=None) -> DataStream:
         plan = plan_aggregation(exprs)
+        if having is not None:
+            # aggregates inside HAVING become references to (possibly new)
+            # partial columns of the same plan
+            having = plan.rewrite(having)
         out_schema = self.keys + [n for n, _ in plan.finals if n not in self.keys]
-        order_by = None
         if self.orderby:
             order_by = [
                 (c, False) if isinstance(c, str) else (c[0], c[1] == "desc")
                 for c in ([self.orderby] if isinstance(self.orderby, str) else self.orderby)
             ]
-        elif self.keys:
-            order_by = [(k, False) for k in self.keys]
         node = logical.AggNode(
-            [self.stream.node_id], out_schema, self.keys, plan, order_by=order_by
+            [self.stream.node_id], out_schema, self.keys, plan,
+            having=having, order_by=order_by, limit=limit,
         )
         return self.stream._child(node)
 

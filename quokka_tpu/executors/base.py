@@ -16,6 +16,12 @@ from quokka_tpu.ops.batch import DeviceBatch
 
 
 class Executor:
+    # executors that implement checkpoint()/restore() set this True; the
+    # runtime must NOT record a recovery point for executors without real
+    # snapshot support (a fresh instance + full tape replay is the only safe
+    # recovery for them)
+    SUPPORTS_CHECKPOINT = False
+
     def execute(
         self, batches: List[DeviceBatch], stream_id: int, channel: int
     ) -> Optional[DeviceBatch]:

@@ -1,0 +1,150 @@
+"""Numeric/linear-algebra executors: gramian, covariance, approximate
+quantiles.
+
+Reference parity: DataStream.gramian/covariance/approximate_quantile
+(pyquokka/datastream.py:1033/1100/921).  Gramian partials are X^T X matmuls —
+pure MXU work — summed across batches and channels; approximate quantiles use
+per-channel uniform reservoir sampling (the reference's t-digest dependency is
+optional there too)."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+
+from quokka_tpu.executors.base import Executor
+from quokka_tpu.ops import bridge
+from quokka_tpu.ops.batch import DeviceBatch
+
+
+class GramianExecutor(Executor):
+    """Running X^T X (and column sums + count for covariance) over the given
+    float columns."""
+
+    def __init__(self, columns: Sequence[str], covariance: bool = False):
+        self.columns = list(columns)
+        self.covariance = covariance
+        self.gram: Optional[jnp.ndarray] = None
+        self.sums: Optional[jnp.ndarray] = None
+        self.count = 0
+
+    @staticmethod
+    @jax.jit
+    def _accumulate(mat, valid):
+        m = jnp.where(valid[:, None], mat, 0.0)
+        return m.T @ m, jnp.sum(m, axis=0)
+
+    def execute(self, batches, stream_id, channel):
+        for b in batches:
+            if b is None or b.count_valid() == 0:
+                continue
+            mat = jnp.stack([b.columns[c].data for c in self.columns], axis=1)
+            g, s = self._accumulate(mat.astype(jnp.float32), b.valid)
+            self.gram = g if self.gram is None else self.gram + g
+            self.sums = s if self.sums is None else self.sums + s
+            self.count += b.count_valid()
+
+    def done(self, channel):
+        if self.gram is None:
+            return None
+        g = np.asarray(self.gram, dtype=np.float64)
+        if self.covariance and self.count > 1:
+            mu = np.asarray(self.sums, dtype=np.float64) / self.count
+            g = g / self.count - np.outer(mu, mu)
+        cols = {"__row": np.array(self.columns, dtype=object)}
+        for j, c in enumerate(self.columns):
+            cols[c] = g[:, j]
+        self.gram = None
+        self.sums = None
+        return bridge.arrow_to_device(pa.table(cols))
+
+
+class CombineGramianExecutor(Executor):
+    """Sum per-channel gramian partials (matrix rows keyed by __row)."""
+
+    def __init__(self, columns: Sequence[str], covariance: bool = False):
+        self.columns = list(columns)
+        self.parts: List[DeviceBatch] = []
+
+    def execute(self, batches, stream_id, channel):
+        self.parts.extend(b for b in batches if b is not None)
+
+    def done(self, channel):
+        if not self.parts:
+            return None
+        import pandas as pd
+
+        dfs = [bridge.to_pandas(b) for b in self.parts]
+        self.parts = []
+        acc = dfs[0].set_index("__row")[self.columns]
+        for d in dfs[1:]:
+            acc = acc + d.set_index("__row")[self.columns]
+        out = acc.reset_index().rename(columns={"__row": "column"})
+        return bridge.arrow_to_device(pa.Table.from_pandas(out, preserve_index=False))
+
+
+class ReservoirQuantileExecutor(Executor):
+    """Approximate quantiles by uniform reservoir sampling per channel; the
+    final quantile is computed on the merged reservoir."""
+
+    def __init__(self, column: str, quantiles: Sequence[float], reservoir: int = 65_536,
+                 seed: int = 0):
+        self.column = column
+        self.quantiles = list(quantiles)
+        self.cap = reservoir
+        self.rng = np.random.default_rng(seed)
+        self.sample = np.zeros(0, dtype=np.float64)
+        self.seen = 0
+
+    def execute(self, batches, stream_id, channel):
+        for b in batches:
+            if b is None or b.count_valid() == 0:
+                continue
+            x = np.asarray(b.columns[self.column].data)[np.asarray(b.valid)]
+            x = x.astype(np.float64)
+            if len(self.sample) < self.cap:
+                take = min(self.cap - len(self.sample), len(x))
+                self.sample = np.concatenate([self.sample, x[:take]])
+                x = x[take:]
+                self.seen += take
+            for v in x:  # classic reservoir replacement
+                self.seen += 1
+                j = self.rng.integers(0, self.seen)
+                if j < self.cap:
+                    self.sample[j] = v
+
+    def done(self, channel):
+        if self.seen == 0:
+            return None
+        qs = np.quantile(self.sample, self.quantiles)
+        return bridge.arrow_to_device(
+            pa.table({"quantile": np.array(self.quantiles), self.column: qs})
+        )
+
+
+class CombineQuantileExecutor(Executor):
+    """Merge per-channel reservoirs is approximated by re-sampling the emitted
+    per-channel quantiles weighted equally (adequate for the advertised
+    approximate semantics); single-channel plans skip this."""
+
+    def __init__(self, column: str, quantiles: Sequence[float]):
+        self.column = column
+        self.quantiles = list(quantiles)
+        self.parts: List[DeviceBatch] = []
+
+    def execute(self, batches, stream_id, channel):
+        self.parts.extend(b for b in batches if b is not None)
+
+    def done(self, channel):
+        if not self.parts:
+            return None
+        import pandas as pd
+
+        df = pd.concat([bridge.to_pandas(b) for b in self.parts], ignore_index=True)
+        self.parts = []
+        out = df.groupby("quantile")[self.column].mean().reset_index()
+        return bridge.arrow_to_device(pa.Table.from_pandas(out, preserve_index=False))

@@ -1,0 +1,81 @@
+"""Output writers: stream batches to Parquet / CSV files.
+
+Reference parity: OutputExecutor (pyquokka/executors/sql_executors.py:189-273)
+— accumulate rows until a target row-group size, write numbered files per
+channel, emit the written filenames downstream."""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import pyarrow as pa
+import pyarrow.csv as pacsv
+import pyarrow.parquet as pq
+
+from quokka_tpu.executors.base import Executor
+from quokka_tpu.ops import bridge
+from quokka_tpu.ops.batch import DeviceBatch
+
+
+class OutputExecutor(Executor):
+    def __init__(self, path: str, fmt: str = "parquet", rows_per_file: int = 1 << 20,
+                 prefix: str = "part"):
+        assert fmt in ("parquet", "csv")
+        self.path = path
+        self.fmt = fmt
+        self.rows_per_file = rows_per_file
+        self.prefix = prefix
+        self.pending: List[pa.Table] = []
+        self.pending_rows = 0
+        self.file_no = 0
+        self.written: List[str] = []
+        os.makedirs(path, exist_ok=True)
+
+    def execute(self, batches, stream_id, channel):
+        for b in batches:
+            if b is None:
+                continue
+            t = bridge.device_to_arrow(b)
+            if t.num_rows == 0:
+                continue
+            self.pending.append(t)
+            self.pending_rows += t.num_rows
+        out = []
+        while self.pending_rows >= self.rows_per_file:
+            out.append(self._flush(channel, self.rows_per_file))
+        return self._names_batch(out) if out else None
+
+    def done(self, channel):
+        out = []
+        while self.pending_rows > 0:
+            out.append(self._flush(channel, self.rows_per_file))
+        return self._names_batch(out) if out else None
+
+    def _flush(self, channel: int, rows: int) -> str:
+        take, taken = [], 0
+        while self.pending and taken < rows:
+            t = self.pending[0]
+            need = rows - taken
+            if t.num_rows <= need:
+                take.append(self.pending.pop(0))
+                taken += t.num_rows
+            else:
+                take.append(t.slice(0, need))
+                self.pending[0] = t.slice(need)
+                taken += need
+        self.pending_rows -= taken
+        table = pa.concat_tables(take, promote_options="permissive")
+        name = os.path.join(
+            self.path, f"{self.prefix}-{channel}-{self.file_no}.{self.fmt}"
+        )
+        self.file_no += 1
+        if self.fmt == "parquet":
+            pq.write_table(table, name)
+        else:
+            pacsv.write_csv(table, name)
+        self.written.append(name)
+        return name
+
+    def _names_batch(self, names: List[str]) -> DeviceBatch:
+        return bridge.arrow_to_device(pa.table({"filename": names}))

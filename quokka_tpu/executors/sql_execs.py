@@ -57,6 +57,7 @@ class StorageExecutor(Executor):
 
 
 class PartialAggExecutor(Executor):
+    SUPPORTS_CHECKPOINT = True
     """Per-channel partial group-by: maintains one running partial-aggregate
     batch; emits it at done.  Sits upstream of the hash shuffle."""
 
@@ -161,6 +162,10 @@ class FinalAggExecutor(Executor):
         g = self.state
         for name, e in self.plan.finals:
             g = g.with_column(name, evaluate_to_column(e, g))
+        # HAVING runs before the projection: it may reference partial columns
+        # (aggregates rewritten by plan.rewrite) that the output drops
+        if self.having is not None:
+            g = kernels.compact(kernels.apply_mask(g, evaluate_predicate(self.having, g)))
         out_cols = self.keys + [n for n, _ in self.plan.finals]
         # dedupe (a key may also be an output)
         seen, cols = set(), []
@@ -169,8 +174,6 @@ class FinalAggExecutor(Executor):
                 seen.add(c)
                 cols.append(c)
         g = g.select(cols)
-        if self.having is not None:
-            g = kernels.compact(kernels.apply_mask(g, evaluate_predicate(self.having, g)))
         if self.order_by:
             names = [n for n, _ in self.order_by]
             desc = [d for _, d in self.order_by]
@@ -185,6 +188,8 @@ class FinalAggExecutor(Executor):
 
 
 class BuildProbeJoinExecutor(Executor):
+    SUPPORTS_CHECKPOINT = True
+
     """Streamed hash join: stream 1 is the build side (buffered until its
     stage completes), stream 0 probes.  Stage scheduling guarantees build
     completes before the first probe batch arrives (the reference asserts the

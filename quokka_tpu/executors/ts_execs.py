@@ -39,6 +39,8 @@ def _time_max(batch: DeviceBatch, col: str) -> float:
 
 
 class SortedAsofExecutor(Executor):
+    SUPPORTS_CHECKPOINT = True
+
     """Streaming backward asof join.  Stream 0 = left/trades, stream 1 =
     right/quotes.  Trades are emitted once the quote watermark passes their
     timestamp; the quote buffer is pruned to the last quote per key below the

@@ -246,12 +246,29 @@ class AggNode(Node):
         )
         n_final = (self.channels or ctx.exec_channels) if keys else 1
         part = HashPartitioner(keys) if keys else PassThroughPartitioner()
-        actor_of[node_id] = graph.new_exec_node(
+        final = graph.new_exec_node(
             lambda: FinalAggExecutor(keys, plan, having, order_by, limit),
             {0: (partial, TargetInfo(part))},
             n_final,
             self.stage,
         )
+        if order_by and n_final > 1:
+            # per-channel order is local; merge to a global order (+ limit)
+            from quokka_tpu.executors.sql_execs import SortExecutor, TopKExecutor
+
+            names = [n for n, _ in order_by]
+            desc = [d for _, d in order_by]
+            if limit is not None:
+                merge_factory = lambda: TopKExecutor(names, limit, desc)
+            else:
+                merge_factory = lambda: SortExecutor(names, desc)
+            final = graph.new_exec_node(
+                merge_factory,
+                {0: (final, TargetInfo(PassThroughPartitioner()))},
+                1,
+                self.stage,
+            )
+        actor_of[node_id] = final
 
     def describe(self):
         return f"Agg(keys={self.keys}, out={[n for n, _ in self.plan.finals]})"

@@ -135,7 +135,27 @@ class StrCol:
         return StrCol(self.codes[idx], self.dictionary)
 
 
-Column = object  # NumCol | StrCol
+@dataclasses.dataclass
+class VecCol:
+    """Fixed-width vector (embedding) column: [rows, dim] device array.
+    Bridge target for arrow fixed_size_list<float> columns; the payload of
+    vector search (top-k cosine runs as a matmul on the MXU)."""
+
+    data: jax.Array  # [padded_rows, dim]
+
+    @property
+    def padded_len(self) -> int:
+        return self.data.shape[0]
+
+    @property
+    def dim(self) -> int:
+        return self.data.shape[1]
+
+    def take(self, idx: jax.Array) -> "VecCol":
+        return VecCol(self.data[idx])
+
+
+Column = object  # NumCol | StrCol | VecCol
 
 
 # ---------------------------------------------------------------------------

@@ -20,7 +20,7 @@ import pyarrow as pa
 import pyarrow.compute as pc
 
 from quokka_tpu import config
-from quokka_tpu.ops.batch import DeviceBatch, NumCol, StrCol, StringDict
+from quokka_tpu.ops.batch import DeviceBatch, NumCol, StrCol, StringDict, VecCol
 
 _I32_MIN = -(2**31)
 _I32_MAX = 2**31 - 1
@@ -75,6 +75,16 @@ def arrow_column_to_device(arr: pa.ChunkedArray, padded: int):
         if isinstance(enc, pa.ChunkedArray):
             enc = enc.combine_chunks()
         return arrow_column_to_device(enc, padded)
+    if pa.types.is_fixed_size_list(t):
+        # must run before fill_null (lists can't fill with a scalar) and must
+        # not rely on flatten() alone — it drops null slots, misaligning rows;
+        # null rows become zero vectors explicitly
+        dim = t.list_size
+        valid_np = arr.is_valid().to_numpy(zero_copy_only=False)
+        flat = arr.flatten().to_numpy(zero_copy_only=False).astype(config.float_dtype())
+        out = np.zeros((padded, dim), dtype=flat.dtype)
+        out[np.nonzero(valid_np)[0]] = flat.reshape(-1, dim)
+        return VecCol(jnp.asarray(out))
     if arr.null_count:
         arr = pc.fill_null(arr, 0)
     if pa.types.is_boolean(t):
@@ -117,7 +127,13 @@ def device_to_arrow(batch: DeviceBatch) -> pa.Table:
     names = []
     for name, col in batch.columns.items():
         names.append(name)
-        if isinstance(col, StrCol):
+        if isinstance(col, VecCol):
+            mat = np.asarray(col.data)[mask]
+            flat = pa.array(mat.reshape(-1))
+            arrays.append(
+                pa.FixedSizeListArray.from_arrays(flat, col.dim)
+            )
+        elif isinstance(col, StrCol):
             codes = np.asarray(col.codes)[mask]
             vals = col.dictionary.values
             out = np.empty(len(codes), dtype=object)
@@ -189,6 +205,11 @@ def concat_batches(batches: Sequence[DeviceBatch]) -> DeviceBatch:
                 code_parts.append(codes)
             codes = _pad_device(jnp.concatenate(code_parts), padded)
             out_cols[name] = StrCol(codes, merged)
+        elif isinstance(cols[0], VecCol):
+            data = jnp.concatenate([c.data[:cnt] for c, cnt in zip(cols, counts)])
+            if data.shape[0] < padded:
+                data = jnp.pad(data, ((0, padded - data.shape[0]), (0, 0)))
+            out_cols[name] = VecCol(data[:padded])
         else:
             data = jnp.concatenate([c.data[:cnt] for c, cnt in zip(cols, counts)])
             data = _pad_device(data, padded)

@@ -23,6 +23,8 @@ Key invariants preserved from the reference:
 from __future__ import annotations
 
 import copy
+import os
+import pickle
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -74,6 +76,28 @@ class TaskGraph:
             self.exec_config.update(exec_config)
         self.actors: Dict[int, ActorInfo] = {}
         self._next_actor = 0
+        self.hbq = None
+        self.ckpt_dir = None
+        if self.exec_config.get("fault_tolerance"):
+            import tempfile
+
+            from quokka_tpu.runtime.hbq import HBQ
+
+            base = self.exec_config.get("hbq_path", "/tmp/quokka_tpu_spill/")
+            os.makedirs(base, exist_ok=True)
+            # unique per run: id()-style keys repeat across (and within)
+            # processes and would replay another run's spill files
+            self.hbq = HBQ(tempfile.mkdtemp(prefix="run-", dir=base))
+            self.ckpt_dir = tempfile.mkdtemp(prefix="ckpt-", dir=base)
+
+    def cleanup(self) -> None:
+        import shutil
+
+        if self.hbq is not None:
+            self.hbq.wipe()
+            shutil.rmtree(self.hbq.path, ignore_errors=True)
+        if self.ckpt_dir is not None:
+            shutil.rmtree(self.ckpt_dir, ignore_errors=True)
 
     def _new_actor(self, kind, channels, stage, sorted_actor=False) -> ActorInfo:
         info = ActorInfo(self._next_actor, kind, channels, stage, sorted_actor)
@@ -154,6 +178,8 @@ class TaskGraph:
                     for sch in range(src.channels)
                     if _feeds(tinfo.partitioner, sch, ch, channels)
                 }
+            # IRT at state 0: the recovery planner's starting point
+            self.store.tset("IRT", (info.id, ch, 0), copy.deepcopy(reqs))
             self.store.ntt_push(info.id, ExecutorTask(info.id, ch, 0, 0, reqs))
         return info.id
 
@@ -169,7 +195,10 @@ class TaskGraph:
         )
 
     def run(self, max_batches: Optional[int] = None):
-        Engine(self).run(max_batches=max_batches)
+        try:
+            Engine(self).run(max_batches=max_batches)
+        finally:
+            self.cleanup()
 
     def result(self, actor_id: int) -> ResultDataset:
         return self.actors[actor_id].blocking_dataset
@@ -260,6 +289,10 @@ class Engine:
             parts = fn(batch, channel)
             for tgt_ch, part in parts.items():
                 name = (actor, channel, seq, tgt_actor, actor, tgt_ch)
+                if self.g.hbq is not None:
+                    # spill post-partition (core.py:311-313): replayable
+                    # without recomputing the producer
+                    self.g.hbq.put(name, bridge.device_to_arrow(part))
                 self.cache.put(name, part)
                 with self.store.transaction():
                     self.store.sadd("NOT", (actor, channel), name)
@@ -330,9 +363,12 @@ class Engine:
             if not chans:
                 del task.input_reqs[src]
                 extra = executor.source_done(info.source_streams[src], task.channel)
-                if extra is not None and extra.count_valid() > 0:
+                emitted = extra is not None and extra.count_valid() > 0
+                if emitted:
                     self._emit(info, task.channel, out_seq, extra)
                     out_seq += 1
+                self._tape(task.actor, task.channel,
+                           ("srcdone", info.source_streams[src], emitted))
         task.out_seq = out_seq
         if not task.input_reqs:
             out = executor.done(task.channel)
@@ -362,10 +398,12 @@ class Engine:
         with tracing.span(f"exec.{type(executor).__name__}"):
             out = executor.execute(batches, stream_id, task.channel)
         out_seq = task.out_seq
-        if out is not None and out.count_valid() > 0:
+        emitted = out is not None and out.count_valid() > 0
+        if emitted:
             with tracing.span("push.exec"):
                 self._emit(info, task.channel, out_seq, out)
             out_seq += 1
+        self._tape(task.actor, task.channel, ("exec", src_actor, tuple(names), emitted))
         consumed: Dict[int, Dict[int, int]] = {src_actor: {}}
         for (sa, sch, seq, *_rest) in names:
             consumed[sa][sch] = max(consumed[sa].get(sch, 0), seq + 1)
@@ -373,8 +411,149 @@ class Engine:
             for sch, nxt in consumed[src_actor].items():
                 self.store.tset("EWT", (src_actor, sch, task.actor, task.channel), nxt - 1)
         self.cache.gc(names)
-        self.store.ntt_push(task.actor, task.advance(consumed, out_seq))
+        new_task = task.advance(consumed, out_seq)
+        interval = self.g.exec_config.get("checkpoint_interval")
+        if interval and self.g.ckpt_dir is not None and new_task.state_seq % interval == 0:
+            self._checkpoint(executor, new_task)
+        self.store.ntt_push(task.actor, new_task)
         return True
+
+    # -- fault tolerance ------------------------------------------------------
+    def _tape(self, actor: int, ch: int, event) -> None:
+        """Record the exec channel's event history (the lineage 'tape'): which
+        exact batch sets were consumed and which steps emitted.  Replaying the
+        tape after a failure reproduces byte-identical output seqs, which is
+        what lets already-consumed outputs stay valid downstream (the
+        TapedExecutorTask discipline, pyquokka/task.py:139, fault-tolerance.md)."""
+        if self.g.hbq is None:
+            return
+        with self.store.transaction():
+            tape = self.store.tget("LT", ("tape", actor, ch))
+            if tape is None:
+                tape = []
+                self.store.tset("LT", ("tape", actor, ch), tape)
+            tape.append(event)
+
+    def _ckpt_file(self, actor: int, ch: int, state_seq: int) -> str:
+        return os.path.join(self.g.ckpt_dir, f"ckpt-{actor}-{ch}-{state_seq}.pkl")
+
+    def _checkpoint(self, executor, task: ExecutorTask) -> None:
+        """Snapshot executor state + input frontier + tape position
+        (core.py:678-685)."""
+        if not getattr(executor, "SUPPORTS_CHECKPOINT", False):
+            # no snapshot support: recovery rewinds to state 0 + full tape
+            # replay; recording an LCT here would silently drop state
+            return
+        state = executor.checkpoint()
+        with open(self._ckpt_file(task.actor, task.channel, task.state_seq), "wb") as f:
+            pickle.dump(state, f)
+        with self.store.transaction():
+            tape = self.store.tget("LT", ("tape", task.actor, task.channel)) or []
+            self.store.tset(
+                "LCT",
+                (task.actor, task.channel),
+                (task.state_seq, task.out_seq, len(tape)),
+            )
+            self.store.tset(
+                "IRT",
+                (task.actor, task.channel, task.state_seq),
+                {a: dict(c) for a, c in task.input_reqs.items()},
+            )
+
+    def simulate_failure_and_recover(self, failed: List[Tuple[int, int]]) -> None:
+        """Kill the given exec (actor, channel) workers — losing executor
+        state, their queued tasks, and cached inputs destined to them — then
+        run the recovery protocol (coordinator.py:219-552): restore from the
+        latest checkpoint, rebuild the input frontier from IRT, and replay
+        already-produced inputs from the HBQ spill."""
+        assert self.g.hbq is not None, "fault tolerance is not enabled"
+        for (a, ch) in failed:
+            info = self.g.actors[a]
+            assert info.kind == "exec", "simulated failures target exec workers"
+            self.execs[(a, ch)] = info.executor_factory()
+            for name in list(self.cache.flights_info()):
+                if name[3] == a and name[5] == ch:
+                    self.cache.gc([name])
+            with self.store.transaction():
+                self.store.tables["DST"].pop((a, ch), None)
+            q = self.store.tables["NTT"][a]
+            keep = [t for t in q if not (t.name == "exec" and t.channel == ch)]
+            q.clear()
+            q.extend(keep)
+            lct = self.store.tget("LCT", (a, ch))
+            if lct is not None:
+                state_seq, out_seq, tape_pos = lct
+                with open(self._ckpt_file(a, ch, state_seq), "rb") as f:
+                    self.execs[(a, ch)].restore(pickle.load(f))
+                reqs = {
+                    s: dict(c)
+                    for s, c in self.store.tget("IRT", (a, ch, state_seq)).items()
+                }
+            else:
+                state_seq, out_seq, tape_pos = 0, 0, 0
+                reqs = {
+                    s: dict(c) for s, c in self.store.tget("IRT", (a, ch, 0)).items()
+                }
+            tape = list(self.store.tget("LT", ("tape", a, ch)) or [])
+            state_seq, out_seq = self._replay_tape(
+                a, ch, tape[tape_pos:], reqs, state_seq, out_seq
+            )
+            with self.store.transaction():
+                self.store.tset("EST", (a, ch), state_seq)
+            self.store.ntt_push(a, ExecutorTask(a, ch, state_seq, out_seq, reqs))
+            self._replay_from_hbq(a, ch, reqs)
+
+    def _replay_tape(self, actor: int, ch: int, events, reqs,
+                     state_seq: int, out_seq: int):
+        """Re-run the recorded event history: identical inputs in identical
+        order reproduce identical outputs at identical seqs (so downstream
+        consumers — which may already hold some of them — stay consistent)."""
+        info = self.g.actors[actor]
+        executor = self.execs[(actor, ch)]
+        for ev in events:
+            if ev[0] == "exec":
+                _, src_actor, names, emitted = ev
+                batches = []
+                for name in names:
+                    b = self.cache.get(name)
+                    if b is None:
+                        table = self.g.hbq.get(name)
+                        assert table is not None, f"lost object {name} not in HBQ"
+                        b = bridge.arrow_to_device(table)
+                    batches.append(b)
+                out = executor.execute(batches, info.source_streams[src_actor], ch)
+                re_emitted = out is not None and out.count_valid() > 0
+                assert re_emitted == emitted, "non-deterministic replay"
+                if re_emitted:
+                    self._emit(info, ch, out_seq, out)
+                    out_seq += 1
+                for name in names:
+                    sa, sch, seq = name[0], name[1], name[2]
+                    reqs[sa][sch] = max(reqs[sa].get(sch, 0), seq + 1)
+                state_seq += 1
+            else:
+                # exhausted sources stay in reqs here; the first live prune
+                # re-drops them (executors guard repeated source_done calls)
+                _, stream_id, emitted = ev
+                extra = executor.source_done(stream_id, ch)
+                re_emitted = extra is not None and extra.count_valid() > 0
+                assert re_emitted == emitted, "non-deterministic replay"
+                if re_emitted:
+                    self._emit(info, ch, out_seq, extra)
+                    out_seq += 1
+        return state_seq, out_seq
+
+    def _replay_from_hbq(self, actor: int, ch: int, reqs) -> None:
+        for src, chans in reqs.items():
+            for sch, need in chans.items():
+                seq = need
+                while True:
+                    name = (src, sch, seq, actor, src, ch)
+                    table = self.g.hbq.get(name)
+                    if table is None:
+                        break
+                    self.cache.put(name, bridge.arrow_to_device(table))
+                    seq += 1
 
     def _emit(self, info: ActorInfo, channel: int, seq: int, out: DeviceBatch) -> None:
         if info.blocking_dataset is not None:
@@ -395,6 +574,8 @@ class Engine:
         stages = sorted({a.stage for a in actors})
         stage_idx = 0
         t0 = time.time()
+        inject = self.g.exec_config.get("inject_failure")
+        handled = 0
         while True:
             if time.time() - t0 > timeout:
                 raise TimeoutError(
@@ -410,9 +591,16 @@ class Engine:
                 if task is None:
                     continue
                 if task.name == "input":
-                    progress |= self.handle_input_task(task)
+                    ok = self.handle_input_task(task)
                 else:
-                    progress |= self.handle_exec_task(task)
+                    ok = self.handle_exec_task(task)
+                progress |= ok
+                if ok:
+                    handled += 1
+                    if inject is not None and handled >= inject["after_tasks"]:
+                        self.simulate_failure_and_recover(inject["channels"])
+                        inject = None
+                        progress = True
             if self._all_done(actors):
                 return
             # advance when nothing undone remains at the current stage

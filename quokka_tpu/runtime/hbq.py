@@ -1,0 +1,53 @@
+"""HBQ — host buffer queue: disk spill of post-partition outputs.
+
+Reference parity: pyquokka/hbq.py:30-95.  Every object pushed to the data
+plane is also written (post-partition) as an Arrow IPC file named by its
+6-tuple object name, so a ReplayTask can re-push it after a failure without
+recomputing the producer.  GC follows the cemetery table.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Optional, Sequence, Tuple
+
+import pyarrow as pa
+import pyarrow.ipc as ipc
+
+
+def _fname(name: Tuple) -> str:
+    src_actor, src_ch, seq, tgt_actor, pfn, tgt_ch = name
+    return f"hbq-{src_actor}-{src_ch}-{seq}-{tgt_actor}-{pfn}-{tgt_ch}.arrow"
+
+
+class HBQ:
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(path, exist_ok=True)
+
+    def put(self, name: Tuple, table: pa.Table) -> None:
+        p = os.path.join(self.path, _fname(name))
+        with ipc.new_file(p + ".tmp", table.schema) as w:
+            w.write_table(table)
+        os.replace(p + ".tmp", p)  # atomic: readers never see partial spills
+
+    def get(self, name: Tuple) -> Optional[pa.Table]:
+        p = os.path.join(self.path, _fname(name))
+        if not os.path.exists(p):
+            return None
+        with ipc.open_file(p) as r:
+            return r.read_all()
+
+    def contains(self, name: Tuple) -> bool:
+        return os.path.exists(os.path.join(self.path, _fname(name)))
+
+    def gc(self, names: Sequence[Tuple]) -> None:
+        for name in names:
+            p = os.path.join(self.path, _fname(name))
+            if os.path.exists(p):
+                os.remove(p)
+
+    def wipe(self) -> None:
+        shutil.rmtree(self.path, ignore_errors=True)
+        os.makedirs(self.path, exist_ok=True)

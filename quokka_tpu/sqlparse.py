@@ -50,6 +50,8 @@ KEYWORDS = {
     "and", "or", "not", "in", "like", "between", "is", "null", "case", "when",
     "then", "else", "end", "cast", "as", "date", "timestamp", "interval", "true",
     "false", "distinct", "extract", "from", "asc", "desc", "by",
+    "select", "where", "group", "having", "order", "limit", "join", "inner",
+    "left", "semi", "anti", "on",
 }
 
 
@@ -406,6 +408,78 @@ def parse_select_list(sql: str) -> List[Expr]:
     if p.peek().kind != "eof":
         raise ValueError(f"trailing tokens in select list: {p.peek()}")
     return out
+
+
+class SelectStatement:
+    """Parsed SELECT: tables + join specs + clauses (the frontend surface of
+    the reference's experimental SQL tier, pyquokka/sql.py:74)."""
+
+    def __init__(self):
+        self.select: List[Expr] = []
+        self.distinct = False
+        self.table: str = ""
+        self.joins: List[Tuple[str, str, Expr]] = []  # (how, table, on-expr)
+        self.where: Optional[Expr] = None
+        self.group_by: List[str] = []
+        self.having: Optional[Expr] = None
+        self.order_by: List[Tuple[str, bool]] = []
+        self.limit: Optional[int] = None
+
+
+def parse_select(sql: str) -> SelectStatement:
+    p = Parser(tokenize(sql))
+    st = SelectStatement()
+    p.expect("kw", "select")
+    st.distinct = bool(p.accept("kw", "distinct"))
+    while True:
+        e = p.parse_expr()
+        if p.accept("kw", "as"):
+            e = Alias(e, p.expect("ident").text)
+        elif p.peek().kind == "ident":
+            e = Alias(e, p.next().text)
+        st.select.append(e)
+        if not p.accept("op", ","):
+            break
+    p.expect("kw", "from")
+    st.table = p.expect("ident").text
+    while True:
+        how = None
+        if p.accept("kw", "join") or (p.accept("kw", "inner") and p.expect("kw", "join")):
+            how = "inner"
+        elif p.peek().kind == "kw" and p.peek().text in ("left", "semi", "anti"):
+            how = p.next().text
+            p.expect("kw", "join")
+        else:
+            break
+        tname = p.expect("ident").text
+        p.expect("kw", "on")
+        cond = p.parse_expr()
+        st.joins.append((how, tname, cond))
+    if p.accept("kw", "where"):
+        st.where = p.parse_expr()
+    if p.accept("kw", "group"):
+        p.expect("kw", "by")
+        while True:
+            st.group_by.append(p.expect("ident").text.split(".")[-1])
+            if not p.accept("op", ","):
+                break
+    if p.accept("kw", "having"):
+        st.having = p.parse_expr()
+    if p.accept("kw", "order"):
+        p.expect("kw", "by")
+        while True:
+            name = p.expect("ident").text.split(".")[-1]
+            desc = bool(p.accept("kw", "desc"))
+            if not desc:
+                p.accept("kw", "asc")
+            st.order_by.append((name, desc))
+            if not p.accept("op", ","):
+                break
+    if p.accept("kw", "limit"):
+        st.limit = int(_num(p.expect("num").text))
+    if p.peek().kind != "eof":
+        raise ValueError(f"trailing tokens in SELECT: {p.peek()}")
+    return st
 
 
 def parse_order_by(sql: str) -> List[Tuple[str, bool]]:

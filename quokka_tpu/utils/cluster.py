@@ -1,0 +1,67 @@
+"""Cluster descriptions.
+
+Reference parity: pyquokka/utils.py — LocalCluster (utils.py:96), EC2Cluster
+(utils.py:25), QuokkaClusterManager (utils.py:191).  The embedded runtime
+executes everything in-process, so LocalCluster is a description object; the
+TPU-pod deployment path (one worker per host, chips addressed through
+jax.distributed + the collective shuffle plane in quokka_tpu.parallel) is
+specified here so multi-host contexts can be constructed uniformly, while
+cloud provisioning (the reference shells out to boto3/ssh) is deliberately out
+of scope for the embedded build and raises with guidance.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+
+class LocalCluster:
+    """Single-host execution: all channels share this process and one
+    accelerator (or the virtual CPU mesh)."""
+
+    def __init__(self, io_per_node: int = 2, exec_per_node: int = 2):
+        self.io_per_node = io_per_node
+        self.exec_per_node = exec_per_node
+        self.leader_ip = "127.0.0.1"
+
+    @property
+    def num_nodes(self) -> int:
+        return 1
+
+
+class TPUPodCluster:
+    """Description of a multi-host TPU deployment: `hosts` run one worker
+    daemon each; device-resident shuffles ride ICI collectives inside the
+    slice; host-mediated shuffles cross DCN.  Constructing a QuokkaContext
+    against this requires the served control store (multi-host runtime tier —
+    see README roadmap)."""
+
+    def __init__(self, hosts: List[str], chips_per_host: int = 4,
+                 coordinator: Optional[str] = None):
+        self.hosts = hosts
+        self.chips_per_host = chips_per_host
+        self.coordinator = coordinator or (hosts[0] if hosts else "127.0.0.1")
+
+    @property
+    def num_nodes(self) -> int:
+        return len(self.hosts)
+
+
+class QuokkaClusterManager:
+    """Provisioning entry points (create/start/stop clusters).  Cloud
+    provisioning is not available in the embedded build."""
+
+    def create_local_cluster(self, **kwargs) -> LocalCluster:
+        return LocalCluster(**kwargs)
+
+    def create_cluster(self, *args, **kwargs):
+        raise NotImplementedError(
+            "cloud cluster provisioning (EC2/GKE) is not available in the "
+            "embedded build; construct a TPUPodCluster from existing hosts "
+            "or use LocalCluster"
+        )
+
+    get_cluster_from_json = create_cluster
+    start_cluster = create_cluster
+    stop_cluster = create_cluster
+    terminate_cluster = create_cluster

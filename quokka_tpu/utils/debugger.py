@@ -1,0 +1,64 @@
+"""Post-mortem debugging: snapshot the control plane + data-plane index.
+
+Reference parity: pyquokka/debugger.py:6-41 (dump all Redis tables + the
+Flight cache index to a pickle) and Coordinator.dump_redis_state's
+pre/post-recovery snapshots (coordinator.py:41-58)."""
+
+from __future__ import annotations
+
+import pickle
+from typing import Optional
+
+
+class Debugger:
+    def __init__(self, graph):
+        self.graph = graph
+
+    def snapshot(self) -> dict:
+        g = self.graph
+        return {
+            "control": g.store.dump(),
+            "cache_index": g.cache.flights_info(),
+            "actors": {
+                a: {
+                    "kind": info.kind,
+                    "channels": info.channels,
+                    "stage": info.stage,
+                    "targets": list(info.targets),
+                    "sorted": info.sorted_actor,
+                }
+                for a, info in g.actors.items()
+            },
+        }
+
+    def dump(self, path: str) -> None:
+        snap = self.snapshot()
+        # tasks/partition specs aren't all picklable; stringify leaves best-effort
+        with open(path, "wb") as f:
+            pickle.dump(_stringify(snap), f)
+
+    def summary(self) -> str:
+        snap = self.snapshot()
+        lines = [f"actors: {len(snap['actors'])}  cached objects: {len(snap['cache_index'])}"]
+        for a, info in sorted(snap["actors"].items()):
+            done = {
+                ch for (aa, ch) in snap["control"]["DST"] if aa == a
+            } if isinstance(snap["control"]["DST"], dict) else set()
+            lines.append(
+                f"  actor {a} ({info['kind']}, stage {info['stage']}): "
+                f"{info['channels']} channels, done={sorted(done)}, "
+                f"targets={info['targets']}"
+            )
+        return "\n".join(lines)
+
+
+def _stringify(obj):
+    try:
+        pickle.dumps(obj)
+        return obj
+    except Exception:
+        if isinstance(obj, dict):
+            return {str(k): _stringify(v) for k, v in obj.items()}
+        if isinstance(obj, (list, tuple, set)):
+            return [_stringify(v) for v in obj]
+        return repr(obj)

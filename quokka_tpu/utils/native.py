@@ -17,12 +17,37 @@ _LIB = None
 _TRIED = False
 
 
+def _build_lib(native_dir: str) -> None:
+    """Best-effort auto-build of the native helper on first use."""
+    import subprocess
+
+    src = os.path.join(native_dir, "columnar.cpp")
+    out = os.path.join(native_dir, "libquokka_native.so")
+    if not os.path.exists(src) or os.path.exists(out):
+        return
+    tmp = out + f".build-{os.getpid()}"
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-fPIC", "-shared", "-std=c++17", "-o", tmp, src],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        os.replace(tmp, out)  # atomic: never leave a torn .so behind
+    except Exception:
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+
+
 def _find_lib():
     global _LIB, _TRIED
     if _TRIED:
         return _LIB
     _TRIED = True
     here = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    _build_lib(os.path.join(here, "native"))
     for cand in (
         os.path.join(here, "native", "libquokka_native.so"),
         os.environ.get("QUOKKA_TPU_NATIVE_LIB", ""),

@@ -1,0 +1,119 @@
+"""Writers, vector search, gramian/covariance, approximate quantiles, native lib."""
+
+import glob
+import os
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from quokka_tpu import QuokkaContext
+
+
+@pytest.fixture
+def ctx():
+    return QuokkaContext()
+
+
+class TestWriters:
+    def test_write_parquet_roundtrip(self, ctx, table, pdf, tmp_path):
+        out = str(tmp_path / "out")
+        names = ctx.from_arrow(table).write_parquet(out, rows_per_file=300)
+        files = sorted(glob.glob(os.path.join(out, "*.parquet")))
+        assert len(files) >= 3 and set(names.filename) == set(files)
+        back = ctx.read_parquet(os.path.join(out, "*.parquet")).collect()
+        assert len(back) == len(pdf)
+        pd.testing.assert_frame_equal(
+            back.sort_values(["k", "v"]).reset_index(drop=True)[pdf.columns.tolist()],
+            pdf.sort_values(["k", "v"]).reset_index(drop=True),
+            check_dtype=False,
+        )
+
+    def test_write_csv(self, ctx, table, pdf, tmp_path):
+        out = str(tmp_path / "csvout")
+        ctx.from_arrow(table).select(["k", "q"]).write_csv(out)
+        back = ctx.read_csv(os.path.join(out, "*.csv")).collect()
+        assert len(back) == len(pdf)
+        assert back.k.sum() == pdf.k.sum()
+
+
+class TestVectors:
+    def test_nearest_neighbors(self, ctx):
+        r = np.random.default_rng(5)
+        n, d, nq, k = 2000, 32, 4, 5
+        vecs = r.normal(size=(n, d)).astype(np.float32)
+        queries = r.normal(size=(nq, d)).astype(np.float32)
+        t = pa.table(
+            {
+                "id": np.arange(n, dtype=np.int64),
+                "emb": pa.FixedSizeListArray.from_arrays(
+                    pa.array(vecs.reshape(-1)), d
+                ),
+            }
+        )
+        got = ctx.from_arrow(t).nearest_neighbors(queries, "emb", k).collect()
+        # oracle
+        vn = vecs / np.linalg.norm(vecs, axis=1, keepdims=True)
+        qn = queries / np.linalg.norm(queries, axis=1, keepdims=True)
+        sims = qn @ vn.T
+        for qi in range(nq):
+            exp_ids = set(np.argsort(-sims[qi])[:k].tolist())
+            got_ids = set(got[got.query_idx == qi].id.tolist())
+            assert got_ids == exp_ids, f"query {qi}"
+
+    def test_nearest_neighbors_multi_batch(self, ctx):
+        from quokka_tpu.dataset.readers import InputArrowDataset
+
+        r = np.random.default_rng(6)
+        n, d = 3000, 16
+        vecs = r.normal(size=(n, d)).astype(np.float32)
+        queries = r.normal(size=(2, d)).astype(np.float32)
+        t = pa.table(
+            {
+                "id": np.arange(n, dtype=np.int64),
+                "emb": pa.FixedSizeListArray.from_arrays(pa.array(vecs.reshape(-1)), d),
+            }
+        )
+        s = ctx.read_dataset(InputArrowDataset(t, batch_rows=256))
+        got = s.nearest_neighbors(queries, "emb", 3).collect()
+        vn = vecs / np.linalg.norm(vecs, axis=1, keepdims=True)
+        qn = queries / np.linalg.norm(queries, axis=1, keepdims=True)
+        sims = qn @ vn.T
+        for qi in range(2):
+            assert set(got[got.query_idx == qi].id) == set(np.argsort(-sims[qi])[:3])
+
+
+class TestLinalg:
+    def test_gramian(self, ctx, table, pdf):
+        got = ctx.from_arrow(table).gramian(["v", "q"]).collect()
+        X = pdf[["v", "q"]].to_numpy(dtype=np.float64)
+        exp = X.T @ X
+        got = got.set_index("column").loc[["v", "q"], ["v", "q"]].to_numpy()
+        np.testing.assert_allclose(got, exp, rtol=1e-4)
+
+    def test_covariance(self, ctx, table, pdf):
+        got = ctx.from_arrow(table).covariance(["v", "q"]).collect()
+        X = pdf[["v", "q"]].to_numpy(dtype=np.float64)
+        exp = np.cov(X.T, bias=True)
+        got = got.set_index("column").loc[["v", "q"], ["v", "q"]].to_numpy()
+        np.testing.assert_allclose(got, exp, rtol=1e-3, atol=1e-4)
+
+    def test_approximate_quantile(self, ctx, table, pdf):
+        got = ctx.from_arrow(table).approximate_quantile("v", [0.1, 0.5, 0.9]).collect()
+        exp = np.quantile(pdf.v, [0.1, 0.5, 0.9])
+        got = got.sort_values("quantile").v.to_numpy()
+        np.testing.assert_allclose(got, exp, atol=0.15)
+
+
+class TestNative:
+    def test_hash_parity(self):
+        from quokka_tpu.ops.batch import fnv1a64
+        from quokka_tpu.utils import native
+
+        vals = ["alpha", "beta", "", "äöü", None]
+        out = native.fnv1a64_many(vals)
+        if out is None:
+            pytest.skip("native lib not built")
+        exp = [fnv1a64(v) if v is not None else 0 for v in vals]
+        np.testing.assert_array_equal(out, np.array(exp, dtype=np.uint64))

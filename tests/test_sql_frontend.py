@@ -1,0 +1,75 @@
+"""SQL frontend tests: SELECT over registered tables."""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from quokka_tpu import QuokkaContext
+
+
+@pytest.fixture
+def env(table, pdf):
+    ctx = QuokkaContext()
+    ctx.register("t", ctx.from_arrow(table))
+    r = np.random.default_rng(1)
+    dim = pa.table(
+        {"k": np.arange(20, dtype=np.int64), "label": [f"L{i%4}" for i in range(20)]}
+    )
+    ctx.register("dim", ctx.from_arrow(dim))
+    return ctx, pdf, dim.to_pandas()
+
+
+class TestSql:
+    def test_projection_where(self, env):
+        ctx, pdf, _ = env
+        got = ctx.sql("select k, v * 2 as v2 from t where q > 25").collect()
+        exp = pdf[pdf.q > 25]
+        assert len(got) == len(exp)
+        np.testing.assert_allclose(sorted(got.v2), sorted(exp.v * 2))
+
+    def test_group_by_having_order(self, env):
+        ctx, pdf, _ = env
+        got = ctx.sql(
+            "select k, sum(v) as sv, count(*) as n from t "
+            "group by k having count(*) > 30 order by k"
+        ).collect()
+        exp = (
+            pdf.groupby("k")
+            .agg(sv=("v", "sum"), n=("v", "size"))
+            .reset_index()
+        )
+        exp = exp[exp.n > 30].reset_index(drop=True)
+        pd.testing.assert_frame_equal(got, exp, check_dtype=False, rtol=1e-9)
+
+    def test_join(self, env):
+        ctx, pdf, dimdf = env
+        got = ctx.sql(
+            "select label, count(*) as n from t join dim on k = k "
+            "group by label order by label"
+        ).collect()
+        exp = (
+            pdf.merge(dimdf, on="k").groupby("label").size().reset_index(name="n")
+        )
+        pd.testing.assert_frame_equal(got, exp, check_dtype=False)
+
+    def test_distinct_limit(self, env):
+        ctx, pdf, _ = env
+        got = ctx.sql("select distinct s from t").collect()
+        assert set(got.s) == set(pdf.s)
+        got = ctx.sql("select k from t order by k desc limit 3").collect()
+        assert got.k.tolist() == sorted(pdf.k, reverse=True)[:3]
+
+    def test_agg_schema_matches_select_list(self, env):
+        ctx, pdf, _ = env
+        got = ctx.sql("select count(*) as n from t group by k").collect()
+        assert list(got.columns) == ["n"]  # group key NOT auto-included
+        got = ctx.sql("select k as kk, sum(v) as sv from t group by k order by kk").collect()
+        assert list(got.columns) == ["kk", "sv"]
+        exp = pdf.groupby("k").v.sum().sort_index()
+        np.testing.assert_allclose(got.sv.to_numpy(), exp.to_numpy())
+
+    def test_unknown_table(self, env):
+        ctx, _, _ = env
+        with pytest.raises(ValueError, match="unknown table"):
+            ctx.sql("select x from nope")
