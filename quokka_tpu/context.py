@@ -231,7 +231,7 @@ class QuokkaContext:
         if self.optimize_plans:
             from quokka_tpu.optimizer import optimize
 
-            sink_id = optimize(sub, sink_id)
+            sink_id = optimize(sub, sink_id, exec_channels=self.exec_channels)
         self._assign_stages(sub, sink_id)
         graph = TaskGraph(self.exec_config)
         actor_of: Dict[int, int] = {}
@@ -309,7 +309,7 @@ class QuokkaContext:
         if self.optimize_plans:
             from quokka_tpu.optimizer import optimize
 
-            sink_id = optimize(sub, sink_id)
+            sink_id = optimize(sub, sink_id, exec_channels=self.exec_channels)
         self._assign_stages(sub, sink_id)
         lines = []
         for nid in self._toposort(sub, sink_id):

@@ -51,23 +51,29 @@ class GramianExecutor(Executor):
     def done(self, channel):
         if self.gram is None:
             return None
+        # emit RAW partials (gram rows + a sums row + a count row): channels
+        # must combine raw moments before any normalization, otherwise
+        # per-channel covariances sum to N-channels times the true value
         g = np.asarray(self.gram, dtype=np.float64)
-        if self.covariance and self.count > 1:
-            mu = np.asarray(self.sums, dtype=np.float64) / self.count
-            g = g / self.count - np.outer(mu, mu)
-        cols = {"__row": np.array(self.columns, dtype=object)}
+        sums = np.asarray(self.sums, dtype=np.float64)
+        labels = list(self.columns) + ["__sums__", "__count__"]
+        count_row = np.zeros(len(self.columns))
+        count_row[0] = self.count
+        mat = np.vstack([g, sums[None, :], count_row[None, :]])
+        cols = {"__row": np.array(labels, dtype=object)}
         for j, c in enumerate(self.columns):
-            cols[c] = g[:, j]
+            cols[c] = mat[:, j]
         self.gram = None
         self.sums = None
         return bridge.arrow_to_device(pa.table(cols))
 
 
 class CombineGramianExecutor(Executor):
-    """Sum per-channel gramian partials (matrix rows keyed by __row)."""
+    """Sum per-channel RAW gramian partials, then normalize once."""
 
     def __init__(self, columns: Sequence[str], covariance: bool = False):
         self.columns = list(columns)
+        self.covariance = covariance
         self.parts: List[DeviceBatch] = []
 
     def execute(self, batches, stream_id, channel):
@@ -83,7 +89,16 @@ class CombineGramianExecutor(Executor):
         acc = dfs[0].set_index("__row")[self.columns]
         for d in dfs[1:]:
             acc = acc + d.set_index("__row")[self.columns]
-        out = acc.reset_index().rename(columns={"__row": "column"})
+        g = acc.loc[self.columns].to_numpy()
+        if self.covariance:
+            count = float(acc.loc["__count__"].to_numpy()[0])
+            sums = acc.loc["__sums__"].to_numpy()
+            if count > 1:
+                mu = sums / count
+                g = g / count - np.outer(mu, mu)
+        out = pd.DataFrame({"column": self.columns})
+        for j, c in enumerate(self.columns):
+            out[c] = g[:, j]
         return bridge.arrow_to_device(pa.Table.from_pandas(out, preserve_index=False))
 
 

@@ -19,6 +19,7 @@ from quokka_tpu.target_info import (
     BroadcastPartitioner,
     HashPartitioner,
     PassThroughPartitioner,
+    RangePartitioner,
     TargetInfo,
 )
 
@@ -252,12 +253,12 @@ class AggNode(Node):
             n_final,
             self.stage,
         )
-        if order_by and n_final > 1:
-            # per-channel order is local; merge to a global order (+ limit)
+        if (order_by or limit is not None) and n_final > 1:
+            # per-channel order/limit is local; merge to the global result
             from quokka_tpu.executors.sql_execs import SortExecutor, TopKExecutor
 
-            names = [n for n, _ in order_by]
-            desc = [d for _, d in order_by]
+            names = [n for n, _ in (order_by or [])]
+            desc = [d for _, d in (order_by or [])]
             if limit is not None:
                 merge_factory = lambda: TopKExecutor(names, limit, desc)
             else:
@@ -323,27 +324,68 @@ class TopKNode(Node):
 
 
 class SortNode(Node):
-    """Global sort: single-channel blocking sort (external merge later)."""
+    """Global sort.  When the upstream chain is sampleable, boundaries come
+    from a sample and the sort runs range-partitioned in parallel (channel i
+    owns value range i; ordered channel concat is globally sorted — the
+    parallel discipline of SuperFastSortExecutor, sql_executors.py:88).
+    Otherwise falls back to a single-channel blocking sort."""
 
     def __init__(self, parents, schema, by, descending):
         super().__init__(parents, schema)
         self.by = by
         self.descending = descending
+        self.boundaries = None  # filled by the optimizer/sampling when possible
 
     def lower(self, ctx, graph, actor_of, node_id):
         from quokka_tpu.executors.sql_execs import SortExecutor
 
         by, desc = self.by, self.descending
-        actor_of[node_id] = graph.new_exec_node(
-            lambda: SortExecutor(by, desc),
-            {0: (actor_of[self.parents[0]], _passthrough_edge())},
-            1,
-            self.stage,
-        )
+        n = self.channels or ctx.exec_channels
+        if self.boundaries is not None and n > 1:
+            bounds = list(self.boundaries)
+            if desc and desc[0]:
+                # descending: reverse range ownership so channel order still
+                # concatenates into the requested global order
+                edge = TargetInfo(RangePartitioner(by[0], bounds))
+                # channel c gets range c; invert by flipping partition ids
+                from quokka_tpu.target_info import FunctionPartitioner
+
+                def flip(batch, src_ch, n_tgt, _bounds=tuple(bounds)):
+                    import jax.numpy as jnp
+
+                    from quokka_tpu.ops import kernels as K
+
+                    col_arr = batch.columns[by[0]].data
+                    pids = jnp.searchsorted(
+                        jnp.asarray(list(_bounds)), col_arr, side="right"
+                    ).astype(jnp.int32)
+                    pids = (n_tgt - 1) - pids
+                    return dict(enumerate(K.split_by_partition(batch, pids, n_tgt)))
+
+                edge = TargetInfo(FunctionPartitioner(flip))
+            else:
+                edge = TargetInfo(RangePartitioner(by[0], bounds))
+            actor_of[node_id] = graph.new_exec_node(
+                lambda: SortExecutor(by, desc),
+                {0: (actor_of[self.parents[0]], edge)},
+                n,
+                self.stage,
+                # consumers must read channel 0's range before channel 1's:
+                # SAT-interleaved delivery preserves the global order
+                sorted_actor=True,
+            )
+        else:
+            actor_of[node_id] = graph.new_exec_node(
+                lambda: SortExecutor(by, desc),
+                {0: (actor_of[self.parents[0]], _passthrough_edge())},
+                1,
+                self.stage,
+            )
         self.sorted_by = list(by)
 
     def describe(self):
-        return f"Sort({self.by})"
+        par = f", parallel x{self.channels or '?'}" if self.boundaries else ""
+        return f"Sort({self.by}{par})"
 
 
 class SinkNode(Node):

@@ -24,10 +24,12 @@ from quokka_tpu.expression import Expr, conjoin, rename_columns, split_conjuncts
 BROADCAST_THRESHOLD = 65_536  # build rows below this skip the probe-side shuffle
 
 
-def optimize(sub: Dict[int, logical.Node], sink_id: int) -> int:
+def optimize(sub: Dict[int, logical.Node], sink_id: int,
+             exec_channels: int = 2) -> int:
     push_filters(sub, sink_id)
     early_projection(sub, sink_id)
     choose_broadcast(sub, sink_id)
+    plan_parallel_sorts(sub, sink_id, exec_channels)
     return sink_id
 
 
@@ -271,6 +273,88 @@ def choose_broadcast(sub: Dict[int, logical.Node], sink_id: int) -> None:
         est = _estimate_subtree(sub, node.parents[1], cat)
         if est is not None and est <= BROADCAST_THRESHOLD:
             node.broadcast = True
+
+
+def plan_parallel_sorts(sub: Dict[int, logical.Node], sink_id: int,
+                        exec_channels: int) -> None:
+    """Give global sorts range boundaries from a source sample so they run
+    partitioned across channels instead of on one."""
+    if exec_channels < 2:
+        return
+    from quokka_tpu.catalog import Catalog
+
+    global _CATALOG
+    if _CATALOG is None:
+        _CATALOG = Catalog()
+    for nid in _reachable(sub, sink_id):
+        node = sub[nid]
+        if not isinstance(node, logical.SortNode) or node.boundaries is not None:
+            continue
+        if len(node.by) != 1:
+            continue
+        col = node.by[0]
+        sample = _sample_subtree(sub, node.parents[0], _CATALOG)
+        if sample is None or sample.num_rows < 4 * exec_channels:
+            continue
+        if col not in sample.column_names:
+            continue
+        import numpy as np
+        import pyarrow as pa
+
+        arr = sample.column(col)
+        t = arr.type
+        if not (pa.types.is_integer(t) or pa.types.is_floating(t)
+                or pa.types.is_date32(t)):
+            continue  # string/timestamp boundaries: single-channel fallback
+        vals = arr.combine_chunks().cast(
+            pa.int64() if pa.types.is_date32(t) else t
+        ).to_numpy(zero_copy_only=False)
+        qs = np.quantile(vals, [i / exec_channels for i in range(1, exec_channels)])
+        if pa.types.is_integer(t) or pa.types.is_date32(t):
+            qs = np.unique(qs.astype(np.int64))
+        else:
+            qs = np.unique(qs)
+        if len(qs) == exec_channels - 1:
+            node.boundaries = qs.tolist()
+            node.channels = exec_channels
+
+
+def _sample_subtree(sub, nid: int, cat):
+    """Sample rows flowing out of a Filter/Projection/Map chain over a source
+    (applies the chain's predicates to the sample)."""
+    node = sub[nid]
+    preds = []
+    guard = 0
+    while guard < 64:
+        guard += 1
+        if isinstance(node, logical.SourceNode):
+            sample = cat._sample(node.reader)
+            if sample is None or sample.num_rows == 0:
+                return None
+            all_preds = preds + (
+                [node.predicate] if node.predicate is not None else []
+            )
+            if all_preds:
+                from quokka_tpu.ops import bridge, kernels
+                from quokka_tpu.ops.expr_compile import CompileError, evaluate_predicate
+
+                try:
+                    b = bridge.arrow_to_device(sample)
+                    for p in all_preds:
+                        b = kernels.apply_mask(b, evaluate_predicate(p, b))
+                    sample = bridge.device_to_arrow(kernels.compact(b))
+                except CompileError:
+                    return None
+            return sample
+        if isinstance(node, logical.FilterNode):
+            preds.append(node.predicate)
+            node = sub[node.parents[0]]
+            continue
+        if isinstance(node, (logical.ProjectionNode, logical.MapNode)):
+            node = sub[node.parents[0]]
+            continue
+        return None
+    return None
 
 
 def _estimate_subtree(sub, nid: int, cat) -> Optional[float]:

@@ -18,15 +18,23 @@ class ResultDataset:
     def __init__(self, name: str = "result"):
         self.name = name
         self._lock = threading.Lock()
-        self._tables: Dict[int, List[pa.Table]] = defaultdict(list)
+        # keyed by (channel, seq): fault-tolerant tape replay re-emits the
+        # same seqs, which must overwrite rather than duplicate
+        self._tables: Dict[int, Dict[int, pa.Table]] = defaultdict(dict)
 
-    def append(self, channel: int, table: pa.Table) -> None:
+    def append(self, channel: int, table: pa.Table, seq: Optional[int] = None) -> None:
         with self._lock:
-            self._tables[channel].append(table)
+            if seq is None:
+                seq = len(self._tables[channel])
+            self._tables[channel][seq] = table
 
     def to_arrow(self) -> Optional[pa.Table]:
         with self._lock:
-            tables = [t for ch in sorted(self._tables) for t in self._tables[ch]]
+            tables = [
+                self._tables[ch][s]
+                for ch in sorted(self._tables)
+                for s in sorted(self._tables[ch])
+            ]
         if not tables:
             return None
         # unify dictionary-encoded vs plain string columns across chunks

@@ -557,7 +557,8 @@ class Engine:
 
     def _emit(self, info: ActorInfo, channel: int, seq: int, out: DeviceBatch) -> None:
         if info.blocking_dataset is not None:
-            info.blocking_dataset.append(channel, bridge.device_to_arrow(out))
+            # seq-keyed so fault-tolerant replay overwrites, never duplicates
+            info.blocking_dataset.append(channel, bridge.device_to_arrow(out), seq=seq)
         else:
             self.push(info.id, channel, seq, out)
 

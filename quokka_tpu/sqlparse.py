@@ -50,6 +50,11 @@ KEYWORDS = {
     "and", "or", "not", "in", "like", "between", "is", "null", "case", "when",
     "then", "else", "end", "cast", "as", "date", "timestamp", "interval", "true",
     "false", "distinct", "extract", "from", "asc", "desc", "by",
+}
+# statement-level words stay ordinary identifiers inside expressions (columns
+# named `left`, `order`, `on`, ... must keep parsing in filter_sql/agg_sql);
+# parse_select matches them contextually via Parser.accept_word
+STATEMENT_WORDS = {
     "select", "where", "group", "having", "order", "limit", "join", "inner",
     "left", "semi", "anti", "on",
 }
@@ -109,6 +114,23 @@ class Parser:
         t = self.accept(kind, text)
         if t is None:
             raise ValueError(f"expected {text or kind}, got {self.peek()}")
+        return t
+
+    # statement-level words are ordinary identifiers in expression context;
+    # match them contextually (kind may be ident or kw)
+    def peek_word(self, word: str) -> bool:
+        t = self.peek()
+        return t.kind in ("ident", "kw") and t.text.lower() == word
+
+    def accept_word(self, word: str) -> Optional[Token]:
+        if self.peek_word(word):
+            return self.next()
+        return None
+
+    def expect_word(self, word: str) -> Token:
+        t = self.accept_word(word)
+        if t is None:
+            raise ValueError(f"expected {word}, got {self.peek()}")
         return t
 
     # -- grammar -------------------------------------------------------------
@@ -429,13 +451,13 @@ class SelectStatement:
 def parse_select(sql: str) -> SelectStatement:
     p = Parser(tokenize(sql))
     st = SelectStatement()
-    p.expect("kw", "select")
+    p.expect_word("select")
     st.distinct = bool(p.accept("kw", "distinct"))
     while True:
         e = p.parse_expr()
         if p.accept("kw", "as"):
             e = Alias(e, p.expect("ident").text)
-        elif p.peek().kind == "ident":
+        elif p.peek().kind == "ident" and p.peek().text.lower() not in STATEMENT_WORDS:
             e = Alias(e, p.next().text)
         st.select.append(e)
         if not p.accept("op", ","):
@@ -444,28 +466,28 @@ def parse_select(sql: str) -> SelectStatement:
     st.table = p.expect("ident").text
     while True:
         how = None
-        if p.accept("kw", "join") or (p.accept("kw", "inner") and p.expect("kw", "join")):
+        if p.accept_word("join") or (p.accept_word("inner") and p.expect_word("join")):
             how = "inner"
-        elif p.peek().kind == "kw" and p.peek().text in ("left", "semi", "anti"):
-            how = p.next().text
-            p.expect("kw", "join")
+        elif any(p.peek_word(w) for w in ("left", "semi", "anti")):
+            how = p.next().text.lower()
+            p.expect_word("join")
         else:
             break
         tname = p.expect("ident").text
-        p.expect("kw", "on")
+        p.expect_word("on")
         cond = p.parse_expr()
         st.joins.append((how, tname, cond))
-    if p.accept("kw", "where"):
+    if p.accept_word("where"):
         st.where = p.parse_expr()
-    if p.accept("kw", "group"):
+    if p.accept_word("group"):
         p.expect("kw", "by")
         while True:
             st.group_by.append(p.expect("ident").text.split(".")[-1])
             if not p.accept("op", ","):
                 break
-    if p.accept("kw", "having"):
+    if p.accept_word("having"):
         st.having = p.parse_expr()
-    if p.accept("kw", "order"):
+    if p.accept_word("order"):
         p.expect("kw", "by")
         while True:
             name = p.expect("ident").text.split(".")[-1]
@@ -475,7 +497,7 @@ def parse_select(sql: str) -> SelectStatement:
             st.order_by.append((name, desc))
             if not p.accept("op", ","):
                 break
-    if p.accept("kw", "limit"):
+    if p.accept_word("limit"):
         st.limit = int(_num(p.expect("num").text))
     if p.peek().kind != "eof":
         raise ValueError(f"trailing tokens in SELECT: {p.peek()}")

@@ -23,8 +23,10 @@ def _build_lib(native_dir: str) -> None:
 
     src = os.path.join(native_dir, "columnar.cpp")
     out = os.path.join(native_dir, "libquokka_native.so")
-    if not os.path.exists(src) or os.path.exists(out):
+    if not os.path.exists(src):
         return
+    if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
+        return  # up to date; rebuild only when the source is newer
     tmp = out + f".build-{os.getpid()}"
     try:
         subprocess.run(

@@ -175,3 +175,29 @@ class TestBroadcast:
         got = ctx.read_parquet(fp).join(ctx.read_parquet(dp), on="k").count()
         exp = len(fdf.merge(ddf, on="k"))
         assert got == exp
+
+
+class TestParallelSort:
+    def test_sort_becomes_range_partitioned(self, pq_env):
+        fp, dp, fdf, ddf = pq_env
+        ctx = QuokkaContext(exec_channels=2)
+        q = ctx.read_parquet(fp).sort(["x"])
+        sub, sid = optimized_plan(q)
+        sorts = find_nodes(sub, sid, logical.SortNode)
+        assert len(sorts) == 1 and sorts[0].boundaries is not None
+        assert len(sorts[0].boundaries) == 1  # n_channels - 1
+
+    def test_parallel_sort_correct(self, pq_env):
+        fp, dp, fdf, ddf = pq_env
+        for desc in (False, True):
+            ctx = QuokkaContext(exec_channels=2)
+            got = ctx.read_parquet(fp).sort(["x"], [desc]).collect()
+            exp = fdf.sort_values("x", ascending=not desc).reset_index(drop=True)
+            np.testing.assert_allclose(got.x.to_numpy(), exp.x.to_numpy())
+
+    def test_parallel_sort_with_filter(self, pq_env):
+        fp, dp, fdf, ddf = pq_env
+        ctx = QuokkaContext(exec_channels=2)
+        got = ctx.read_parquet(fp).filter(col("k") > 50).sort(["x"]).collect()
+        exp = fdf[fdf.k > 50].sort_values("x").reset_index(drop=True)
+        np.testing.assert_allclose(got.x.to_numpy(), exp.x.to_numpy())

@@ -73,3 +73,37 @@ class TestSql:
         ctx, _, _ = env
         with pytest.raises(ValueError, match="unknown table"):
             ctx.sql("select x from nope")
+
+
+class TestRegressions:
+    def test_statement_words_as_column_names(self):
+        # `left`, `order`, `on`, `limit` must keep working as column names in
+        # the expression surfaces (regression: SELECT keywords broke them)
+        from quokka_tpu import sqlparse
+
+        e = sqlparse.parse_expression("left > 1 and limit < 5")
+        assert e.required_columns() == {"left", "limit"}
+        ctx = QuokkaContext()
+        t = pa.table({"left": np.arange(10, dtype=np.int64),
+                      "order": np.arange(10, dtype=np.float64)})
+        got = ctx.from_arrow(t).filter_sql("left > 6").collect()
+        assert len(got) == 3
+
+    def test_group_limit_without_order_is_global(self, env):
+        ctx, pdf, _ = env
+        got = ctx.sql("select k, sum(v) as sv from t group by k limit 3").collect()
+        assert len(got) == 3  # regression: per-channel limit returned 2x
+
+    def test_covariance_multi_channel(self):
+        from quokka_tpu.dataset.readers import InputArrowDataset
+
+        r = np.random.default_rng(9)
+        n = 4000
+        t = pa.table({"v": r.normal(size=n), "q": r.normal(size=n) * 2})
+        ctx = QuokkaContext(exec_channels=2)
+        s = ctx.read_dataset(InputArrowDataset(t, batch_rows=256))
+        got = s.covariance(["v", "q"]).collect()
+        X = t.to_pandas()[["v", "q"]].to_numpy()
+        exp = np.cov(X.T, bias=True)
+        gm = got.set_index("column").loc[["v", "q"], ["v", "q"]].to_numpy()
+        np.testing.assert_allclose(gm, exp, rtol=1e-3, atol=1e-4)

@@ -263,3 +263,147 @@ def test_q14(env):
     promo = rev.where(m.p_type.str.startswith("PROMO"), 0.0)
     exp = 100.0 * promo.sum() / rev.sum()
     np.testing.assert_allclose(got.promo_revenue[0], exp, rtol=1e-9)
+
+
+def test_q4(env):
+    """Semi-join shape (exists subquery in the reference)."""
+    ctx, paths, dfs = env
+    s = streams(env)
+    got = (
+        s["orders"]
+        .filter_sql(
+            "o_orderdate >= date '1993-07-01' and o_orderdate < date '1993-10-01'"
+        )
+        .join(
+            s["lineitem"].filter_sql("l_commitdate < l_receiptdate"),
+            left_on="o_orderkey",
+            right_on="l_orderkey",
+            how="semi",
+        )
+        .groupby("o_orderpriority")
+        .agg_sql("count(*) as order_count")
+        .collect()
+    )
+    o, l = dfs["orders"], dfs["lineitem"]
+    import datetime
+
+    of = o[
+        (o.o_orderdate >= datetime.date(1993, 7, 1))
+        & (o.o_orderdate < datetime.date(1993, 10, 1))
+    ]
+    lk = set(l[l.l_commitdate < l.l_receiptdate].l_orderkey)
+    sel = of[of.o_orderkey.isin(lk)]
+    exp = sel.groupby("o_orderpriority").size().reset_index(name="order_count")
+    assert len(exp) > 0
+    sorted_eq(got, exp, by=["o_orderpriority"])
+
+
+def test_q10(env):
+    """Join chain + group-by + top-k by revenue."""
+    ctx, paths, dfs = env
+    s = streams(env)
+    got = (
+        s["lineitem"]
+        .filter_sql("l_returnflag = 'R'")
+        .join(
+            s["orders"].filter_sql(
+                "o_orderdate >= date '1993-10-01' and o_orderdate < date '1994-01-01'"
+            ),
+            left_on="l_orderkey",
+            right_on="o_orderkey",
+        )
+        .join(s["customer"], left_on="o_custkey", right_on="c_custkey")
+        .join(s["nation"], left_on="c_nationkey", right_on="n_nationkey")
+        # the build side's join key (c_custkey) is consumed by the join;
+        # group on the equal probe-side key o_custkey
+        .groupby(["o_custkey", "c_name", "n_name"])
+        .agg_sql("sum(l_extendedprice * (1 - l_discount)) as revenue")
+        .top_k(["revenue"], 20, [True])
+        .collect()
+    )
+    import datetime
+
+    l, o, c, n = dfs["lineitem"], dfs["orders"], dfs["customer"], dfs["nation"]
+    of = o[
+        (o.o_orderdate >= datetime.date(1993, 10, 1))
+        & (o.o_orderdate < datetime.date(1994, 1, 1))
+    ]
+    m = (
+        l[l.l_returnflag == "R"]
+        .merge(of, left_on="l_orderkey", right_on="o_orderkey")
+        .merge(c, left_on="o_custkey", right_on="c_custkey")
+        .merge(n, left_on="c_nationkey", right_on="n_nationkey")
+    )
+    m["rev"] = m.l_extendedprice * (1 - m.l_discount)
+    exp = (
+        m.groupby(["c_custkey", "c_name", "n_name"])
+        .rev.sum()
+        .reset_index(name="revenue")
+        .nlargest(20, "revenue")
+    )
+    assert len(exp) > 0
+    np.testing.assert_allclose(
+        np.sort(got.revenue.to_numpy())[::-1],
+        np.sort(exp.revenue.to_numpy())[::-1],
+        rtol=1e-9,
+    )
+
+
+def test_q19(env):
+    """Disjunctive multi-attribute predicate (OR of ANDs)."""
+    ctx, paths, dfs = env
+    s = streams(env)
+    got = (
+        s["lineitem"]
+        .join(s["part"], left_on="l_partkey", right_on="p_partkey")
+        .filter_sql(
+            "(p_brand = 'Brand#12' and l_quantity >= 1 and l_quantity <= 11 "
+            " and p_size between 1 and 5) "
+            "or (p_brand = 'Brand#23' and l_quantity >= 10 and l_quantity <= 20 "
+            " and p_size between 1 and 10) "
+            "or (p_brand = 'Brand#34' and l_quantity >= 20 and l_quantity <= 30 "
+            " and p_size between 1 and 15)"
+        )
+        .agg_sql("sum(l_extendedprice * (1 - l_discount)) as revenue, count(*) as n")
+        .collect()
+    )
+    l, p = dfs["lineitem"], dfs["part"]
+    m = l.merge(p, left_on="l_partkey", right_on="p_partkey")
+    cond = (
+        ((m.p_brand == "Brand#12") & m.l_quantity.between(1, 11) & m.p_size.between(1, 5))
+        | ((m.p_brand == "Brand#23") & m.l_quantity.between(10, 20) & m.p_size.between(1, 10))
+        | ((m.p_brand == "Brand#34") & m.l_quantity.between(20, 30) & m.p_size.between(1, 15))
+    )
+    f = m[cond]
+    assert len(f) > 0
+    np.testing.assert_allclose(
+        got.revenue[0], (f.l_extendedprice * (1 - f.l_discount)).sum(), rtol=1e-9
+    )
+    assert got.n[0] == len(f)
+
+
+def test_q13(env):
+    """Left join + count + distribution of counts (agg over agg)."""
+    ctx, paths, dfs = env
+    s = streams(env)
+    got = (
+        s["customer"]
+        .join(
+            s["orders"].filter(~col("o_comment").str.contains("special")),
+            left_on="c_custkey",
+            right_on="o_custkey",
+            how="left",
+        )
+        .groupby("c_custkey")
+        .agg_sql("count(o_orderkey) as c_count")
+        .groupby("c_count")
+        .agg_sql("count(*) as custdist")
+        .collect()
+    )
+    c, o = dfs["customer"], dfs["orders"]
+    of = o[~o.o_comment.str.contains("special")]
+    m = c.merge(of, left_on="c_custkey", right_on="o_custkey", how="left")
+    cc = m.groupby("c_custkey").o_orderkey.count().reset_index(name="c_count")
+    exp = cc.groupby("c_count").size().reset_index(name="custdist")
+    assert len(exp) > 1
+    sorted_eq(got, exp, by=["c_count"])
