@@ -363,7 +363,13 @@ class DataStream:
     def sort(self, by, descending=None) -> "DataStream":
         by = [by] if isinstance(by, str) else list(by)
         descending = descending or [False] * len(by)
-        return self._child(logical.SortNode([self.node_id], self.schema, by, descending))
+        node = logical.SortNode([self.node_id], self.schema, by, descending)
+        # the output IS ordered: mark it at plan time so chained verbs lower
+        # as sorted actors and the SAT-interleaved delivery preserves the
+        # global order across a parallel (range-partitioned) sort's channels
+        node.sorted_by = list(by)
+        nid = self.ctx.add_node(node)
+        return OrderedStream(self.ctx, nid)
 
 
 class _HeadNode(logical.Node):

@@ -343,28 +343,11 @@ class SortNode(Node):
         n = self.channels or ctx.exec_channels
         if self.boundaries is not None and n > 1:
             bounds = list(self.boundaries)
-            if desc and desc[0]:
-                # descending: reverse range ownership so channel order still
-                # concatenates into the requested global order
-                edge = TargetInfo(RangePartitioner(by[0], bounds))
-                # channel c gets range c; invert by flipping partition ids
-                from quokka_tpu.target_info import FunctionPartitioner
-
-                def flip(batch, src_ch, n_tgt, _bounds=tuple(bounds)):
-                    import jax.numpy as jnp
-
-                    from quokka_tpu.ops import kernels as K
-
-                    col_arr = batch.columns[by[0]].data
-                    pids = jnp.searchsorted(
-                        jnp.asarray(list(_bounds)), col_arr, side="right"
-                    ).astype(jnp.int32)
-                    pids = (n_tgt - 1) - pids
-                    return dict(enumerate(K.split_by_partition(batch, pids, n_tgt)))
-
-                edge = TargetInfo(FunctionPartitioner(flip))
-            else:
-                edge = TargetInfo(RangePartitioner(by[0], bounds))
+            # descending: reversed range ownership keeps channel-order concat
+            # equal to the requested global order
+            edge = TargetInfo(
+                RangePartitioner(by[0], bounds, descending=bool(desc and desc[0]))
+            )
             actor_of[node_id] = graph.new_exec_node(
                 lambda: SortExecutor(by, desc),
                 {0: (actor_of[self.parents[0]], edge)},
@@ -380,6 +363,7 @@ class SortNode(Node):
                 {0: (actor_of[self.parents[0]], _passthrough_edge())},
                 1,
                 self.stage,
+                sorted_actor=True,
             )
         self.sorted_by = list(by)
 

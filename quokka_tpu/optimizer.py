@@ -306,15 +306,25 @@ def plan_parallel_sorts(sub: Dict[int, logical.Node], sink_id: int,
         if not (pa.types.is_integer(t) or pa.types.is_floating(t)
                 or pa.types.is_date32(t)):
             continue  # string/timestamp boundaries: single-channel fallback
-        vals = arr.combine_chunks().cast(
+        vals = arr.combine_chunks().drop_null().cast(
             pa.int64() if pa.types.is_date32(t) else t
         ).to_numpy(zero_copy_only=False)
+        if pa.types.is_floating(t):
+            vals = vals[~np.isnan(vals)]
+        if len(vals) < 4 * exec_channels:
+            continue
         qs = np.quantile(vals, [i / exec_channels for i in range(1, exec_channels)])
         if pa.types.is_integer(t) or pa.types.is_date32(t):
             qs = np.unique(qs.astype(np.int64))
         else:
             qs = np.unique(qs)
-        if len(qs) == exec_channels - 1:
+        # spread sanity: degenerate/clustered samples (all quantiles at one
+        # extreme) would route everything to one channel — fall back instead
+        if (
+            len(qs) == exec_channels - 1
+            and vals.min() < qs[0]
+            and qs[-1] < vals.max()
+        ):
             node.boundaries = qs.tolist()
             node.channels = exec_channels
 
@@ -336,14 +346,26 @@ def _sample_subtree(sub, nid: int, cat):
             )
             if all_preds:
                 from quokka_tpu.ops import bridge, kernels
-                from quokka_tpu.ops.expr_compile import CompileError, evaluate_predicate
+                from quokka_tpu.ops.expr_compile import evaluate_predicate
 
+                # project down before bridging: the full schema may contain
+                # columns the bridge can't represent (structs/lists) that the
+                # query never touches
+                import numpy as np
+                import pyarrow as pa
+
+                needed = set()
+                for p in all_preds:
+                    needed |= p.required_columns()
+                keep = [c for c in sample.column_names if c in needed]
                 try:
-                    b = bridge.arrow_to_device(sample)
+                    b = bridge.arrow_to_device(sample.select(keep))
                     for p in all_preds:
                         b = kernels.apply_mask(b, evaluate_predicate(p, b))
-                    sample = bridge.device_to_arrow(kernels.compact(b))
-                except CompileError:
+                    mask = np.asarray(b.valid)[: sample.num_rows]
+                    sample = sample.filter(pa.array(mask))
+                except Exception:
+                    # sampling is advisory; any failure means "no estimate"
                     return None
             return sample
         if isinstance(node, logical.FilterNode):

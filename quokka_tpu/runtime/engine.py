@@ -279,6 +279,8 @@ class Engine:
         col = batch.columns[part.key]
         bounds = jnp.asarray(part.boundaries)
         pids = jnp.searchsorted(bounds, col.data, side="right").astype(jnp.int32)
+        if part.descending:
+            pids = (n_tgt - 1) - pids  # channel 0 owns the highest range
         return dict(enumerate(kernels.split_by_partition(batch, pids, n_tgt)))
 
     # -- push (core.py:276-376) ---------------------------------------------

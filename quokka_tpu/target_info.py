@@ -40,6 +40,7 @@ class HashPartitioner(Partitioner):
 class RangePartitioner(Partitioner):
     key: str = ""
     boundaries: List = dataclasses.field(default_factory=list)  # n-1 split points
+    descending: bool = False  # channel 0 owns the HIGHEST range when set
 
 
 @dataclasses.dataclass

@@ -201,3 +201,25 @@ class TestParallelSort:
         got = ctx.read_parquet(fp).filter(col("k") > 50).sort(["x"]).collect()
         exp = fdf[fdf.k > 50].sort_values("x").reset_index(drop=True)
         np.testing.assert_allclose(got.x.to_numpy(), exp.x.to_numpy())
+
+    def test_sort_then_chain_stays_ordered(self, pq_env):
+        # regression: ops chained after a parallel sort must preserve order
+        fp, dp, fdf, ddf = pq_env
+        ctx = QuokkaContext(exec_channels=2)
+        got = ctx.read_parquet(fp).sort(["x"]).select(["x"]).collect()
+        np.testing.assert_allclose(got.x.to_numpy(), np.sort(fdf.x.to_numpy()))
+
+    def test_unsampleable_schema_does_not_break_planning(self, tmp_path):
+        # a list column the query never touches must not crash the sampler
+        t = pa.table(
+            {
+                "x": np.random.default_rng(0).normal(size=1000),
+                "weird": pa.array([[1, 2]] * 1000, type=pa.list_(pa.int64())),
+            }
+        )
+        p = str(tmp_path / "weird.parquet")
+        pq.write_table(t, p)
+        ctx = QuokkaContext(exec_channels=2)
+        got = ctx.read_parquet(p, columns=["x"]).filter(col("x") > 0).sort(["x"]).collect()
+        exp = np.sort(t.column("x").to_numpy()[t.column("x").to_numpy() > 0])
+        np.testing.assert_allclose(got.x.to_numpy(), exp)
