@@ -27,7 +27,9 @@ class Catalog:
         """Estimated output rows of a source under `predicate`; None if the
         reader can't report size.  Cached per (reader, predicate) so repeated
         optimize() calls don't re-read Parquet footers and samples."""
-        key = (id(reader), predicate.sql() if predicate is not None else None)
+        # key on the reader object itself (identity hash): keeping it as a dict
+        # key pins it alive, so — unlike id() — the key can't be reused after GC
+        key = (reader, predicate.sql() if predicate is not None else None)
         if key in self._cache:
             return self._cache[key]
         est = self._estimate(reader, predicate)

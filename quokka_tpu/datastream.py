@@ -549,8 +549,8 @@ class OrderedStream(DataStream):
         from quokka_tpu.executors.ts_execs import SortedAsofExecutor
         from quokka_tpu.target_info import HashPartitioner, PassThroughPartitioner
 
-        if direction != "backward":
-            raise NotImplementedError("join_asof currently supports backward")
+        if direction not in ("backward", "forward"):
+            raise NotImplementedError(f"join_asof direction {direction!r}")
         left_on = left_on or on or self.time_col
         right_on = right_on or on or right.time_col
         if by is not None:
@@ -568,7 +568,9 @@ class OrderedStream(DataStream):
         node = logical.StatefulNode(
             [self.node_id, right.node_id],
             out_schema,
-            lambda: SortedAsofExecutor(left_on, right_on, left_by, right_by, suffix),
+            lambda: SortedAsofExecutor(
+                left_on, right_on, left_by, right_by, suffix, direction=direction
+            ),
             partitioners=parts,
             sorted_output=[left_on],
         )

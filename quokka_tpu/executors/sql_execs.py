@@ -12,6 +12,7 @@ from __future__ import annotations
 import copy
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+from quokka_tpu import config
 from quokka_tpu.ops import bridge, kernels
 from quokka_tpu.ops import join as join_ops
 from quokka_tpu.ops.batch import DeviceBatch
@@ -202,11 +203,15 @@ class BuildProbeJoinExecutor(Executor):
         how: str = "inner",
         suffix: str = "_2",
         rename: Optional[Dict[str, str]] = None,
+        out_schema: Optional[List[str]] = None,
     ):
         self.left_on = list(left_on)
         self.right_on = list(right_on)
         self.how = how
         self.suffix = suffix
+        # plan-time output schema: lets a left join emit all-null payload even
+        # when this channel never saw a single build batch (schema unknown)
+        self.out_schema = list(out_schema) if out_schema else None
         # plan-time rename of clashing build columns; None -> detect at
         # runtime from the first probe batch (raw TaskGraph usage)
         self.planned_rename = rename
@@ -268,13 +273,42 @@ class BuildProbeJoinExecutor(Executor):
     def _probe(self, live):
         if self.build is None and self.build_parts:
             self._finalize_build(live[0].names)
-        if self.build is None or self.build.count_valid() == 0:
+        if self.build is None:
+            # No build batch ever arrived on this channel.  Engine.push always
+            # delivers every hash partition (even zero-valid ones), so this
+            # only happens when the build SOURCE emitted zero batches — i.e.
+            # consistently on every channel.  Payload kinds are unknowable
+            # then; all-null float columns stand in (documented limitation:
+            # a string payload column degrades to float nulls in this case).
             if self.how in ("inner", "semi"):
                 return None
             if self.how == "anti":
                 out = live
                 return bridge.concat_batches(out) if len(out) > 1 else out[0]
-            raise NotImplementedError("left join against empty build (todo)")
+            if self.out_schema is None:
+                raise RuntimeError(
+                    "left join: build side produced no batches and no plan "
+                    "schema was provided (pass out_schema=)"
+                )
+            import jax.numpy as jnp
+
+            from quokka_tpu.ops.batch import NumCol
+
+            outs = []
+            for probe in live:
+                payload = [c for c in self.out_schema if c not in probe.columns]
+                b = probe
+                for c in payload:
+                    b = b.with_column(
+                        c,
+                        NumCol(jnp.full(b.padded_len, jnp.nan, config.float_dtype()), "f"),
+                    )
+                outs.append(b)
+            return bridge.concat_batches(outs) if len(outs) > 1 else outs[0]
+        if self.build.count_valid() == 0 and self.how in ("inner", "semi"):
+            return None
+        # empty-but-schema'd build: anti/left fall through — the general join
+        # kernel handles a zero-valid build (every probe row unmatched)
         outs = []
         for probe in live:
             if self.build_unique and self.how in ("inner", "semi", "anti"):

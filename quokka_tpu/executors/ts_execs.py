@@ -16,10 +16,11 @@ from __future__ import annotations
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
+import numpy as np
 
 from quokka_tpu.executors.base import Executor
 from quokka_tpu.ops import asof as asof_ops
-from quokka_tpu.ops import bridge, kernels
+from quokka_tpu.ops import bridge, kernels, timewide
 from quokka_tpu.ops.batch import DeviceBatch, NumCol
 from quokka_tpu.ops.expr_compile import AggPlan, evaluate_to_column
 from quokka_tpu.windows import (
@@ -33,9 +34,81 @@ from quokka_tpu.windows import (
 )
 
 
-def _time_max(batch: DeviceBatch, col: str) -> float:
+def _time_max(batch: DeviceBatch, col: str):
+    """Watermark: float for float times, exact host int for (wide) int times."""
     c = batch.columns[col]
+    if c.hi is not None:
+        return timewide.host_max_i64(c, batch.valid)
     return float(kernels.reduce_array(c.data, batch.valid, "max"))
+
+
+def _time_min(batch: DeviceBatch, col: str, valid=None):
+    """Min over `valid` (default batch.valid): float or exact host int."""
+    c = batch.columns[col]
+    v = batch.valid if valid is None else valid
+    if c.hi is not None:
+        return timewide.host_min_i64(c, v)
+    return float(kernels.reduce_array(c.data, v, "min"))
+
+
+def _cmp_time(col, v, op: str):
+    """col <op> v where v is a host watermark (int, float, or +/-inf) and col
+    may be a two-limb wide column."""
+    if isinstance(v, float) and not np.isfinite(v):
+        full = jnp.ones(col.padded_len, dtype=bool)
+        hit = (v > 0) if op in ("<", "<=") else (v < 0)
+        return full if hit else ~full
+    if col.hi is None:
+        d = col.data
+        return {"<": d < v, "<=": d <= v, ">": d > v, ">=": d >= v,
+                "=": d == v, "!=": d != v}[op]
+    return timewide.cmp_scalar(col, int(v), op)
+
+
+class _TimeRebase:
+    """Exact int32 rebase for wide (two-limb int64) time columns.
+
+    Streaming executors do single-array time arithmetic (watermarks, ``t //
+    hop``, ``t - size``).  Wide columns are rebased once per executor onto an
+    int32 window relative to a host base taken from the first batch (minus
+    2**29 slack for late/out-of-order starts, floor-aligned to the window hop
+    so absolute window boundaries stay epoch-aligned).  The rebase is exact or
+    it raises — never a silent low-limb truncation (see ops/timewide.py).
+    Emitted absolute times are reconstructed with ``add_base``.
+    """
+
+    _tbase: Optional[int] = None
+    _t_kind: Optional[str] = None
+    _t_unit: Optional[str] = None
+
+    def _rebase_batch(self, batch: DeviceBatch, col_name: str, align: int = 1,
+                      headroom: int = 0) -> DeviceBatch:
+        col = batch.columns[col_name]
+        if jnp.issubdtype(col.data.dtype, jnp.floating):
+            return batch
+        if self._tbase is None:
+            # The base is fixed by the FIRST batch — including a narrow one
+            # (base 0, passthrough).  A later wide batch then rebases against
+            # base 0 and raises cleanly instead of silently mixing absolute
+            # and rebased window coordinates in one executor state.
+            if col.hi is None:
+                self._tbase = 0
+            else:
+                vals = timewide.host_i64(col, batch.valid)
+                mn = int(vals.min()) if len(vals) else 0
+                align = max(1, int(align))
+                self._tbase = ((mn - 2**29) // align) * align
+            self._t_kind = col.kind
+            self._t_unit = col.unit
+        if self._tbase == 0 and col.hi is None:
+            return batch  # narrow stream: absolute int32 coordinates as-is
+        rel = timewide.rebase_narrow(col, batch.valid, self._tbase, headroom)
+        return batch.with_column(col_name, rel)
+
+    def _restore_time(self, data, kind: str = "i") -> NumCol:
+        if self._tbase is None:
+            return NumCol(data, kind)
+        return timewide.add_base(data, self._tbase, self._t_kind or kind, self._t_unit)
 
 
 class SortedAsofExecutor(Executor):
@@ -47,7 +120,11 @@ class SortedAsofExecutor(Executor):
     frontier plus everything above it."""
 
     def __init__(self, left_on: str, right_on: str, left_by, right_by,
-                 suffix: str = "_2", keep_unmatched: bool = False):
+                 suffix: str = "_2", keep_unmatched: bool = False,
+                 direction: str = "backward"):
+        if direction not in ("backward", "forward"):
+            raise ValueError(direction)
+        self.direction = direction
         self.left_on = left_on
         self.right_on = right_on
         self.left_by = list(left_by or [])
@@ -90,6 +167,13 @@ class SortedAsofExecutor(Executor):
         self.q_done = True
         return self._flush(final=True)
 
+    def _setup_payload(self, probe_names):
+        if self.payload is None:
+            payload = [c for c in self.quotes.names
+                       if c not in set(self.right_by) and c != self.right_on]
+            self.rename = {c: c + self.suffix for c in payload if c in probe_names}
+            self.payload = [self.rename.get(c, c) for c in payload]
+
     def _flush(self, final: bool = False):
         if self.trades is None or self.trades.count_valid() == 0:
             return None
@@ -98,28 +182,25 @@ class SortedAsofExecutor(Executor):
                 out, self.trades = self.trades, None
                 return out if self.keep_unmatched else None
             return None
+        if self.direction == "forward":
+            return self._flush_forward()
         if self.q_done:
             safe = float("inf")
         elif self.q_watermark is None:
             return None
         else:
             safe = self.q_watermark
-        tcol = self.trades.columns[self.left_on].data
+        tcol = self.trades.columns[self.left_on]
         # strictly below the quote watermark: a future quote batch can still
         # contain quotes at exactly `safe` (ties must win per backward-asof)
-        ready_mask = self.trades.valid & (
-            (tcol <= safe) if safe == float("inf") else (tcol < safe)
-        )
+        op = "<=" if safe == float("inf") else "<"
+        ready_mask = self.trades.valid & _cmp_time(tcol, safe, op)
         ready = kernels.compact(kernels.apply_mask(self.trades, ready_mask))
         if ready.count_valid() == 0:
             return None
         rest = kernels.compact(kernels.apply_mask(self.trades, self.trades.valid & ~ready_mask))
         self.trades = rest if rest.count_valid() > 0 else None
-        if self.payload is None:
-            payload = [c for c in self.quotes.names
-                       if c not in set(self.right_by) and c != self.right_on]
-            self.rename = {c: c + self.suffix for c in payload if c in ready.names}
-            self.payload = [self.rename.get(c, c) for c in payload]
+        self._setup_payload(ready.names)
         quotes = self.quotes.rename(self.rename) if self.rename else self.quotes
         out = asof_ops.asof_join(
             ready, quotes, self.left_on, self.right_on,
@@ -136,32 +217,95 @@ class SortedAsofExecutor(Executor):
         self._prune_quotes(prune_to)
         return out
 
-    def _prune_quotes(self, safe: float):
+    def _flush_forward(self):
+        """Forward asof: a trade's match is the FIRST quote of its key at/after
+        its time.  A global quote watermark can't tell us a per-key match has
+        arrived, so instead: join the whole buffer, and a matched trade is
+        final (future quotes arrive later in time and can't beat the match).
+        To keep the output time-ordered, matched trades are held back until no
+        earlier trade remains unmatched."""
+        self._setup_payload(self.trades.names)
+        quotes = self.quotes.rename(self.rename) if self.rename else self.quotes
+        out = asof_ops.asof_join(
+            self.trades, quotes, self.left_on, self.right_on,
+            self.left_by, self.right_by, self.payload, direction="forward",
+        )
+        matched = out.columns.pop("__asof_matched__").data
+        if self.q_done:
+            result = out if self.keep_unmatched else kernels.compact(
+                kernels.apply_mask(out, matched)
+            )
+            self.trades = None
+            self.quotes = None
+            return result if result.count_valid() > 0 else None
+        tcol = self.trades.columns[self.left_on]
+        unmatched = self.trades.valid & ~matched
+        emit = self.trades.valid & matched
+        if bool(jnp.any(unmatched)):
+            cutoff = _time_min(self.trades, self.left_on, unmatched)
+            emit = emit & _cmp_time(tcol, cutoff, "<")
+        result = kernels.compact(kernels.apply_mask(out, emit))
+        rest = kernels.compact(
+            kernels.apply_mask(self.trades, self.trades.valid & ~emit)
+        )
+        self.trades = rest if rest.count_valid() > 0 else None
+        # prune quotes below every retained and every possible future trade —
+        # forward matches need quote time >= trade time, so those can't match
+        bound = self.t_watermark
+        if self.trades is not None:
+            tmin = _time_min(self.trades, self.left_on)
+            bound = tmin if bound is None else min(bound, tmin)
+        if bound is not None and self.quotes is not None:
+            q = self.quotes
+            keep = q.valid & _cmp_time(q.columns[self.right_on], bound, ">=")
+            pruned = kernels.compact(kernels.apply_mask(q, keep))
+            self.quotes = pruned if pruned.count_valid() > 0 else None
+        return result if result.count_valid() > 0 else None
+
+    def _prune_quotes(self, safe):
+        """Drop quotes no future trade can match: everything at/below the
+        frontier except the latest quote per key.  Sort-based so it is exact
+        for wide (two-limb) time columns — sort_batch keys are limb-aware."""
         if self.quotes is None or safe == float("inf"):
             if self.q_done:
                 self.quotes = None
             return
         q = self.quotes
-        qt = q.columns[self.right_on].data
-        above = q.valid & (qt > safe)
+        qt = q.columns[self.right_on]
+        above = q.valid & _cmp_time(qt, safe, ">")
+        below = q.valid & ~above
         if self.right_by:
-            # the latest quote per key at/below the frontier must be kept
-            below = kernels.apply_mask(q, q.valid & (qt <= safe))
-            g = kernels.groupby_aggregate(
-                below, self.right_by, [("__maxt", "max", qt)]
-            )
-            g = kernels.compact(g)
-            keep_last = asof_ops.asof_join(
-                q, g, self.right_on, "__maxt", self.right_by, self.right_by, ["__maxt"],
-            )
-            is_last = keep_last.columns["__asof_matched__"].data & (
-                qt == keep_last.columns["__maxt"].data
-            )
-            keep = above | (q.valid & is_last)
+            s = kernels.sort_batch(q, self.right_by + [self.right_on])
+            st = s.columns[self.right_on]
+            s_below = s.valid & _cmp_time(st, safe, "<=")
+            from quokka_tpu.ops.batch import key_limbs
+
+            n = s.padded_len
+            limbs = key_limbs(s, self.right_by)
+            next_key_same = jnp.ones(n, dtype=bool)
+            for l in limbs:
+                next_key_same = next_key_same & (l == jnp.roll(l, -1))
+            next_key_same = next_key_same.at[n - 1].set(False)
+            next_below = jnp.roll(s_below, -1).at[n - 1].set(False) & jnp.roll(
+                s.valid, -1
+            ).at[n - 1].set(False)
+            # last below-frontier quote in its key run: successor is out of
+            # key, invalid, or above the frontier
+            is_last_below = s_below & ~(next_key_same & next_below)
+            keep_s = (s.valid & _cmp_time(st, safe, ">")) | is_last_below
+            pruned = kernels.compact(kernels.apply_mask(s, keep_s))
         else:
-            maxt = kernels.reduce_array(jnp.where(q.valid & (qt <= safe), qt, -jnp.inf if jnp.issubdtype(qt.dtype, jnp.floating) else jnp.iinfo(qt.dtype).min), q.valid, "max")
-            keep = above | (q.valid & (qt == maxt))
-        pruned = kernels.compact(kernels.apply_mask(q, keep))
+            if bool(jnp.any(below)):
+                maxt = _time_max(
+                    DeviceBatch(
+                        {self.right_on: qt}, below, None, None
+                    ),
+                    self.right_on,
+                )
+                keep = above | (below & _cmp_time(qt, maxt, "="))
+            else:
+                keep = above
+            pruned = kernels.compact(kernels.apply_mask(q, keep))
         self.quotes = pruned if pruned.count_valid() > 0 else None
 
     def checkpoint(self):
@@ -169,6 +313,7 @@ class SortedAsofExecutor(Executor):
             "trades": None if self.trades is None else bridge.device_to_arrow(self.trades),
             "quotes": None if self.quotes is None else bridge.device_to_arrow(self.quotes),
             "q_watermark": self.q_watermark,
+            "t_watermark": self.t_watermark,
             "q_done": self.q_done,
         }
 
@@ -178,6 +323,7 @@ class SortedAsofExecutor(Executor):
         self.trades = None if state["trades"] is None else bridge.arrow_to_device(state["trades"])
         self.quotes = None if state["quotes"] is None else bridge.arrow_to_device(state["quotes"])
         self.q_watermark = state["q_watermark"]
+        self.t_watermark = state.get("t_watermark")
         self.q_done = state["q_done"]
 
 
@@ -223,7 +369,7 @@ class _PartialWindowAgg:
         return g.select(out)
 
 
-class HoppingWindowExecutor(Executor):
+class HoppingWindowExecutor(_TimeRebase, Executor):
     """Hopping (and tumbling: hop == size) window aggregation.  Rows are
     replicated size//hop times onto their covering windows (static factor),
     partially aggregated, and windows are emitted once the watermark passes
@@ -261,6 +407,9 @@ class HoppingWindowExecutor(Executor):
         for b in batches:
             if b is None or b.count_valid() == 0:
                 continue
+            b = self._rebase_batch(
+                b, self.time_col, align=self.hop, headroom=self.size + self.hop
+            )
             watermark = _time_max(b, self.time_col)
             parts.append(self.helper.partial(self._assign_windows(b)))
         if self.state is not None:
@@ -282,10 +431,8 @@ class HoppingWindowExecutor(Executor):
 
     def _emit(self, g: DeviceBatch) -> DeviceBatch:
         start = g.columns["__wid"].data * self.hop
-        g = g.with_column("window_start", NumCol(start, "i"))
-        g = g.with_column(
-            "window_end", NumCol(start + self.size, "i")
-        )
+        g = g.with_column("window_start", self._restore_time(start))
+        g = g.with_column("window_end", self._restore_time(start + self.size))
         out = self.helper.finalize(g, extra=["window_start", "window_end"])
         return out
 
@@ -299,7 +446,7 @@ class HoppingWindowExecutor(Executor):
 TumblingWindowExecutor = HoppingWindowExecutor
 
 
-class SessionWindowExecutor(Executor):
+class SessionWindowExecutor(_TimeRebase, Executor):
     """Gap-based session windows: sessions close when the per-key gap exceeds
     the timeout; open sessions are carried as partial rows across batches
     (ts_executors.py:197 semantics, batched)."""
@@ -362,6 +509,7 @@ class SessionWindowExecutor(Executor):
         for b in batches:
             if b is None or b.count_valid() == 0:
                 continue
+            b = self._rebase_batch(b, self.time_col, headroom=self.timeout + 1)
             self.watermark = _time_max(b, self.time_col)
             parts.append(self._to_partial_rows(b))
         if self.open is not None:
@@ -384,6 +532,9 @@ class SessionWindowExecutor(Executor):
 
     def _emit(self, g: DeviceBatch) -> DeviceBatch:
         g = g.rename({"__first_t": "session_start", "__last_t": "session_end"})
+        if self._tbase is not None:
+            for c in ("session_start", "session_end"):
+                g = g.with_column(c, self._restore_time(g.columns[c].data))
         helper = _PartialWindowAgg(self.keys, self.plan, wid_col="session_start")
         return helper.finalize(g, extra=["session_start", "session_end"])
 
@@ -394,7 +545,7 @@ class SessionWindowExecutor(Executor):
         return out
 
 
-class SlidingWindowExecutor(Executor):
+class SlidingWindowExecutor(_TimeRebase, Executor):
     """Per-event trailing window [t - size, t] aggregates (groupby_rolling,
     ts_executors.py:147).  Sum/count/avg via segmented prefix sums + a
     vectorized lower-bound search; each batch needs the previous tail rows,
@@ -426,7 +577,7 @@ class SlidingWindowExecutor(Executor):
         return bridge.concat_batches(outs) if len(outs) > 1 else outs[0]
 
     def _process(self, batch: DeviceBatch) -> Optional[DeviceBatch]:
-        b = batch
+        b = self._rebase_batch(batch, self.time_col, headroom=int(self.size) + 1)
         for name, e in self.plan.pre:
             b = b.with_column(name, evaluate_to_column(e, b))
         b = b.with_column("__new", NumCol(jnp.ones(b.padded_len, dtype=jnp.bool_), "b"))
@@ -447,11 +598,15 @@ class SlidingWindowExecutor(Executor):
             merged = b
         out = self._rolling(merged)
         # new tail: rows within `size` of the max time
-        wm = _time_max(batch, self.time_col)
+        wm = _time_max(b, self.time_col)
         t = merged.columns[self.time_col].data
         tail_mask = merged.valid & (t >= wm - self.size)
         tail = kernels.compact(kernels.apply_mask(merged, tail_mask))
         self.tail = tail.drop(["__new"]) if tail.count_valid() > 0 else None
+        if out is not None and self._tbase is not None and self.time_col in out.columns:
+            out = out.with_column(
+                self.time_col, self._restore_time(out.columns[self.time_col].data)
+            )
         return out
 
     def _rolling(self, merged: DeviceBatch) -> Optional[DeviceBatch]:

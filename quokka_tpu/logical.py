@@ -198,6 +198,7 @@ class JoinNode(Node):
 
         left_on, right_on, how, suffix = self.left_on, self.right_on, self.how, self.suffix
         rename = self.rename
+        out_schema = list(self.schema)
         if self.broadcast:
             edges = {
                 0: (actor_of[self.parents[0]], _passthrough_edge()),
@@ -209,7 +210,9 @@ class JoinNode(Node):
                 1: (actor_of[self.parents[1]], TargetInfo(HashPartitioner(right_on))),
             }
         actor_of[node_id] = graph.new_exec_node(
-            lambda: BuildProbeJoinExecutor(left_on, right_on, how, suffix, rename),
+            lambda: BuildProbeJoinExecutor(
+                left_on, right_on, how, suffix, rename, out_schema=out_schema
+            ),
             edges,
             self.channels or ctx.exec_channels,
             self.stage,

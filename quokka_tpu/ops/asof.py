@@ -40,10 +40,12 @@ def _seg_fill_forward(values: jax.Array, seg_start: jax.Array) -> jax.Array:
 
 
 @functools.partial(jax.jit, static_argnames=("t",))
-def _asof_match(limbs: Tuple[jax.Array, ...], times: jax.Array, is_trade: jax.Array,
-                valid: jax.Array, t: int):
+def _asof_match(limbs: Tuple[jax.Array, ...], times: Tuple[jax.Array, ...],
+                is_trade: jax.Array, valid: jax.Array, t: int):
     """Returns per-trade-row (quote_row_idx, matched) for backward asof.
-    Arrays are the concatenation [trades | quotes]; `t` = trade padded len."""
+    Arrays are the concatenation [trades | quotes]; `t` = trade padded len.
+    `times` is one array for narrow/float time columns, or (hi, lo) limbs for
+    wide int64/ns timestamps (limb lexicographic order == numeric order)."""
     n = valid.shape[0]
     ranks, _ = dense_rank(limbs, valid)
     iota = jnp.arange(n, dtype=jnp.int32)
@@ -51,11 +53,12 @@ def _asof_match(limbs: Tuple[jax.Array, ...], times: jax.Array, is_trade: jax.Ar
     # sort by (validity, key rank, time, side): quotes (0) before trades (1)
     # at equal times -> backward asof includes same-timestamp quotes
     side = is_trade.astype(jnp.int32)
-    sorted_ops = lax.sort([inv, ranks, times, side, iota], num_keys=4)
+    nk = 2 + len(times)
+    sorted_ops = lax.sort([inv, ranks, *times, side, iota], num_keys=nk + 1)
     perm = sorted_ops[-1]
     valid_s = sorted_ops[0] == 0
     ranks_s = sorted_ops[1]
-    side_s = sorted_ops[3]
+    side_s = sorted_ops[nk]
     seg_start = (ranks_s != jnp.roll(ranks_s, 1)) | (iota == 0)
     quote_pos = jnp.where(valid_s & (side_s == 0), iota, -1)
     last_quote_pos = _seg_fill_forward(quote_pos, seg_start)
@@ -89,13 +92,22 @@ def asof_join(
         limbs = [jnp.concatenate([a, b.astype(a.dtype)]) for a, b in zip(lt, lq)]
     else:
         limbs = [jnp.zeros(t + quotes.padded_len, dtype=jnp.int32)]
-    t_time = trades.columns[left_on].data
-    q_time = quotes.columns[right_on].data
-    if direction == "forward":
-        t_time, q_time = -t_time, -q_time
-    elif direction != "backward":
+    if direction not in ("backward", "forward"):
         raise ValueError(direction)
-    times = jnp.concatenate([t_time, q_time.astype(t_time.dtype)])
+    tc = trades.columns[left_on]
+    qc = quotes.columns[right_on]
+    if tc.hi is not None or qc.hi is not None:
+        from quokka_tpu.ops import timewide
+
+        tl, ql = timewide.widen_limbs(tc), timewide.widen_limbs(qc)
+        if direction == "forward":
+            tl, ql = timewide.not_limbs(tl), timewide.not_limbs(ql)
+        times = tuple(jnp.concatenate([a, b.astype(a.dtype)]) for a, b in zip(tl, ql))
+    else:
+        t_time, q_time = tc.data, qc.data
+        if direction == "forward":
+            t_time, q_time = -t_time, -q_time
+        times = (jnp.concatenate([t_time, q_time.astype(t_time.dtype)]),)
     is_trade = jnp.concatenate(
         [jnp.ones(t, dtype=bool), jnp.zeros(quotes.padded_len, dtype=bool)]
     )
@@ -103,11 +115,11 @@ def asof_join(
     match_orig, matched = _asof_match(tuple(limbs), times, is_trade, valid, t)
     quote_idx = jnp.clip(match_orig - t, 0, quotes.padded_len - 1)
     cols = dict(trades.columns)
+    from quokka_tpu.ops.batch import with_nulls
+
     for name in payload:
         c = quotes.columns[name]
         taken = c.take(quote_idx)
-        if isinstance(taken, NumCol) and taken.kind == "f":
-            taken = NumCol(jnp.where(matched, taken.data, jnp.nan), "f")
-        cols[name] = taken
+        cols[name] = with_nulls(taken, ~matched)
     cols["__asof_matched__"] = NumCol(matched, "b")
     return DeviceBatch(cols, trades.valid, trades.nrows, trades.sorted_by)

@@ -84,6 +84,14 @@ class StringDict:
         hits = np.nonzero(self.values == literal)[0]
         return int(hits[0]) if len(hits) else -1
 
+    @property
+    def none_entries(self) -> Optional[np.ndarray]:
+        """Bool mask of None (null) entries, or None when there are none."""
+        if not hasattr(self, "_none_entries"):
+            m = np.array([x is None for x in self.values], dtype=bool)
+            self._none_entries = m if m.any() else None
+        return self._none_entries
+
 
 # ---------------------------------------------------------------------------
 # Columns
@@ -126,9 +134,14 @@ class StrCol:
         return self.codes.shape[0]
 
     def hash_limbs(self):
-        """Two int32 device arrays (hi, lo) of the 64-bit value hash per row."""
-        hi = jnp.asarray(self.dictionary.hash_hi)[self.codes]
-        lo = jnp.asarray(self.dictionary.hash_lo)[self.codes]
+        """Two int32 device arrays (hi, lo) of the 64-bit value hash per row.
+        Null rows (code < 0) get the hash of null, (0, 0) — same pair
+        _hash_strings assigns to None dictionary entries — so all nulls land
+        in one group for groupby/sort instead of aliasing the last entry."""
+        c = jnp.maximum(self.codes, 0)
+        isnull = self.codes < 0
+        hi = jnp.where(isnull, 0, jnp.asarray(self.dictionary.hash_hi)[c])
+        lo = jnp.where(isnull, 0, jnp.asarray(self.dictionary.hash_lo)[c])
         return hi, lo
 
     def take(self, idx: jax.Array) -> "StrCol":
@@ -156,6 +169,72 @@ class VecCol:
 
 
 Column = object  # NumCol | StrCol | VecCol
+
+
+# ---------------------------------------------------------------------------
+# Null representation (sentinel encoding)
+#
+# The reference carries Polars/Arrow validity bitmaps; device batches instead
+# reserve one value per kind as NULL and map it back to a real Arrow null at
+# the device->host boundary (bridge.device_to_arrow):
+#   floats            NaN
+#   narrow int/date   INT32_MIN (INT64_MIN under x64)
+#   wide int/ts       INT64_MIN (both limbs == INT32_MIN under the lo-2^31
+#                     encoding)
+#   strings           dictionary code -1
+#   bools             no null (ingest fills False); nulled bools upcast to 'i'
+# Consequences (documented divergence): INT_MIN as real data reads as null,
+# and nulls sort first (smallest) rather than Polars' nulls-last.
+# ---------------------------------------------------------------------------
+
+NULL_I32 = -(2**31)
+NULL_I64 = -(2**63)
+
+
+def _int_sentinel(dtype):
+    return NULL_I64 if dtype == jnp.int64 else NULL_I32
+
+
+def null_mask(col) -> jax.Array:
+    """Per-row null mask for any column kind."""
+    if isinstance(col, StrCol):
+        isnull = col.codes < 0
+        none = col.dictionary.none_entries
+        if none is not None:
+            isnull = isnull | jnp.asarray(none)[jnp.maximum(col.codes, 0)]
+        return isnull
+    if isinstance(col, VecCol):
+        return jnp.zeros(col.padded_len, dtype=bool)
+    if col.kind == "f":
+        return jnp.isnan(col.data)
+    if col.kind == "b":
+        return jnp.zeros(col.padded_len, dtype=bool)
+    if col.hi is not None:
+        return (col.hi == NULL_I32) & (col.data == NULL_I32)
+    return col.data == _int_sentinel(col.data.dtype)
+
+
+def with_nulls(col, null_where: jax.Array):
+    """Return `col` with rows where `null_where` marked null (sentinel)."""
+    if isinstance(col, StrCol):
+        return StrCol(jnp.where(null_where, -1, col.codes), col.dictionary)
+    if isinstance(col, VecCol):
+        return VecCol(jnp.where(null_where[:, None], 0.0, col.data))
+    if col.kind == "f":
+        return NumCol(jnp.where(null_where, jnp.nan, col.data), "f", unit=col.unit)
+    if col.kind == "b":
+        # bools have no spare value: upcast to int (0/1/NULL)
+        data = jnp.where(null_where, NULL_I32, col.data.astype(jnp.int32))
+        return NumCol(data, "i")
+    if col.hi is not None:
+        return NumCol(
+            jnp.where(null_where, jnp.int32(NULL_I32), col.data),
+            col.kind,
+            hi=jnp.where(null_where, jnp.int32(NULL_I32), col.hi),
+            unit=col.unit,
+        )
+    sent = _int_sentinel(col.data.dtype)
+    return NumCol(jnp.where(null_where, sent, col.data), col.kind, unit=col.unit)
 
 
 # ---------------------------------------------------------------------------

@@ -36,11 +36,15 @@ def _pad(arr: np.ndarray, padded: int, fill=0) -> np.ndarray:
 
 
 def _wide_int_limbs(vals: np.ndarray, padded: int):
-    """Split int64 numpy values into (hi, lo_sortable) int32 limbs."""
+    """Split int64 numpy values into (hi, lo_sortable) int32 limbs.
+
+    lo_sortable = lo - 2**31 (sign-bit flip), so signed (hi, lo_sortable)
+    lexicographic order equals numeric int64 order for every value —
+    including when the low 32 bits straddle 2**31.
+    """
     hi = (vals >> np.int64(32)).astype(np.int32)
-    lo = (vals & np.int64(0xFFFFFFFF)).astype(np.uint32)
-    lo_sortable = (lo ^ np.uint32(0x80000000)).astype(np.int64) - 2**31
-    lo_sortable = lo_sortable.astype(np.int32)
+    lo = (vals & np.int64(0xFFFFFFFF)).astype(np.int64)
+    lo_sortable = (lo - 2**31).astype(np.int32)
     return (
         jnp.asarray(_pad(hi, padded)),
         jnp.asarray(_pad(lo_sortable, padded)),
@@ -48,17 +52,30 @@ def _wide_int_limbs(vals: np.ndarray, padded: int):
 
 
 def _limbs_to_int64(hi: np.ndarray, lo_sortable: np.ndarray) -> np.ndarray:
-    lo = (lo_sortable.astype(np.int64) + 2**31).astype(np.uint32) ^ np.uint32(0x80000000)
-    return (hi.astype(np.int64) << np.int64(32)) | lo.astype(np.int64)
+    lo = lo_sortable.astype(np.int64) + 2**31
+    return (hi.astype(np.int64) << np.int64(32)) | lo
 
 
-def _ints_to_col(vals: np.ndarray, padded: int, kind: str, unit=None) -> NumCol:
+def _ints_to_col(vals: np.ndarray, padded: int, kind: str, unit=None, nullm=None) -> NumCol:
+    """nullm: optional bool mask of null rows (vals are 0-filled there); nulls
+    become the kind's sentinel (batch.NULL_I32 / NULL_I64)."""
+    from quokka_tpu.ops.batch import NULL_I32, NULL_I64
+
     vals = np.ascontiguousarray(vals)
     if config.x64_enabled():
-        return NumCol(jnp.asarray(_pad(vals.astype(np.int64), padded)), kind, unit=unit)
+        v = vals.astype(np.int64)
+        if nullm is not None:
+            v = np.where(nullm, np.int64(NULL_I64), v)
+        return NumCol(jnp.asarray(_pad(v, padded)), kind, unit=unit)
     if vals.size == 0 or (vals.min() >= _I32_MIN and vals.max() <= _I32_MAX):
-        return NumCol(jnp.asarray(_pad(vals.astype(np.int32), padded)), kind, unit=unit)
-    hi, lo = _wide_int_limbs(vals.astype(np.int64), padded)
+        v = vals.astype(np.int32)
+        if nullm is not None:
+            v = np.where(nullm, np.int32(NULL_I32), v)
+        return NumCol(jnp.asarray(_pad(v, padded)), kind, unit=unit)
+    v = vals.astype(np.int64)
+    if nullm is not None:
+        v = np.where(nullm, np.int64(NULL_I64), v)  # limbs: (NULL_I32, NULL_I32)
+    hi, lo = _wide_int_limbs(v, padded)
     return NumCol(lo, kind, hi=hi, unit=unit)
 
 
@@ -67,7 +84,10 @@ def arrow_column_to_device(arr: pa.ChunkedArray, padded: int):
         arr = arr.combine_chunks()
     t = arr.type
     if pa.types.is_dictionary(t):
-        codes = arr.indices.to_numpy(zero_copy_only=False).astype(np.int32)
+        idx = arr.indices
+        if idx.null_count:
+            idx = pc.fill_null(idx, -1)  # null rows -> code -1
+        codes = idx.to_numpy(zero_copy_only=False).astype(np.int32)
         values = arr.dictionary.to_pylist()
         return StrCol(jnp.asarray(_pad(codes, padded)), StringDict(np.array(values, dtype=object)))
     if pa.types.is_string(t) or pa.types.is_large_string(t):
@@ -85,27 +105,40 @@ def arrow_column_to_device(arr: pa.ChunkedArray, padded: int):
         out = np.zeros((padded, dim), dtype=flat.dtype)
         out[np.nonzero(valid_np)[0]] = flat.reshape(-1, dim)
         return VecCol(jnp.asarray(out))
+    from quokka_tpu.ops.batch import NULL_I32
+
+    nullm = None
     if arr.null_count:
-        arr = pc.fill_null(arr, 0)
+        # nulls become kind sentinels (NaN / INT_MIN / code -1) — real Arrow
+        # nulls again at device_to_arrow.  Bools have no spare value: False.
+        nullm = np.logical_not(arr.is_valid().to_numpy(zero_copy_only=False))
+        arr = pc.fill_null(arr, float("nan") if pa.types.is_floating(t) else 0)
     if pa.types.is_boolean(t):
         vals = arr.to_numpy(zero_copy_only=False).astype(np.bool_)
         return NumCol(jnp.asarray(_pad(vals, padded, fill=False)), "b")
     if pa.types.is_date32(t):
-        vals = arr.cast(pa.int32()).to_numpy(zero_copy_only=False)
-        return NumCol(jnp.asarray(_pad(vals.astype(np.int32), padded)), "d")
+        vals = arr.cast(pa.int32()).to_numpy(zero_copy_only=False).astype(np.int32)
+        if nullm is not None:
+            vals = np.where(nullm, np.int32(NULL_I32), vals)
+        return NumCol(jnp.asarray(_pad(vals, padded)), "d")
     if pa.types.is_date64(t):
         vals = arr.cast(pa.timestamp("ms")).cast(pa.int64()).to_numpy(zero_copy_only=False)
-        vals = vals // 86400000
-        return NumCol(jnp.asarray(_pad(vals.astype(np.int32), padded)), "d")
+        vals = (vals // 86400000).astype(np.int32)
+        if nullm is not None:
+            vals = np.where(nullm, np.int32(NULL_I32), vals)
+        return NumCol(jnp.asarray(_pad(vals, padded)), "d")
     if pa.types.is_timestamp(t):
         vals = arr.cast(pa.int64()).to_numpy(zero_copy_only=False)
-        return _ints_to_col(vals, padded, "t", unit=t.unit)
+        return _ints_to_col(vals, padded, "t", unit=t.unit, nullm=nullm)
     if pa.types.is_decimal(t):
         vals = arr.cast(pa.float64()).to_numpy(zero_copy_only=False)
-        return NumCol(jnp.asarray(_pad(vals.astype(config.float_dtype()), padded)), "f")
+        vals = vals.astype(config.float_dtype())
+        if nullm is not None:
+            vals = np.where(nullm, np.nan, vals)
+        return NumCol(jnp.asarray(_pad(vals, padded)), "f")
     if pa.types.is_integer(t):
         vals = arr.to_numpy(zero_copy_only=False)
-        return _ints_to_col(vals, padded, "i")
+        return _ints_to_col(vals, padded, "i", nullm=nullm)
     if pa.types.is_floating(t):
         vals = arr.to_numpy(zero_copy_only=False).astype(config.float_dtype())
         return NumCol(jnp.asarray(_pad(vals, padded)), "f")
@@ -141,18 +174,37 @@ def device_to_arrow(batch: DeviceBatch) -> pa.Table:
                 out[i] = vals[c] if 0 <= c < len(vals) else None
             arrays.append(pa.array(out, type=pa.string()))
         else:
+            from quokka_tpu.ops.batch import NULL_I32, NULL_I64
+
             data = np.asarray(col.data)[mask]
             if col.hi is not None:
                 hi = np.asarray(col.hi)[mask]
                 v64 = _limbs_to_int64(hi, data)
+                nullm = v64 == NULL_I64
+                nullm = nullm if nullm.any() else None
                 if col.kind == "t":
-                    arrays.append(pa.array(v64).cast(pa.timestamp(col.unit or "us")))
+                    arrays.append(
+                        pa.array(v64, mask=nullm).cast(pa.timestamp(col.unit or "us"))
+                    )
                 else:
-                    arrays.append(pa.array(v64, type=pa.int64()))
+                    arrays.append(pa.array(v64, type=pa.int64(), mask=nullm))
             elif col.kind == "d":
-                arrays.append(pa.array(data.astype(np.int32)).cast(pa.date32()))
-            elif col.kind == "t":
-                arrays.append(pa.array(data.astype(np.int64)).cast(pa.timestamp(col.unit or "us")))
+                d32 = data.astype(np.int32)
+                nullm = d32 == np.int32(NULL_I32)
+                nullm = nullm if nullm.any() else None
+                arrays.append(pa.array(d32, mask=nullm).cast(pa.date32()))
+            elif col.kind in ("i", "t"):
+                sent = NULL_I64 if data.dtype == np.int64 else NULL_I32
+                nullm = data == sent
+                nullm = nullm if nullm.any() else None
+                if col.kind == "t":
+                    arrays.append(
+                        pa.array(data.astype(np.int64), mask=nullm).cast(
+                            pa.timestamp(col.unit or "us")
+                        )
+                    )
+                else:
+                    arrays.append(pa.array(data, mask=nullm))
             elif col.kind == "b":
                 arrays.append(pa.array(data.astype(np.bool_)))
             else:

@@ -39,7 +39,14 @@ from quokka_tpu.expression import (
     StrOp,
     UnaryOp,
 )
-from quokka_tpu.ops.batch import DeviceBatch, NumCol, StrCol, StringDict
+from quokka_tpu.ops.batch import (
+    NULL_I32,
+    DeviceBatch,
+    NumCol,
+    StrCol,
+    StringDict,
+    null_mask,
+)
 
 
 class CompileError(Exception):
@@ -66,9 +73,20 @@ def evaluate(e: Expr, batch: DeviceBatch):
     if isinstance(e, BinOp):
         return _binop(e.op, evaluate(e.left, batch), evaluate(e.right, batch))
     if isinstance(e, UnaryOp):
-        v = evaluate(e.operand, batch)
         if e.op == "not":
-            return NumCol(~_as_bool(v), "b")
+            # push NOT into comparisons (op flip / De Morgan) so SQL 3VL holds:
+            # NOT (x = 5) with x null must be false, not ~false
+            pushed = _negate_expr(e.operand)
+            if pushed is not None:
+                return evaluate(pushed, batch)
+            res = ~_as_bool(evaluate(e.operand, batch))
+            # fallback invert (LIKE/contains/...): still exclude null operands
+            if isinstance(e.operand, StrOp):
+                v = evaluate(e.operand.expr, batch)
+                if isinstance(v, (NumCol, StrCol)):
+                    res = res & ~null_mask(v)
+            return NumCol(res, "b")
+        v = evaluate(e.operand, batch)
         if e.op == "-":
             if isinstance(v, NumCol):
                 return NumCol(-v.data, v.kind)
@@ -210,7 +228,12 @@ def _binop(op, a, b):
             ">": lambda x, y: x > y,
             ">=": lambda x, y: x >= y,
         }[op]
-        return NumCol(fn(da, db), "b")
+        res = fn(da, db)
+        # SQL three-valued logic: a null operand makes the predicate false
+        for side in (a, b):
+            if isinstance(side, NumCol) and side.kind in ("i", "d", "t", "f"):
+                res = res & ~null_mask(side)
+        return NumCol(res, "b")
 
     kind = _result_kind(a, b, op)
     if op == "+":
@@ -230,6 +253,18 @@ def _binop(op, a, b):
     else:
         raise CompileError(f"binop {op}")
     out = jnp.asarray(out)
+    # arithmetic would destroy int sentinels (INT_MIN + 1 is no longer null):
+    # re-mark the result null wherever a sentinel-kind operand was null
+    nulls = None
+    for side in (a, b):
+        if isinstance(side, NumCol) and side.kind in ("i", "d", "t"):
+            nm = null_mask(side)
+            nulls = nm if nulls is None else nulls | nm
+    if nulls is not None:
+        if kind == "f" or jnp.issubdtype(out.dtype, jnp.floating):
+            out = jnp.where(nulls, jnp.nan, out)
+        else:
+            out = jnp.where(nulls, jnp.iinfo(out.dtype).min, out)
     return NumCol(out, kind)
 
 
@@ -271,7 +306,7 @@ def _wide_compare(op, a, b):
         val = int(v.days if isinstance(v, _DateScalar) else v)
         hi = np.int32(val >> 32)
         lo_u = np.uint32(val & 0xFFFFFFFF)
-        lo = np.int32(np.int64(int(lo_u) ^ 0x80000000) - 2**31)
+        lo = np.int32(int(lo_u) - 2**31)
         return hi, lo
 
     ahi, alo = limbs(a)
@@ -286,7 +321,11 @@ def _wide_compare(op, a, b):
         ">": ~(lt | eq),
         ">=": ~lt,
     }
-    return NumCol(table[op], "b")
+    res = table[op]
+    for side in (a, b):
+        if isinstance(side, NumCol):
+            res = res & ~null_mask(side)
+    return NumCol(res, "b")
 
 
 def _lo_sortable_from_narrow(x):
@@ -300,8 +339,23 @@ def _lo_sortable_from_narrow(x):
 
 
 def _dict_gather(col: StrCol, host_values: np.ndarray, kind: str) -> NumCol:
-    """Evaluate something per-dictionary-entry on host, gather by code."""
-    return NumCol(jnp.asarray(host_values)[col.codes], kind)
+    """Evaluate something per-dictionary-entry on host, gather by code.
+    Null rows (code < 0) yield False for predicates, NULL sentinel for ints."""
+    g = jnp.asarray(host_values)[jnp.maximum(col.codes, 0)]
+    isnull = col.codes < 0
+    if kind == "b":
+        g = g & ~isnull
+    elif kind == "f":
+        g = jnp.where(isnull, jnp.nan, g)
+    else:
+        sent = NULL_I32 if g.dtype != jnp.int64 else -(2**63)
+        g = jnp.where(isnull, sent, g)
+    return NumCol(g, kind)
+
+
+def _notnone(vals: np.ndarray) -> np.ndarray:
+    """Host mask of dictionary entries that are real strings (None = null)."""
+    return np.array([x is not None for x in vals], dtype=bool)
 
 
 def _string_compare(op, a, b):
@@ -309,24 +363,54 @@ def _string_compare(op, a, b):
         a, b, op = b, a, _flip(op)
     if isinstance(a, StrCol) and isinstance(b, str):
         vals = a.dictionary.values.astype(str)
+        nn = _notnone(a.dictionary.values)  # null strings never match (3VL)
         if op == "=":
-            return _dict_gather(a, vals == b, "b")
+            return _dict_gather(a, (vals == b) & nn, "b")
         if op == "!=":
-            return _dict_gather(a, vals != b, "b")
+            return _dict_gather(a, (vals != b) & nn, "b")
         cmp = {"<": vals < b, "<=": vals <= b, ">": vals > b, ">=": vals >= b}[op]
-        return _dict_gather(a, cmp, "b")
+        return _dict_gather(a, cmp & nn, "b")
     if isinstance(a, StrCol) and isinstance(b, StrCol):
         if op not in ("=", "!="):
             raise CompileError("ordering comparison between two string columns (todo)")
         ahi, alo = a.hash_limbs()
         bhi, blo = b.hash_limbs()
         eq = (ahi == bhi) & (alo == blo)
-        return NumCol(eq if op == "=" else ~eq, "b")
+        out = eq if op == "=" else ~eq
+        out = out & ~null_mask(a) & ~null_mask(b)
+        return NumCol(out, "b")
     raise CompileError(f"string comparison {type(a)} {op} {type(b)}")
 
 
 def _flip(op):
     return {"<": ">", "<=": ">=", ">": "<", ">=": "<=", "=": "=", "!=": "!="}[op]
+
+
+_NEG_CMP = {"=": "!=", "!=": "=", "<": ">=", "<=": ">", ">": "<=", ">=": "<"}
+
+
+def _negate_expr(e: Expr) -> Optional[Expr]:
+    """Push a logical NOT one level down, or None if it can't be pushed.
+    Negated comparisons keep their null guard (null operand -> false), which a
+    plain bitwise invert would wrongly turn into true (SQL three-valued logic)."""
+    if isinstance(e, BinOp):
+        if e.op in _NEG_CMP:
+            return BinOp(_NEG_CMP[e.op], e.left, e.right)
+        if e.op in ("and", "or"):
+            la, lb = _negate_expr(e.left), _negate_expr(e.right)
+            if la is None:
+                la = UnaryOp("not", e.left)
+            if lb is None:
+                lb = UnaryOp("not", e.right)
+            return BinOp("or" if e.op == "and" else "and", la, lb)
+        return None
+    if isinstance(e, UnaryOp) and e.op == "not":
+        return e.operand
+    if isinstance(e, IsNull):
+        return IsNull(e.expr, negated=not e.negated)
+    if isinstance(e, InList):
+        return InList(e.expr, e.values, negated=not e.negated)
+    return None
 
 
 def _like_to_regex(pat: str) -> str:
@@ -396,6 +480,7 @@ def _in_list(e: InList, batch: DeviceBatch):
     v = evaluate(e.expr, batch)
     if isinstance(v, StrCol):
         mask = np.isin(v.dictionary.values.astype(str), [str(x) for x in e.values])
+        mask = mask & _notnone(v.dictionary.values)
         out = _dict_gather(v, mask, "b")
     else:
         data = _numeric_data(v)
@@ -405,16 +490,16 @@ def _in_list(e: InList, batch: DeviceBatch):
         out = NumCol(acc, "b")
     if e.negated:
         out = NumCol(~out.data, "b")
+    # null operand: both IN and NOT IN are null -> false under 3VL
+    if isinstance(v, (NumCol, StrCol)):
+        out = NumCol(out.data & ~null_mask(v), "b")
     return out
 
 
 def _is_null(e: IsNull, batch: DeviceBatch):
     v = evaluate(e.expr, batch)
-    if isinstance(v, StrCol):
-        mask = np.array([x is None for x in v.dictionary.values])
-        out = _dict_gather(v, mask, "b")
-    elif isinstance(v, NumCol) and v.kind == "f":
-        out = NumCol(jnp.isnan(v.data), "b")
+    if isinstance(v, (StrCol, NumCol)):
+        out = NumCol(null_mask(v), "b")
     else:
         out = NumCol(jnp.zeros(batch.padded_len, dtype=jnp.bool_), "b")
     if e.negated:
@@ -546,6 +631,28 @@ def _func(e: Func, batch: DeviceBatch):
     def num(i):
         return _numeric_data(args[i])
 
+    if name in ("__nn0", "__nnhigh", "__nnlow", "__nncount"):
+        # internal null-skipping wrappers injected by AggPlan.rewrite: replace
+        # nulls with the aggregate's identity element before the kernel agg
+        v = args[0]
+        if not isinstance(v, (NumCol, StrCol)):
+            if name == "__nncount":
+                return NumCol(jnp.ones(batch.padded_len, dtype=jnp.int32), "i")
+            return v
+        nm = null_mask(v)
+        if name == "__nncount":
+            return NumCol((~nm).astype(jnp.int32), "i")
+        if isinstance(v, StrCol):
+            raise CompileError("numeric aggregate over a string column")
+        if v.hi is not None:
+            raise CompileError("aggregate over wide ints requires x64")
+        if v.kind == "f":
+            repl = {"__nn0": 0.0, "__nnhigh": jnp.inf, "__nnlow": -jnp.inf}[name]
+        else:
+            ii = jnp.iinfo(v.data.dtype)
+            repl = {"__nn0": 0, "__nnhigh": ii.max, "__nnlow": ii.min}[name]
+        return NumCol(jnp.where(nm, repl, v.data), v.kind, unit=v.unit)
+
     if name == "abs":
         return NumCol(jnp.abs(num(0)), _kind_of(args[0]))
     if name == "round":
@@ -569,10 +676,25 @@ def _func(e: Func, batch: DeviceBatch):
         f = jnp.sin if name == "sin" else jnp.cos
         return NumCol(f(jnp.asarray(num(0), config.float_dtype())), "f")
     if name == "coalesce":
-        out = num(0)
+        v = args[0]
+        if not isinstance(v, NumCol):
+            return v  # scalar first arg is never null
+        if v.hi is not None:
+            raise CompileError("coalesce on wide ints requires x64")
+        kind = v.kind
+        out = v.data
         for i in range(1, len(args)):
-            out = jnp.where(jnp.isnan(out), num(i), out)
-        return NumCol(out, "f")
+            # sentinel-aware: detect nulls of the CURRENT accumulator (NaN for
+            # floats, INT_MIN for int kinds), not just NaN
+            nm = null_mask(NumCol(out, kind))
+            nxt = args[i]
+            nxt_data = nxt.data if isinstance(nxt, NumCol) else nxt
+            if isinstance(nxt, NumCol) and nxt.kind == "f" and kind != "f":
+                out = out.astype(config.float_dtype())
+                kind = "f"
+                nm = jnp.isnan(out) | nm.astype(bool)
+            out = jnp.where(nm, nxt_data, out)
+        return NumCol(jnp.asarray(out), kind)
     if name in ("greatest", "least"):
         f = jnp.maximum if name == "greatest" else jnp.minimum
         out = num(0)
@@ -654,19 +776,30 @@ class AggPlan:
         if isinstance(e, Agg):
             if e.distinct:
                 raise CompileError("count(distinct) requires the holistic agg path")
-            if e.op in ("sum", "min", "max"):
-                return ColRef(self._partial(e.op, e.arg))
+            # null skipping: wrap args so nulls become the agg's identity and
+            # count(col) counts only non-null rows (SQL semantics)
+            def nn_count(arg):
+                if arg is None:
+                    return ColRef(self._partial("count", None))
+                return ColRef(self._partial("sum", Func("__nncount", [arg])))
+
+            if e.op == "sum":
+                return ColRef(self._partial("sum", Func("__nn0", [e.arg])))
+            if e.op == "min":
+                return ColRef(self._partial("min", Func("__nnhigh", [e.arg])))
+            if e.op == "max":
+                return ColRef(self._partial("max", Func("__nnlow", [e.arg])))
             if e.op == "count":
-                return ColRef(self._partial("count", e.arg))
+                return nn_count(e.arg)
             if e.op == "avg":
-                s = ColRef(self._partial("sum", e.arg))
-                c = ColRef(self._partial("count", e.arg))
+                s = ColRef(self._partial("sum", Func("__nn0", [e.arg])))
+                c = nn_count(e.arg)
                 return BinOp("/", s, c)
             if e.op in ("stddev", "var"):
-                x = e.arg
+                x = Func("__nn0", [e.arg])
                 s1 = ColRef(self._partial("sum", x))
                 s2 = ColRef(self._partial("sum", BinOp("*", x, x)))
-                c = ColRef(self._partial("count", x))
+                c = nn_count(e.arg)
                 mean = BinOp("/", s1, c)
                 var = BinOp("-", BinOp("/", s2, c), BinOp("*", mean, mean))
                 if e.op == "var":

@@ -52,6 +52,11 @@ def _is_string_dependent(e: Expr, batch: DeviceBatch) -> bool:
     """Does evaluating e require dictionary VALUES (host data)?"""
     if isinstance(e, (StrOp,)):
         return True
+    if isinstance(e, UnaryOp) and e.op == "not":
+        # bind the whole NOT subtree, not just its string child: evaluate()'s
+        # 3VL null guard lives inside the NOT handling, and `not __bound`
+        # would re-invert null rows back to True
+        return _is_string_dependent(e.operand, batch)
     if isinstance(e, InList):
         return _refs_string(e.expr, batch)
     if isinstance(e, IsNull):

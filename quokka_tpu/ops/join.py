@@ -28,8 +28,16 @@ from jax import lax
 
 from quokka_tpu import config
 from quokka_tpu.ops import kernels
-from quokka_tpu.ops.batch import DeviceBatch, NumCol, StrCol, key_limbs
+from quokka_tpu.ops.batch import DeviceBatch, NumCol, StrCol, key_limbs, null_mask, with_nulls
 from quokka_tpu.ops.kernels import dense_rank
+
+
+def _nonnull_valid(batch: DeviceBatch, keys) -> jax.Array:
+    """Rows with any null join key never match (SQL null-join semantics)."""
+    v = batch.valid
+    for k in keys:
+        v = v & ~null_mask(batch.columns[k])
+    return v
 
 
 def _concat_limbs(probe: DeviceBatch, build: DeviceBatch, probe_keys, build_keys):
@@ -37,7 +45,9 @@ def _concat_limbs(probe: DeviceBatch, build: DeviceBatch, probe_keys, build_keys
     lb = key_limbs(build, build_keys)
     assert len(lp) == len(lb), "join key column types must match"
     limbs = [jnp.concatenate([a, b.astype(a.dtype)]) for a, b in zip(lp, lb)]
-    valid = jnp.concatenate([probe.valid, build.valid])
+    valid = jnp.concatenate(
+        [_nonnull_valid(probe, probe_keys), _nonnull_valid(build, build_keys)]
+    )
     return limbs, valid
 
 
@@ -76,8 +86,8 @@ def hash_join_pk(
     for name in build_payload:
         c = build.columns[name]
         taken = c.take(build_idx)
-        if how == "left" and isinstance(taken, NumCol) and taken.kind == "f":
-            taken = NumCol(jnp.where(matched, taken.data, jnp.nan), "f")
+        if how == "left":
+            taken = with_nulls(taken, ~matched)
         cols[name] = taken
     if how == "inner":
         out_valid = matched
@@ -158,8 +168,8 @@ def hash_join_general(
     for name in build_payload:
         c = build.columns[name]
         taken = c.take(build_idx)
-        if how == "left" and isinstance(taken, NumCol) and taken.kind == "f":
-            taken = NumCol(jnp.where(unmatched, jnp.nan, taken.data), "f")
+        if how == "left":
+            taken = with_nulls(taken, unmatched)
         cols[name] = taken
     return DeviceBatch(cols, out_valid, ntotal if how == "inner" else None, None)
 
@@ -168,10 +178,12 @@ def hash_join_general(
 def _is_unmatched_gather(limbs, valid, p, probe_idx):
     ranks, _ = dense_rank(tuple(limbs), valid)
     rp, rb = ranks[:p], ranks[p:]
-    vb = valid[p:]
+    vp, vb = valid[:p], valid[p:]
     n = valid.shape[0]
     cnt = jax.ops.segment_sum(vb.astype(jnp.int32), rb, num_segments=n)
-    return cnt[rp][probe_idx] == 0
+    # dense_rank gives invalid (incl. null-key) probe rows an arbitrary rank —
+    # they must read as unmatched regardless of that rank's build count
+    return ((cnt[rp] == 0) | ~vp)[probe_idx]
 
 
 def build_keys_unique(build: DeviceBatch, build_keys: Sequence[str]) -> bool:

@@ -87,7 +87,11 @@ class BatchCache:
 
     def _plan_sorted(self, idx, src_actor, tgt_actor, tgt_ch, chans, max_batches):
         """Global (seq, channel) order across all source channels; stop at the
-        first missing batch so ordering is never violated."""
+        first missing batch so ordering is never violated.  Channels whose
+        stream has ended are already pruned from `chans` by the engine (DST +
+        LIT check, engine.handle_exec_task), so every frontier seq here will
+        eventually exist; the scan jumps frontier-to-frontier — no unbounded
+        walk, no convergence guard."""
         names = []
         frontier = dict(chans)  # channel -> next needed seq
         channels = sorted(frontier.keys())
@@ -95,22 +99,20 @@ class BatchCache:
             return names
         seq = min(frontier.values())
         while len(names) < max_batches:
-            progressed = False
             for ch in channels:
                 if frontier[ch] != seq:
                     continue
                 if seq in idx.get((src_actor, ch), ()):
                     names.append((src_actor, ch, seq, tgt_actor, src_actor, tgt_ch))
                     frontier[ch] = seq + 1
-                    progressed = True
                     if len(names) >= max_batches:
                         return names
                 else:
                     return names  # hole: stop to preserve order
-            if not progressed:
-                seq += 1
-                if seq > max(frontier.values(), default=0) + 1_000_000:
-                    break
+            future = [f for f in frontier.values() if f > seq]
+            if not future:
+                break
+            seq = min(future)
         return names
 
     def get(self, name: Tuple):

@@ -277,8 +277,13 @@ class Engine:
         import jax.numpy as jnp
 
         col = batch.columns[part.key]
-        bounds = jnp.asarray(part.boundaries)
-        pids = jnp.searchsorted(bounds, col.data, side="right").astype(jnp.int32)
+        if getattr(col, "hi", None) is not None:
+            from quokka_tpu.ops import timewide
+
+            pids = timewide.limb_le_scalar_count(col, [int(b) for b in part.boundaries])
+        else:
+            bounds = jnp.asarray(part.boundaries)
+            pids = jnp.searchsorted(bounds, col.data, side="right").astype(jnp.int32)
         if part.descending:
             pids = (n_tgt - 1) - pids  # channel 0 owns the highest range
         return dict(enumerate(kernels.split_by_partition(batch, pids, n_tgt)))

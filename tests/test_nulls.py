@@ -1,0 +1,260 @@
+"""Null semantics (ADVICE r1): ingestion keeps nulls as sentinels, predicates
+use SQL three-valued logic, aggregates skip nulls, left joins null-fill every
+payload kind, and null join keys never match.  Oracles: pandas (which also
+skips nulls in aggregations) and hand-computed expectations."""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from quokka_tpu import QuokkaContext
+
+
+def nullable_table():
+    return pa.table(
+        {
+            "k": pa.array([1, 2, None, 4, 5, None, 2, 1], type=pa.int64()),
+            "f": pa.array([1.0, None, 3.0, None, 5.0, 6.0, 7.0, 8.0]),
+            "s": pa.array(["a", None, "c", "a", None, "b", "c", "a"]),
+            "d": pa.array(
+                [0, 10, None, 30, None, 50, 60, 70], type=pa.int32()
+            ).cast(pa.date32()),
+        }
+    )
+
+
+class TestIngestRoundtrip:
+    def test_nulls_survive_collect(self):
+        t = nullable_table()
+        got = QuokkaContext().from_arrow(t).collect()
+        exp = t.to_pandas()
+        for c in ("k", "f", "s", "d"):
+            np.testing.assert_array_equal(
+                got[c].isna().to_numpy(), exp[c].isna().to_numpy(), err_msg=c
+            )
+        np.testing.assert_array_equal(
+            got["k"].dropna().to_numpy(), exp["k"].dropna().to_numpy()
+        )
+        assert got["s"].dropna().tolist() == exp["s"].dropna().tolist()
+
+
+class TestPredicates:
+    def test_comparisons_exclude_nulls(self):
+        t = nullable_table()
+        ctx = QuokkaContext()
+        for pred, oracle in [
+            ("k > 1", lambda df: df[df.k > 1]),
+            ("k < 5", lambda df: df[df.k < 5]),
+            ("k != 2", lambda df: df[df.k.notna() & (df.k != 2)]),
+            ("f <= 6.0", lambda df: df[df.f <= 6.0]),
+            ("s = 'a'", lambda df: df[df.s == "a"]),
+            ("s != 'a'", lambda df: df[df.s.notna() & (df.s != "a")]),
+        ]:
+            got = ctx.from_arrow(t).filter_sql(pred).collect()
+            exp = oracle(t.to_pandas())
+            assert len(got) == len(exp), pred
+
+    def test_is_null(self):
+        t = nullable_table()
+        ctx = QuokkaContext()
+        assert len(ctx.from_arrow(t).filter_sql("k is null").collect()) == 2
+        assert len(ctx.from_arrow(t).filter_sql("k is not null").collect()) == 6
+        assert len(ctx.from_arrow(t).filter_sql("s is null").collect()) == 2
+        assert len(ctx.from_arrow(t).filter_sql("f is not null").collect()) == 6
+        assert len(ctx.from_arrow(t).filter_sql("d is null").collect()) == 2
+
+
+class TestAggregates:
+    def test_null_skipping_aggs(self):
+        t = nullable_table()
+        got = (
+            QuokkaContext()
+            .from_arrow(t)
+            .agg_sql(
+                "count(*) as n, count(f) as nf, sum(f) as sf, avg(f) as af, "
+                "min(f) as mf, max(f) as xf, count(k) as nk"
+            )
+            .collect()
+        )
+        df = t.to_pandas()
+        assert got["n"][0] == len(df)
+        assert got["nf"][0] == df.f.notna().sum()
+        assert got["nk"][0] == df.k.notna().sum()
+        np.testing.assert_allclose(got["sf"][0], df.f.sum())
+        np.testing.assert_allclose(got["af"][0], df.f.mean())
+        np.testing.assert_allclose(got["mf"][0], df.f.min())
+        np.testing.assert_allclose(got["xf"][0], df.f.max())
+
+    def test_grouped_null_key_groups_together(self):
+        t = nullable_table()
+        got = (
+            QuokkaContext()
+            .from_arrow(t)
+            .groupby("k")
+            .agg_sql("count(*) as n")
+            .collect()
+        )
+        df = t.to_pandas()
+        exp = df.groupby("k", dropna=False).size()
+        assert len(got) == len(exp)
+        # the null group exists and has the right count
+        nulls = got[got.k.isna()]
+        assert len(nulls) == 1 and nulls.n.iloc[0] == 2
+
+
+class TestThreeValuedLogic:
+    def test_not_over_null_comparison(self):
+        t = nullable_table()
+        ctx = QuokkaContext()
+        # NOT (k = 2) with k null must exclude the null rows (SQL 3VL)
+        got = ctx.from_arrow(t).filter_sql("not (k = 2)").collect()
+        df = t.to_pandas()
+        assert len(got) == len(df[df.k.notna() & (df.k != 2)])
+        got2 = ctx.from_arrow(t).filter_sql("not (k > 2 and k < 5)").collect()
+        exp2 = df[df.k.notna() & ~((df.k > 2) & (df.k < 5))]
+        assert len(got2) == len(exp2)
+
+    def test_in_and_not_in_exclude_nulls(self):
+        t = nullable_table()
+        ctx = QuokkaContext()
+        df = t.to_pandas()
+        got = ctx.from_arrow(t).filter_sql("k in (1, 2)").collect()
+        assert len(got) == len(df[df.k.isin([1, 2])])
+        got = ctx.from_arrow(t).filter_sql("k not in (1, 2)").collect()
+        assert len(got) == len(df[df.k.notna() & ~df.k.isin([1, 2])])
+
+    def test_sum_over_arithmetic_on_nullable(self):
+        t = nullable_table()
+        ctx = QuokkaContext()
+        df = t.to_pandas()
+        got = (
+            ctx.from_arrow(t)
+            .agg_sql("sum(k + 1) as s, count(k * 2) as c")
+            .collect()
+        )
+        np.testing.assert_allclose(got["s"][0], (df.k + 1).sum())
+        assert got["c"][0] == df.k.notna().sum()
+
+
+class TestNullStrings:
+    def test_groupby_nullable_string_key(self):
+        t = nullable_table()
+        got = (
+            QuokkaContext()
+            .from_arrow(t)
+            .groupby("s")
+            .agg_sql("count(*) as n")
+            .collect()
+        )
+        df = t.to_pandas()
+        exp = df.groupby("s", dropna=False).size().reset_index(name="n")
+        assert len(got) == len(exp)
+        nulls = got[got.s.isna()]
+        assert len(nulls) == 1 and nulls.n.iloc[0] == 2
+        m_got = {k: v for k, v in zip(got.s, got.n) if isinstance(k, str)}
+        m_exp = {k: v for k, v in zip(exp.s, exp.n) if isinstance(k, str)}
+        assert m_got == m_exp
+
+    def test_not_like_excludes_nulls(self):
+        t = nullable_table()
+        ctx = QuokkaContext()
+        df = t.to_pandas()
+        got = ctx.from_arrow(t).filter_sql("s not like 'a%'").collect()
+        exp = df[df.s.notna() & ~df.s.str.startswith("a")]
+        assert len(got) == len(exp)
+
+
+class TestCoalesce:
+    def test_coalesce_int_sentinel(self):
+        t = nullable_table()
+        got = (
+            QuokkaContext()
+            .from_arrow(t)
+            .with_columns_sql("coalesce(k, 0) as k0, coalesce(f, -1.0) as f0")
+            .collect()
+        )
+        df = t.to_pandas()
+        np.testing.assert_array_equal(
+            got.k0.to_numpy(dtype=float), df.k.fillna(0).to_numpy(dtype=float)
+        )
+        np.testing.assert_allclose(got.f0.to_numpy(), df.f.fillna(-1.0).to_numpy())
+
+
+class TestJoins:
+    def test_left_join_null_probe_key_general_path(self):
+        # general (non-unique build) path: null-key probe rows must read as
+        # unmatched despite dense_rank giving them an arbitrary rank
+        left = pa.table(
+            {"k": pa.array([1, None, 9], type=pa.int64()), "lv": [1.0, 2.0, 3.0]}
+        )
+        # duplicate build keys force hash_join_general; 9 is the largest key
+        right = pa.table(
+            {"k": pa.array([1, 1, 9], type=pa.int64()), "rv": [10.0, 11.0, 90.0]}
+        )
+        ctx = QuokkaContext()
+        got = (
+            ctx.from_arrow(left)
+            .join(ctx.from_arrow(right), on="k", how="left")
+            .collect()
+        )
+        nullrow = got[got.lv == 2.0]
+        assert len(nullrow) == 1
+        assert nullrow.rv.isna().all()
+        assert len(got) == 4  # 2 matches for k=1, 1 for k=9, 1 null row
+    def test_null_keys_never_match(self):
+        left = pa.table({"k": pa.array([1, None, 2], type=pa.int64()),
+                         "lv": [10.0, 20.0, 30.0]})
+        right = pa.table({"k": pa.array([None, 1, 3], type=pa.int64()),
+                          "rv": [100.0, 200.0, 300.0]})
+        ctx = QuokkaContext()
+        l = ctx.from_arrow(left)
+        r = ctx.from_arrow(right)
+        inner = l.join(r, on="k").collect()
+        assert len(inner) == 1 and inner.rv.iloc[0] == 200.0
+        semi = l.join(r, on="k", how="semi").collect()
+        assert semi.lv.tolist() == [10.0]
+        anti = l.join(r, on="k", how="anti").collect()
+        assert sorted(anti.lv.tolist()) == [20.0, 30.0]
+
+    def test_left_join_null_fills_all_kinds(self):
+        left = pa.table({"k": pa.array([1, 2, 3], type=pa.int64()),
+                         "lv": [1.0, 2.0, 3.0]})
+        right = pa.table(
+            {
+                "k": pa.array([1], type=pa.int64()),
+                "ri": pa.array([42], type=pa.int64()),
+                "rf": pa.array([4.2]),
+                "rs": pa.array(["hit"]),
+                "rd": pa.array([100], type=pa.int32()).cast(pa.date32()),
+            }
+        )
+        ctx = QuokkaContext()
+        got = (
+            ctx.from_arrow(left)
+            .join(ctx.from_arrow(right), on="k", how="left")
+            .collect()
+            .sort_values("k")
+            .reset_index(drop=True)
+        )
+        assert len(got) == 3
+        matched = got[got.k == 1]
+        assert matched.ri.iloc[0] == 42 and matched.rs.iloc[0] == "hit"
+        unmatched = got[got.k != 1]
+        for c in ("ri", "rf", "rs", "rd"):
+            assert unmatched[c].isna().all(), c
+
+    def test_left_join_empty_build_side(self):
+        # VERDICT weak #7: left join where the build side filters to zero rows
+        left = pa.table({"k": pa.array([1, 2], type=pa.int64()), "lv": [1.0, 2.0]})
+        right = pa.table({"k": pa.array([9], type=pa.int64()), "rv": [9.0]})
+        ctx = QuokkaContext()
+        got = (
+            ctx.from_arrow(left)
+            .join(
+                ctx.from_arrow(right).filter_sql("k < 0"), on="k", how="left"
+            )
+            .collect()
+        )
+        assert len(got) == 2
+        assert got.rv.isna().all()

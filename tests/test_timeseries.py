@@ -294,3 +294,60 @@ class TestCEP:
         # low at t=9 (px 7): nothing after
         assert got.low_time.tolist() == [2, 5, 6]
         assert got.rise_time.tolist() == [3, 8, 8]
+
+
+class TestAsofForward:
+    def _streamed(self, ctx, table, batch_rows):
+        from quokka_tpu import logical
+        from quokka_tpu.dataset.readers import InputArrowDataset
+
+        reader = InputArrowDataset(table, batch_rows=batch_rows)
+        return ctx.new_stream(
+            logical.SourceNode(reader, list(table.column_names), sorted_by=["time"]),
+            ordered=True,
+        )
+
+    def test_forward_asof_lagging_key(self):
+        # key A's quotes arrive far later in global time than its trades: a
+        # watermark-style readiness rule would emit A trades unmatched; the
+        # matched-is-final rule must hold them until the A quotes arrive
+        r = np.random.default_rng(4)
+        tt = np.arange(0, 1000, dtype=np.int64)
+        syms = np.where(np.arange(1000) % 2 == 0, "A", "B")
+        trades = pa.table({"time": tt, "symbol": syms,
+                           "size": r.integers(1, 9, 1000).astype(np.int64)})
+        qb = np.arange(1, 1000, 2, dtype=np.int64)
+        qa = np.arange(5000, 5010, dtype=np.int64)
+        quotes = pa.table(
+            {
+                "time": np.concatenate([qb, qa]),
+                "symbol": ["B"] * len(qb) + ["A"] * len(qa),
+                "bid": np.concatenate([qb, qa]).astype(np.float64) / 10,
+            }
+        )
+        ctx = QuokkaContext()
+        t = self._streamed(ctx, trades, 64)
+        q = self._streamed(ctx, quotes, 64)
+        got = t.join_asof(q, on="time", by="symbol", direction="forward").collect()
+        exp = pd.merge_asof(
+            trades.to_pandas(), quotes.to_pandas().sort_values("time"),
+            on="time", by="symbol", direction="forward",
+        ).dropna(subset=["bid"])
+        got = got.sort_values(["symbol", "time"]).reset_index(drop=True)
+        exp = exp.sort_values(["symbol", "time"]).reset_index(drop=True)
+        assert len(got) == len(exp)
+        np.testing.assert_allclose(got.bid.to_numpy(), exp.bid.to_numpy())
+
+    def test_forward_asof_single_batch(self):
+        trades = pa.table({"time": np.array([1, 5, 9], dtype=np.int64),
+                           "symbol": ["A", "A", "A"]})
+        quotes = pa.table({"time": np.array([4, 7], dtype=np.int64),
+                           "symbol": ["A", "A"], "bid": [1.0, 2.0]})
+        ctx = QuokkaContext()
+        t = ctx.from_arrow_sorted(trades, sorted_by="time")
+        q = ctx.from_arrow_sorted(quotes, sorted_by="time")
+        got = t.join_asof(q, on="time", by="symbol", direction="forward").collect()
+        got = got.sort_values("time")
+        # t=1 -> quote 4 (1.0); t=5 -> quote 7 (2.0); t=9 -> unmatched/dropped
+        assert got.time.tolist() == [1, 5]
+        assert got.bid.tolist() == [1.0, 2.0]

@@ -1,0 +1,210 @@
+"""Wide (two-limb int64) correctness without x64 — the TPU configuration.
+
+The main suite runs with x64 on, so the limb code paths (sort keys, range
+filters, asof times, window assignment) are only exercised here.  Every test
+flips x64 off, runs values that straddle a 2**31 low-limb boundary (where the
+old encoding was non-monotonic, ADVICE r1), and compares against numpy/pandas
+oracles on true int64.
+"""
+
+import jax
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from quokka_tpu import QuokkaContext
+from quokka_tpu.ops import asof as asof_ops
+from quokka_tpu.ops import bridge, kernels, timewide
+from quokka_tpu.windows import TumblingWindow
+
+
+@pytest.fixture
+def no_x64():
+    jax.config.update("jax_enable_x64", False)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_enable_x64", True)
+
+
+def straddling_values(seed=7, n=512):
+    """int64 values whose low 32 bits cluster around 2**31 (both sides), with
+    several distinct high limbs including negatives."""
+    r = np.random.default_rng(seed)
+    his = np.array([-2, -1, 0, 1, 5], dtype=np.int64)
+    hi = his[r.integers(0, len(his), n)] << np.int64(32)
+    lo = (2**31 + r.integers(-1000, 1000, n)).astype(np.int64) % (2**32)
+    extra = r.integers(0, 2**32, n).astype(np.uint64).astype(np.int64)
+    vals = np.where(r.random(n) < 0.5, hi + lo, hi + extra)
+    return vals
+
+
+class TestLimbEncoding:
+    def test_roundtrip_arrow(self, no_x64):
+        vals = straddling_values()
+        t = pa.table({"x": vals})
+        b = bridge.arrow_to_device(t)
+        assert b.columns["x"].hi is not None  # actually exercising limbs
+        back = bridge.device_to_arrow(b)
+        np.testing.assert_array_equal(back.column("x").to_numpy(), vals)
+
+    def test_sort_straddles_lo_boundary(self, no_x64):
+        vals = straddling_values()
+        b = bridge.arrow_to_device(pa.table({"x": vals}))
+        s = kernels.sort_batch(b, ["x"])
+        got = bridge.device_to_arrow(s).column("x").to_numpy()
+        np.testing.assert_array_equal(got, np.sort(vals))
+
+    def test_rebase_roundtrip(self, no_x64):
+        r = np.random.default_rng(11)
+        # wide absolute values, span < 2**31, crossing a low-limb wrap
+        vals = 1_600_000_000_000_000_000 + r.integers(0, 2**31 - 2048, 512)
+        base = int(vals.min()) - 123
+        b = bridge.arrow_to_device(pa.table({"x": vals}))
+        col = b.columns["x"]
+        np.testing.assert_array_equal(timewide.host_i64(col, b.valid), vals)
+        rel = timewide.rebase_narrow(col, b.valid, base)
+        restored = timewide.add_base(rel.data, base, "i", None)
+        got = timewide.host_i64(restored, b.valid)
+        np.testing.assert_array_equal(got, vals)
+
+    def test_rebase_overflow_raises(self, no_x64):
+        vals = np.array([0, 2**33], dtype=np.int64)
+        b = bridge.arrow_to_device(pa.table({"x": vals}))
+        with pytest.raises(ValueError, match="coarser unit"):
+            timewide.rebase_narrow(b.columns["x"], b.valid, 0)
+
+    def test_range_partition_counts(self, no_x64):
+        vals = straddling_values(seed=13)
+        bounds = sorted(int(v) for v in straddling_values(seed=17, n=7))
+        b = bridge.arrow_to_device(pa.table({"x": vals}))
+        got = np.asarray(timewide.limb_le_scalar_count(b.columns["x"], bounds))
+        exp = np.searchsorted(np.array(bounds), vals, side="right")
+        np.testing.assert_array_equal(got[: len(vals)], exp)
+
+
+class TestWideQueries:
+    def test_filter_and_sort_query(self, no_x64):
+        vals = straddling_values(seed=23)
+        bound = int(np.median(vals))
+        t = pa.table({"x": vals, "v": np.arange(len(vals), dtype=np.int32)})
+        ctx = QuokkaContext()
+        got = (
+            ctx.from_arrow(t)
+            .filter_sql(f"x > {bound}")
+            .sort("x")
+            .collect()
+        )
+        exp = t.to_pandas().query("x > @bound")
+        assert (np.diff(got["x"].to_numpy()) >= 0).all()  # engine output x-ordered
+        # duplicate x values: engine sort is by x only, so tiebreak both sides
+        got = got.sort_values(["x", "v"]).reset_index(drop=True)
+        exp = exp.sort_values(["x", "v"]).reset_index(drop=True)
+        np.testing.assert_array_equal(got["x"].to_numpy(), exp["x"].to_numpy())
+        np.testing.assert_array_equal(got["v"].to_numpy(), exp["v"].to_numpy())
+
+
+def make_wide_ticks(seed=5, n_trades=600, n_quotes=1200):
+    """Tick times as ns-scale int64 spanning multiple 2**32 boundaries."""
+    r = np.random.default_rng(seed)
+    base = 1_600_000_000_000_000_000  # ~2020 in ns
+    span = 40_000_000_000  # 40s in ns: ~9 low-limb wraps
+    tt = base + np.sort(r.integers(0, span, n_trades))
+    qt = base + np.sort(r.choice(span, n_quotes, replace=False))
+    syms = np.array(["A", "B", "C"])
+    trades = pa.table(
+        {"time": tt, "symbol": syms[r.integers(0, 3, n_trades)],
+         "size": r.integers(1, 100, n_trades).astype(np.int32)}
+    )
+    quotes = pa.table(
+        {"time": qt, "symbol": syms[r.integers(0, 3, n_quotes)],
+         "bid": r.uniform(10, 20, n_quotes).round(2).astype(np.float32)}
+    )
+    return trades, quotes
+
+
+class TestWideTimeseries:
+    def test_asof_kernel_backward_and_forward(self, no_x64):
+        trades, quotes = make_wide_ticks()
+        tb = bridge.arrow_to_device(trades)
+        qb = bridge.arrow_to_device(quotes)
+        assert tb.columns["time"].hi is not None
+        for direction in ("backward", "forward"):
+            out = asof_ops.asof_join(
+                tb, qb, "time", "time", ["symbol"], ["symbol"], ["bid"],
+                direction=direction,
+            )
+            out = kernels.apply_mask(out, out.columns.pop("__asof_matched__").data)
+            got = bridge.device_to_arrow(kernels.compact(out)).to_pandas()
+            exp = pd.merge_asof(
+                trades.to_pandas(), quotes.to_pandas(), on="time",
+                by="symbol", direction=direction,
+            ).dropna(subset=["bid"])
+            got = got.sort_values(["time", "symbol"]).reset_index(drop=True)
+            exp = exp.sort_values(["time", "symbol"]).reset_index(drop=True)
+            assert len(got) == len(exp), direction
+            np.testing.assert_allclose(
+                got.bid.to_numpy(), exp.bid.to_numpy(), rtol=1e-6
+            )
+
+    def test_streaming_asof_wide(self, no_x64):
+        trades, quotes = make_wide_ticks(seed=9)
+        ctx = QuokkaContext()
+        t = ctx.from_arrow_sorted(trades, sorted_by="time")
+        q = ctx.from_arrow_sorted(quotes, sorted_by="time")
+        got = t.join_asof(q, on="time", by="symbol").collect()
+        exp = pd.merge_asof(
+            trades.to_pandas(), quotes.to_pandas(), on="time",
+            by="symbol", direction="backward",
+        ).dropna(subset=["bid"])
+        got = got.sort_values(["time", "symbol"]).reset_index(drop=True)
+        exp = exp.sort_values(["time", "symbol"]).reset_index(drop=True)
+        assert len(got) == len(exp)
+        np.testing.assert_array_equal(got.time.to_numpy(), exp.time.to_numpy())
+        np.testing.assert_allclose(got.bid.to_numpy(), exp.bid.to_numpy(), rtol=1e-6)
+
+    def test_streaming_asof_forward(self, no_x64):
+        trades, quotes = make_wide_ticks(seed=13)
+        ctx = QuokkaContext()
+        t = ctx.from_arrow_sorted(trades, sorted_by="time")
+        q = ctx.from_arrow_sorted(quotes, sorted_by="time")
+        got = t.join_asof(q, on="time", by="symbol", direction="forward").collect()
+        exp = pd.merge_asof(
+            trades.to_pandas(), quotes.to_pandas(), on="time",
+            by="symbol", direction="forward",
+        ).dropna(subset=["bid"])
+        got = got.sort_values(["time", "symbol"]).reset_index(drop=True)
+        exp = exp.sort_values(["time", "symbol"]).reset_index(drop=True)
+        assert len(got) == len(exp)
+        np.testing.assert_allclose(got.bid.to_numpy(), exp.bid.to_numpy(), rtol=1e-6)
+
+    def test_tumbling_window_wide_ns(self, no_x64):
+        # rebase path: span must fit int32 units; put the base just below a
+        # 2**32 wrap so window times still cross a low-limb boundary
+        r = np.random.default_rng(21)
+        k = 1_600_000_000_000_000_000 // 2**32
+        base = (k + 1) * 2**32 - 900_000_000
+        tt = base + np.sort(r.integers(0, 1_000_000_000, 600))
+        syms = np.array(["A", "B", "C"])
+        trades = pa.table(
+            {"time": tt, "symbol": syms[r.integers(0, 3, 600)],
+             "size": r.integers(1, 100, 600).astype(np.int32)}
+        )
+        size = 200_000_000
+        ctx = QuokkaContext()
+        s = ctx.from_arrow_sorted(trades, sorted_by="time")
+        got = s.window_agg(
+            TumblingWindow(size), "sum(size) as vol", by="symbol"
+        ).collect()
+        df = trades.to_pandas()
+        df["window_start"] = (df.time // size) * size
+        exp = (
+            df.groupby(["symbol", "window_start"])["size"].sum().reset_index(name="vol")
+        )
+        got = got.sort_values(["symbol", "window_start"]).reset_index(drop=True)
+        exp = exp.sort_values(["symbol", "window_start"]).reset_index(drop=True)
+        np.testing.assert_array_equal(
+            got.window_start.to_numpy().astype(np.int64), exp.window_start.to_numpy()
+        )
+        np.testing.assert_allclose(got.vol.to_numpy(), exp.vol.to_numpy(), rtol=1e-6)
