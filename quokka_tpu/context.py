@@ -40,8 +40,12 @@ class QuokkaContext:
         exec_channels: int = 2,
         exec_config: Optional[dict] = None,
         optimize: bool = True,
+        mesh=None,
     ):
         self.cluster = cluster  # reserved for multi-host deployments
+        # jax.sharding.Mesh: run supported plans SPMD with channels == shards
+        # (parallel/mesh_exec.py); unsupported plans fall back to the engine
+        self.mesh = mesh
         self.io_channels = io_channels
         self.exec_channels = exec_channels
         self.exec_config = dict(config.DEFAULT_EXEC_CONFIG)
@@ -232,6 +236,17 @@ class QuokkaContext:
             from quokka_tpu.optimizer import optimize
 
             sink_id = optimize(sub, sink_id, exec_channels=self.exec_channels)
+        if self.mesh is not None:
+            from quokka_tpu.parallel.mesh_exec import MeshExecutor, MeshUnsupported
+            from quokka_tpu.runtime.dataset import ResultDataset
+
+            try:
+                table = MeshExecutor(self.mesh).run_to_arrow(sub, sink_id)
+                ds = ResultDataset()
+                ds.append(0, table)
+                return ds
+            except MeshUnsupported:
+                pass  # plan shape not covered: embedded engine below
         self._assign_stages(sub, sink_id)
         graph = TaskGraph(self.exec_config)
         actor_of: Dict[int, int] = {}

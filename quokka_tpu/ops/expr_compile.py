@@ -353,9 +353,13 @@ def _dict_gather(col: StrCol, host_values: np.ndarray, kind: str) -> NumCol:
     return NumCol(g, kind)
 
 
-def _notnone(vals: np.ndarray) -> np.ndarray:
-    """Host mask of dictionary entries that are real strings (None = null)."""
-    return np.array([x is not None for x in vals], dtype=bool)
+def _notnone(d: StringDict) -> np.ndarray:
+    """Host mask of dictionary entries that are real strings (None = null).
+    Reuses the cached StringDict.none_entries mask — no per-batch host loop."""
+    none = d.none_entries
+    if none is None:
+        return np.ones(len(d), dtype=bool)
+    return ~none
 
 
 def _string_compare(op, a, b):
@@ -363,7 +367,7 @@ def _string_compare(op, a, b):
         a, b, op = b, a, _flip(op)
     if isinstance(a, StrCol) and isinstance(b, str):
         vals = a.dictionary.values.astype(str)
-        nn = _notnone(a.dictionary.values)  # null strings never match (3VL)
+        nn = _notnone(a.dictionary)  # null strings never match (3VL)
         if op == "=":
             return _dict_gather(a, (vals == b) & nn, "b")
         if op == "!=":
@@ -480,7 +484,7 @@ def _in_list(e: InList, batch: DeviceBatch):
     v = evaluate(e.expr, batch)
     if isinstance(v, StrCol):
         mask = np.isin(v.dictionary.values.astype(str), [str(x) for x in e.values])
-        mask = mask & _notnone(v.dictionary.values)
+        mask = mask & _notnone(v.dictionary)
         out = _dict_gather(v, mask, "b")
     else:
         data = _numeric_data(v)

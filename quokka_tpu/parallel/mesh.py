@@ -105,69 +105,39 @@ def collective_hash_shuffle(
 # ---------------------------------------------------------------------------
 
 
-def _local_groupby(keys: Tuple[jax.Array, ...], vals: Tuple[jax.Array, ...],
-                   ops: Tuple[str, ...], valid: jax.Array):
-    """Local sort+segment groupby: returns (group keys, agg values, gvalid)
-    padded to the local length."""
-    n = valid.shape[0]
-    iota = jnp.arange(n, dtype=jnp.int32)
-    inv = (~valid).astype(jnp.int32)
-    sorted_ops = lax.sort([inv, *keys, iota], num_keys=1 + len(keys))
-    perm = sorted_ops[-1]
-    valid_s = sorted_ops[0] == 0
-    changed = jnp.zeros(n, dtype=bool)
-    for ks in sorted_ops[1:-1]:
-        changed = changed | (ks != jnp.roll(ks, 1))
-    starts = valid_s & (changed | (iota == 0))
-    ranks = jnp.maximum(jnp.cumsum(starts.astype(jnp.int32)) - 1, 0)
-    num = jnp.max(jnp.where(valid_s, ranks, -1)) + 1
-    outs = []
-    for v, op in zip(vals, ops):
-        vs = v[perm]
-        if op == "sum":
-            outs.append(jax.ops.segment_sum(jnp.where(valid_s, vs, 0), ranks, num_segments=n))
-        elif op == "count":
-            outs.append(jax.ops.segment_sum(valid_s.astype(vs.dtype), ranks, num_segments=n))
-        elif op == "min":
-            big = jnp.array(jnp.inf, vs.dtype) if jnp.issubdtype(vs.dtype, jnp.floating) else jnp.array(jnp.iinfo(vs.dtype).max, vs.dtype)
-            outs.append(jax.ops.segment_min(jnp.where(valid_s, vs, big), ranks, num_segments=n))
-        elif op == "max":
-            small = jnp.array(-jnp.inf, vs.dtype) if jnp.issubdtype(vs.dtype, jnp.floating) else jnp.array(jnp.iinfo(vs.dtype).min, vs.dtype)
-            outs.append(jax.ops.segment_max(jnp.where(valid_s, vs, small), ranks, num_segments=n))
-        else:
-            raise ValueError(op)
-    rep = jnp.full(n, n - 1, jnp.int32).at[ranks].min(jnp.where(valid_s, iota, n - 1))
-    gkeys = tuple(ks[rep] for ks in sorted_ops[1:-1])
-    gvalid = jnp.arange(n) < num
-    return gkeys, tuple(outs), gvalid
-
-
 def distributed_groupby_step(
     mesh: Mesh,
     key_cols: int,
     val_ops: Tuple[str, ...],
     axis: str = "dp",
 ):
-    """Build a jitted distributed group-by-aggregate:
-    local partial agg -> all_to_all shuffle of partials by key hash ->
-    final agg per device.  Input arrays are sharded [total_rows] over `axis`;
-    outputs are the per-device final groups (sharded).
-    This is the TPU execution of the engine's PartialAgg -> HashPartition ->
-    FinalAgg plan (logical.AggNode.lower)."""
+    """Jitted distributed group-by-aggregate: local partial agg -> all_to_all
+    shuffle of partials by key hash -> final agg per device.  Built from the
+    SAME kernel the embedded engine uses (ops/kernels.sorted_groupby) — the
+    full-plan version of this (with carried key values, AggPlan decomposition,
+    string keys) lives in parallel/mesh_exec.mesh_groupby, which is what
+    QuokkaContext(mesh=...) executes."""
+    from quokka_tpu.ops import kernels
 
     recombine = tuple("sum" if op == "count" else op for op in val_ops)
+
+    def _grouped(keys, vals, ops, valid):
+        n = valid.shape[0]
+        outs, _, rep, num = kernels.sorted_groupby(tuple(keys), tuple(vals), ops, valid)
+        gkeys = tuple(k[rep] for k in keys)
+        return gkeys, tuple(outs), jnp.arange(n) < num
 
     def step(*arrays):
         keys = arrays[:key_cols]
         vals = arrays[key_cols : key_cols + len(val_ops)]
         valid = arrays[-1]
-        gkeys, gvals, gvalid = _local_groupby(keys, vals, val_ops, valid)
+        gkeys, gvals, gvalid = _grouped(keys, vals, val_ops, valid)
         cols = tuple(gkeys) + tuple(gvals)
         key_idx = tuple(range(key_cols))
         shuf, shuf_valid = collective_hash_shuffle(cols, gvalid, key_idx, axis)
-        skeys = shuf[:key_cols]
-        svals = shuf[key_cols:]
-        fkeys, fvals, fvalid = _local_groupby(skeys, svals, recombine, shuf_valid)
+        fkeys, fvals, fvalid = _grouped(
+            shuf[:key_cols], shuf[key_cols:], recombine, shuf_valid
+        )
         return fkeys + fvals + (fvalid,)
 
     sharded = jax.shard_map(
@@ -181,35 +151,21 @@ def distributed_groupby_step(
 
 
 def distributed_join_groupby_step(mesh: Mesh, axis: str = "dp"):
-    """A full distributed query step exercising both collective shuffle
-    patterns: two dp-sharded tables are key-shuffled (all_to_all), hash-joined
-    per device (rank-based), and the join output partially aggregated, then
-    psum-reduced to a replicated scalar.  This is the multi-chip shape of
-    TPC-H Q3-style plans."""
+    """Distributed shuffle-join + psum reduction built from the engine's rank
+    join kernel (ops/join._pk_match): two dp-sharded tables are key-shuffled
+    (all_to_all), PK-joined per device, and the joined product is psum-reduced
+    to a replicated scalar.  Full relational joins over a mesh run through
+    parallel/mesh_exec.mesh_join."""
+    from quokka_tpu.ops import join as join_ops
 
     def step(l_key, l_val, l_valid, r_key, r_val, r_valid):
         (lk, lv), lvalid = collective_hash_shuffle((l_key, l_val), l_valid, (0,), axis)
         (rk, rv), rvalid = collective_hash_shuffle((r_key, r_val), r_valid, (0,), axis)
-        # rank-based PK join (build = right)
         p = lk.shape[0]
-        keys = jnp.concatenate([lk, rk])
+        limbs = (jnp.concatenate([lk, rk.astype(lk.dtype)]),)
         valid = jnp.concatenate([lvalid, rvalid])
-        n = keys.shape[0]
-        iota = jnp.arange(n, dtype=jnp.int32)
-        inv = (~valid).astype(jnp.int32)
-        s_inv, s_key, s_iota = lax.sort([inv, keys, iota], num_keys=2)
-        valid_s = s_inv == 0
-        changed = (s_key != jnp.roll(s_key, 1)) | (iota == 0)
-        ranks_sorted = jnp.maximum(jnp.cumsum((valid_s & changed).astype(jnp.int32)) - 1, 0)
-        ranks = jnp.zeros(n, jnp.int32).at[s_iota].set(ranks_sorted)
-        rp, rb = ranks[:p], ranks[p:]
-        vb = valid[p:]
-        b = n - p
-        iota_b = jnp.arange(b, dtype=jnp.int32)
-        first = jnp.full(n, b, jnp.int32).at[rb].min(jnp.where(vb, iota_b, b))
-        cnt = jax.ops.segment_sum(vb.astype(jnp.int32), rb, num_segments=n)
-        matched = lvalid & (cnt[rp] > 0)
-        rv_matched = rv[jnp.clip(first[rp], 0, b - 1)]
+        build_idx, matched = join_ops._pk_match(limbs, valid, p)
+        rv_matched = rv[build_idx]
         prod = jnp.where(matched, lv * rv_matched, 0.0)
         total = lax.psum(jnp.sum(prod), axis)
         rows = lax.psum(jnp.sum(matched.astype(jnp.int32)), axis)
