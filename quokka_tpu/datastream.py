@@ -17,6 +17,7 @@ from quokka_tpu.expression import (
     Alias,
     ColRef,
     Expr,
+    IsNull,
     col,
     conjoin,
     lit_wrap,
@@ -239,7 +240,10 @@ class DataStream:
     aggregate_sql = agg_sql
 
     def count_distinct(self, col_name: str) -> "DataStream":
-        return self.select([col_name]).distinct().aggregate_sql("count(*) as count")
+        # same lowering (and null exclusion) as SQL count(distinct col)
+        return GroupedDataStream(self, [], None)._agg_exprs(
+            [Alias(Agg("count", ColRef(col_name), distinct=True), "count")]
+        )
 
     def sum(self, columns) -> "DataStream":
         columns = [columns] if isinstance(columns, str) else list(columns)
@@ -472,6 +476,9 @@ class GroupedDataStream:
 
     def _agg_exprs(self, exprs: List[Alias], having=None, order_by=None,
                    limit=None) -> DataStream:
+        rewritten = self._rewrite_count_distinct(exprs, having, order_by, limit)
+        if rewritten is not None:
+            return rewritten
         plan = plan_aggregation(exprs)
         if having is not None:
             # aggregates inside HAVING become references to (possibly new)
@@ -488,6 +495,51 @@ class GroupedDataStream:
             having=having, order_by=order_by, limit=limit,
         )
         return self.stream._child(node)
+
+    def _rewrite_count_distinct(self, exprs, having, order_by, limit):
+        """count(distinct x) lowers to distinct-then-count: project keys + x,
+        de-duplicate (a group-by), then count per key (reference:
+        datastream.py:1769 _grouped_count_distinct).  Only the pure form is
+        rewritten; mixing with other aggregates raises."""
+        def is_cd(e):
+            return isinstance(e, Agg) and e.distinct
+
+        cds = [a for a in exprs if is_cd(a.expr)]
+        if not cds:
+            return None
+        if len(cds) != len(exprs) or len(cds) != 1:
+            raise ValueError(
+                "count(distinct) cannot be mixed with other aggregates yet; "
+                "compute it in a separate aggregation and join"
+            )
+        a = cds[0]
+        agg = a.expr
+        if agg.op != "count" or not isinstance(agg.arg, ColRef):
+            raise ValueError("only count(distinct column) is supported")
+        colname = agg.arg.name
+
+        def subst(e):
+            # over the deduped stream, count(distinct col) == count(*):
+            # rewrite HAVING/ORDER references so the inner plan compiles
+            if isinstance(e, Agg) and e.distinct:
+                return Agg("count", None)
+            kids = e.children()
+            if not kids:
+                return e
+            from quokka_tpu.expression import _rebuild
+
+            return _rebuild(e, [subst(k) for k in kids])
+
+        d = (
+            self.stream.filter(IsNull(ColRef(colname), True))  # nulls don't count
+            .select(self.keys + [colname])
+            .distinct()
+        )
+        having = None if having is None else subst(having)
+        g = GroupedDataStream(d, self.keys, self.orderby)
+        out = g._agg_exprs([Alias(Agg("count", None), a.name)],
+                           having=having, order_by=order_by, limit=limit)
+        return out
 
 
 class OrderedStream(DataStream):

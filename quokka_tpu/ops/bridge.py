@@ -253,7 +253,10 @@ def concat_batches(batches: Sequence[DeviceBatch]) -> DeviceBatch:
             for c, remap, cnt in zip(cols, remaps, counts):
                 codes = c.codes[:cnt]
                 if remap is not None:
-                    codes = jnp.asarray(remap)[codes]
+                    # null rows carry code -1: keep them null (a bare gather
+                    # would clamp -1 onto dictionary entry 0)
+                    remapped = jnp.asarray(remap)[jnp.maximum(codes, 0)]
+                    codes = jnp.where(codes < 0, -1, remapped)
                 code_parts.append(codes)
             codes = _pad_device(jnp.concatenate(code_parts), padded)
             out_cols[name] = StrCol(codes, merged)

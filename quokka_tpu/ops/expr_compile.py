@@ -268,9 +268,48 @@ def _binop(op, a, b):
     return NumCol(out, kind)
 
 
+def _days_in_month(y, m):
+    """Vectorized month lengths with Gregorian leap years."""
+    lengths = jnp.asarray([31, 28, 31, 30, 31, 30, 31, 31, 30, 31, 30, 31],
+                          dtype=jnp.int32)
+    leap = ((y % 4 == 0) & ((y % 100 != 0) | (y % 400 == 0))).astype(jnp.int32)
+    return lengths[m - 1] + jnp.where(m == 2, leap, 0)
+
+
+def _add_months_days(days, delta_months):
+    """date(days since epoch) + N calendar months, day-of-month clamped to the
+    target month's length (SQL interval-month semantics)."""
+    y, m, d = _civil_from_days(days)
+    mt = y * 12 + (m - 1) + delta_months
+    y2 = jnp.floor_divide(mt, 12)
+    m2 = mt - y2 * 12 + 1
+    d2 = jnp.minimum(d, _days_in_month(y2, m2))
+    return _days_from_civil(y2, m2, d2)
+
+
+def _add_months_scalar(days: int, delta_months: int) -> int:
+    import calendar
+    import datetime
+
+    dt = datetime.date(1970, 1, 1) + datetime.timedelta(days=int(days))
+    mt = dt.year * 12 + (dt.month - 1) + int(delta_months)
+    y2, m2 = mt // 12, mt % 12 + 1
+    d2 = min(dt.day, calendar.monthrange(y2, m2)[1])
+    return (datetime.date(y2, m2, d2) - datetime.date(1970, 1, 1)).days
+
+
 def _date_interval(op, a, iv: IntervalLit):
     if iv.months:
-        raise CompileError("month/year intervals need calendar arithmetic (todo)")
+        delta_m = -iv.months if op == "-" else iv.months
+        if iv.micros:
+            raise CompileError("mixed month+day intervals")
+        if isinstance(a, _DateScalar):
+            return _DateScalar(_add_months_scalar(a.days, delta_m))
+        if isinstance(a, NumCol) and a.kind == "d":
+            out = _add_months_days(a.data, delta_m).astype(jnp.int32)
+            nm = null_mask(a)  # civil math would turn the sentinel into a date
+            return NumCol(jnp.where(nm, jnp.int32(NULL_I32), out), "d")
+        raise CompileError("month/year interval arithmetic on non-date")
     if not isinstance(a, NumCol):
         if isinstance(a, _DateScalar):
             d = a.days + (iv.days if op == "+" else -iv.days)
@@ -448,8 +487,8 @@ def _str_op(e: StrOp, batch: DeviceBatch):
     if e.op == "length":
         return _dict_gather(v, np.char.str_len(svals).astype(np.int32), "i")
     if e.op == "hash":
-        hi = jnp.asarray(v.dictionary.hash_hi)[v.codes]
-        return NumCol(hi, "i")
+        hi = jnp.asarray(v.dictionary.hash_hi)[jnp.maximum(v.codes, 0)]
+        return NumCol(jnp.where(v.codes < 0, 0, hi), "i")
     # string -> string transforms: rewrite the dictionary, keep codes
     if e.op == "lower":
         return StrCol(v.codes, StringDict(np.char.lower(svals).astype(object)))

@@ -81,7 +81,9 @@ def sort_limbs(batch: DeviceBatch, cols: Sequence[str], descending=None) -> List
             order = np.argsort(c.dictionary.values.astype(str), kind="stable")
             rank = np.empty(len(order), dtype=np.int32)
             rank[order] = np.arange(len(order), dtype=np.int32)
-            limb = jnp.asarray(rank)[c.codes]
+            limb = jnp.asarray(rank)[jnp.maximum(c.codes, 0)]
+            # nulls (code -1) sort first ascending (rank -1 < all real ranks)
+            limb = jnp.where(c.codes < 0, -1, limb)
             limbs.append(~limb if desc else limb)
         else:
             parts = []

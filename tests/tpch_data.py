@@ -48,9 +48,28 @@ CONTAINERS = [
 ]
 
 
-def generate(sf: float = 0.003, seed: int = 0):
-    """Return {table_name: pyarrow.Table}.  sf=1 would be full TPC-H scale."""
+def generate(sf: float = 0.003, seed: int = 0, skew: bool = False,
+             nulls: bool = False):
+    """Return {table_name: pyarrow.Table}.  sf=1 would be full TPC-H scale.
+    skew: Zipf-distributed foreign keys (hot customers/parts — exercises
+    rank-join/segment-agg paths with giant groups).  nulls: ~3% nulls in
+    lineitem numeric/string columns (null-semantics under real queries)."""
     r = np.random.default_rng(seed)
+
+    def fk(n_draw, lo, hi):
+        """Foreign keys in [lo, hi): uniform, or Zipf-skewed when requested."""
+        if not skew:
+            return r.integers(lo, hi, n_draw).astype(np.int64)
+        z = r.zipf(1.3, n_draw).astype(np.int64)
+        return lo + (z - 1) % (hi - lo)
+
+    def with_nulls(arr, frac=0.03):
+        if not nulls:
+            return arr
+        mask = r.random(len(arr)) < frac
+        return pa.array(
+            [None if m else v for v, m in zip(arr.tolist() if hasattr(arr, "tolist") else arr, mask)]
+        )
     n_orders = max(int(1_500_000 * sf), 50)
     n_cust = max(int(150_000 * sf), 20)
     n_part = max(int(200_000 * sf), 25)
@@ -133,10 +152,14 @@ def generate(sf: float = 0.003, seed: int = 0):
         }
     )
     o_orderdate = _dates(r, n_orders, "1992-01-01", "1998-08-02")
+    # dbgen-alike: customers with custkey % 3 == 0 place no orders (this is
+    # what makes Q22's "customers without orders" anti-join non-empty)
+    with_orders = np.arange(1, n_cust + 1, dtype=np.int64)
+    with_orders = with_orders[with_orders % 3 != 0]
     orders = pa.table(
         {
             "o_orderkey": np.arange(1, n_orders + 1, dtype=np.int64) * 4,
-            "o_custkey": r.integers(1, n_cust + 1, n_orders).astype(np.int64),
+            "o_custkey": with_orders[fk(n_orders, 0, len(with_orders))],
             "o_orderstatus": [["F", "O", "P"][i] for i in r.integers(0, 3, n_orders)],
             "o_totalprice": np.round(r.uniform(1000, 400000, n_orders), 2),
             "o_orderdate": pa.array(o_orderdate, type=pa.int32()).cast(pa.date32()),
@@ -163,14 +186,16 @@ def generate(sf: float = 0.003, seed: int = 0):
     lineitem = pa.table(
         {
             "l_orderkey": l_orderkey,
-            "l_partkey": r.integers(1, n_part + 1, n_li).astype(np.int64),
-            "l_suppkey": r.integers(1, n_supp + 1, n_li).astype(np.int64),
+            "l_partkey": fk(n_li, 1, n_part + 1),
+            "l_suppkey": fk(n_li, 1, n_supp + 1),
             "l_linenumber": l_linenumber,
             "l_quantity": qty,
             "l_extendedprice": price,
-            "l_discount": np.round(r.uniform(0, 0.1, n_li), 2),
-            "l_tax": np.round(r.uniform(0, 0.08, n_li), 2),
-            "l_returnflag": [["A", "N", "R"][i] for i in r.integers(0, 3, n_li)],
+            "l_discount": with_nulls(np.round(r.uniform(0, 0.1, n_li), 2)),
+            "l_tax": with_nulls(np.round(r.uniform(0, 0.08, n_li), 2)),
+            "l_returnflag": with_nulls(
+                np.array([["A", "N", "R"][i] for i in r.integers(0, 3, n_li)], dtype=object)
+            ),
             "l_linestatus": [["F", "O"][i] for i in r.integers(0, 2, n_li)],
             "l_shipdate": pa.array(l_shipdate.astype(np.int32), type=pa.int32()).cast(pa.date32()),
             "l_commitdate": pa.array(l_commitdate.astype(np.int32), type=pa.int32()).cast(pa.date32()),
