@@ -253,7 +253,21 @@ class QuokkaContext:
         for nid in self._toposort(sub, sink_id):
             sub[nid].lower(self, graph, actor_of, nid)
         self.latest_graph = graph
-        graph.run()
+        n_workers = getattr(self.cluster, "n_workers", 0) if self.cluster else 0
+        if n_workers:
+            from quokka_tpu.runtime.distributed import run_distributed
+
+            try:
+                run_distributed(
+                    graph,
+                    n_workers=n_workers,
+                    kill_after_inputs=self.exec_config.get("inject_kill_worker"),
+                    heartbeat_timeout=self.exec_config.get("heartbeat_timeout"),
+                )
+            finally:
+                graph.cleanup()
+        else:
+            graph.run()
         return graph.result(actor_of[sink_id])
 
     def _copy_subgraph(self, node_id: int):

@@ -9,6 +9,8 @@ instead of sqlglot.
 
 from __future__ import annotations
 
+import functools
+
 from typing import Callable, Dict, List, Optional, Sequence, Union
 
 from quokka_tpu import logical, sqlparse
@@ -101,11 +103,9 @@ class DataStream:
 
     def rename(self, mapping: Dict[str, str]) -> "DataStream":
         new_schema = [mapping.get(c, c) for c in self.schema]
-
-        def fn(b: DeviceBatch) -> DeviceBatch:
-            return b.rename(mapping)
-
-        return self._child(logical.MapNode([self.node_id], new_schema, fn))
+        return self._child(
+            logical.MapNode([self.node_id], new_schema, logical.RenameFn(mapping))
+        )
 
     def with_columns(self, exprs: Dict[str, Union[Expr, str]]) -> "DataStream":
         compiled = {
@@ -113,14 +113,11 @@ class DataStream:
             for k, v in exprs.items()
         }
         new_schema = self.schema + [k for k in compiled if k not in self.schema]
-
-        def fn(b: DeviceBatch) -> DeviceBatch:
-            for name, e in compiled.items():
-                b = b.with_column(name, evaluate_to_column(e, b))
-            return b
-
         return self._child(
-            logical.MapNode([self.node_id], new_schema, fn, exprs=compiled)
+            logical.MapNode(
+                [self.node_id], new_schema, logical.WithColumnsFn(compiled),
+                exprs=compiled,
+            )
         )
 
     def with_columns_sql(self, sql: str) -> "DataStream":
@@ -160,7 +157,7 @@ class DataStream:
             logical.StatefulNode(
                 [self.node_id],
                 new_schema,
-                lambda: _copy.deepcopy(executor),
+                functools.partial(_copy.deepcopy, executor),
                 partitioners={0: part},
             )
         )
@@ -276,7 +273,7 @@ class DataStream:
         node = logical.StatefulNode(
             [self.node_id],
             ["filename"],
-            lambda: OutputExecutor(path, fmt, rows_per_file),
+            functools.partial(OutputExecutor, path, fmt, rows_per_file),
         )
         return self._child(node).collect()
 
@@ -300,11 +297,11 @@ class DataStream:
         local = logical.StatefulNode(
             [self.node_id],
             out_schema,
-            lambda: NearestNeighborExecutor(queries, vec_col, k, payload_cols),
+            functools.partial(NearestNeighborExecutor, queries, vec_col, k, payload_cols),
         )
         local_id = self.ctx.add_node(local)
         reduce_node = logical.StatefulNode(
-            [local_id], out_schema, lambda: GlobalTopKReduceExecutor(k)
+            [local_id], out_schema, functools.partial(GlobalTopKReduceExecutor, k)
         )
         reduce_node.channels = 1
         return DataStream(self.ctx, self.ctx.add_node(reduce_node))
@@ -329,11 +326,11 @@ class DataStream:
         local = logical.StatefulNode(
             [self.node_id],
             ["__row"] + columns,
-            lambda: GramianExecutor(columns, covariance),
+            functools.partial(GramianExecutor, columns, covariance),
         )
         local_id = self.ctx.add_node(local)
         combine = logical.StatefulNode(
-            [local_id], out_schema, lambda: CombineGramianExecutor(columns, covariance)
+            [local_id], out_schema, functools.partial(CombineGramianExecutor, columns, covariance)
         )
         combine.channels = 1
         return DataStream(self.ctx, self.ctx.add_node(combine))
@@ -349,11 +346,11 @@ class DataStream:
         local = logical.StatefulNode(
             [self.node_id],
             out_schema,
-            lambda: ReservoirQuantileExecutor(column, quantiles),
+            functools.partial(ReservoirQuantileExecutor, column, quantiles),
         )
         local_id = self.ctx.add_node(local)
         combine = logical.StatefulNode(
-            [local_id], out_schema, lambda: CombineQuantileExecutor(column, quantiles)
+            [local_id], out_schema, functools.partial(CombineQuantileExecutor, column, quantiles)
         )
         combine.channels = 1
         return DataStream(self.ctx, self.ctx.add_node(combine))
@@ -620,7 +617,7 @@ class OrderedStream(DataStream):
         node = logical.StatefulNode(
             [self.node_id, right.node_id],
             out_schema,
-            lambda: SortedAsofExecutor(
+            functools.partial(SortedAsofExecutor, 
                 left_on, right_on, left_by, right_by, suffix, direction=direction
             ),
             partitioners=parts,
@@ -646,13 +643,13 @@ class OrderedStream(DataStream):
         named = [e if isinstance(e, Alias) else Alias(e, f"col{i}") for i, e in enumerate(exprs)]
         plan = plan_aggregation(named)
         if isinstance(window, (W.TumblingWindow, W.HoppingWindow)):
-            factory = lambda: HoppingWindowExecutor(time_col, by, window, plan, trigger)
+            factory = functools.partial(HoppingWindowExecutor, time_col, by, window, plan, trigger)
             extra = ["window_start", "window_end"]
         elif isinstance(window, W.SessionWindow):
-            factory = lambda: SessionWindowExecutor(time_col, by, window, plan)
+            factory = functools.partial(SessionWindowExecutor, time_col, by, window, plan)
             extra = ["session_start", "session_end"]
         elif isinstance(window, W.SlidingWindow):
-            factory = lambda: SlidingWindowExecutor(time_col, by, window, plan)
+            factory = functools.partial(SlidingWindowExecutor, time_col, by, window, plan)
             extra = []
         else:
             raise TypeError(f"unknown window type {type(window)}")
@@ -687,7 +684,7 @@ class OrderedStream(DataStream):
         node = logical.StatefulNode(
             [self.node_id],
             out_schema,
-            lambda: ShiftExecutor(time_col, by, columns, n),
+            functools.partial(ShiftExecutor, time_col, by, columns, n),
             partitioners={0: HashPartitioner(by) if by else PassThroughPartitioner()},
             sorted_output=[time_col],
         )
@@ -705,7 +702,7 @@ class OrderedStream(DataStream):
         node = logical.StatefulNode(
             [self.node_id],
             out_schema,
-            lambda: CEPExecutor(time_col, events, within, by),
+            functools.partial(CEPExecutor, time_col, events, within, by),
             partitioners={0: HashPartitioner(by) if by else PassThroughPartitioner()},
         )
         return self._child(node)

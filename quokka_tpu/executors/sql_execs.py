@@ -57,6 +57,20 @@ class StorageExecutor(Executor):
         return bridge.concat_batches(live) if len(live) > 1 else live[0]
 
 
+class SelectingStorageExecutor(StorageExecutor):
+    """Terminal collect that also projects to the plan schema (picklable —
+    the sink factory crosses process boundaries in the multi-worker runtime)."""
+
+    def __init__(self, schema: Sequence[str]):
+        self.schema = list(schema)
+
+    def execute(self, batches, stream_id, channel):
+        out = StorageExecutor.execute(self, batches, stream_id, channel)
+        if out is None:
+            return None
+        return out.select([c for c in self.schema if c in out.columns])
+
+
 class PartialAggExecutor(Executor):
     SUPPORTS_CHECKPOINT = True
     """Per-channel partial group-by: maintains one running partial-aggregate
@@ -326,14 +340,40 @@ class BuildProbeJoinExecutor(Executor):
         return bridge.concat_batches(outs) if len(outs) > 1 else outs[0]
 
     def checkpoint(self):
-        state = self.build if self.build is not None else None
-        if state is None and self.build_parts:
-            state = bridge.concat_batches(self.build_parts)
-        return None if state is None else bridge.device_to_arrow(state)
+        build = self.build
+        if build is None and self.build_parts:
+            build = bridge.concat_batches(self.build_parts)
+        return {
+            "build": None if build is None else bridge.device_to_arrow(build),
+            # without these, a restore past the build's source_done event
+            # would buffer every probe batch forever (build_done False) and
+            # silently emit nothing
+            "build_done": self.build_done,
+            "finalized": self.build is not None,
+            "rename": self.rename,
+            "payload": self.payload,
+            "probe_buffer": [bridge.device_to_arrow(b) for b in self.probe_buffer],
+        }
 
     def restore(self, state):
-        if state is not None:
+        if state is None:
+            return
+        if not isinstance(state, dict):  # legacy: bare build table
             self.build_parts = [bridge.arrow_to_device(state)]
+            return
+        if state["build"] is not None:
+            b = bridge.arrow_to_device(state["build"])
+            if state["finalized"]:
+                self.build = b
+                self.rename = state["rename"]
+                self.payload = state["payload"]
+                self.build_unique = join_ops.build_keys_unique(b, self.right_on)
+            else:
+                self.build_parts = [b]
+        self.build_done = state["build_done"]
+        self.probe_buffer = [
+            bridge.arrow_to_device(t) for t in state["probe_buffer"]
+        ]
 
 
 class BroadcastJoinExecutor(BuildProbeJoinExecutor):

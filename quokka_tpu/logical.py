@@ -9,6 +9,8 @@ describing partitioning and any folded-in predicate/projection/batch functions.
 
 from __future__ import annotations
 
+import functools
+
 import dataclasses
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -78,6 +80,37 @@ def _passthrough_edge():
     return TargetInfo(PassThroughPartitioner())
 
 
+@dataclasses.dataclass
+class SelectFn:
+    """Picklable per-batch projection (executor factories must cross process
+    boundaries for the multi-worker runtime)."""
+
+    cols: List[str]
+
+    def __call__(self, b):
+        return b.select(self.cols)
+
+
+@dataclasses.dataclass
+class RenameFn:
+    mapping: Dict[str, str]
+
+    def __call__(self, b):
+        return b.rename(self.mapping)
+
+
+@dataclasses.dataclass
+class WithColumnsFn:
+    """Picklable with_columns map: compiles its expressions per batch."""
+
+    exprs: Dict[str, Expr]
+
+    def __call__(self, b):
+        for name, e in self.exprs.items():
+            b = b.with_column(name, evaluate_to_column(e, b))
+        return b
+
+
 class FilterNode(Node):
     def __init__(self, parents, schema, predicate: Expr):
         super().__init__(parents, schema)
@@ -88,12 +121,8 @@ class FilterNode(Node):
         from quokka_tpu.ops.fuse import FusedPredicate
 
         pred = self.predicate
-
-        def factory():
-            return UDFExecutor(FusedPredicate(pred))
-
         actor_of[node_id] = graph.new_exec_node(
-            factory,
+            functools.partial(UDFExecutor, FusedPredicate(pred)),
             {0: (actor_of[self.parents[0]], _passthrough_edge())},
             self.channels or ctx.exec_channels,
             self.stage,
@@ -113,7 +142,7 @@ class ProjectionNode(Node):
 
         cols = list(self.schema)
         actor_of[node_id] = graph.new_exec_node(
-            lambda: UDFExecutor(lambda b: b.select(cols)),
+            functools.partial(UDFExecutor, SelectFn(cols)),
             {0: (actor_of[self.parents[0]], _passthrough_edge())},
             self.channels or ctx.exec_channels,
             self.stage,
@@ -138,7 +167,7 @@ class MapNode(Node):
 
         fn = self.fn
         actor_of[node_id] = graph.new_exec_node(
-            lambda: UDFExecutor(fn),
+            functools.partial(UDFExecutor, fn),
             {0: (actor_of[self.parents[0]], _passthrough_edge())},
             self.channels or ctx.exec_channels,
             self.stage,
@@ -210,7 +239,7 @@ class JoinNode(Node):
                 1: (actor_of[self.parents[1]], TargetInfo(HashPartitioner(right_on))),
             }
         actor_of[node_id] = graph.new_exec_node(
-            lambda: BuildProbeJoinExecutor(
+            functools.partial(BuildProbeJoinExecutor, 
                 left_on, right_on, how, suffix, rename, out_schema=out_schema
             ),
             edges,
@@ -243,7 +272,7 @@ class AggNode(Node):
         keys, plan = self.keys, self.plan
         having, order_by, limit = self.having, self.order_by, self.limit
         partial = graph.new_exec_node(
-            lambda: PartialAggExecutor(keys, plan),
+            functools.partial(PartialAggExecutor, keys, plan),
             {0: (actor_of[self.parents[0]], _passthrough_edge())},
             self.channels or ctx.exec_channels,
             self.stage,
@@ -251,7 +280,7 @@ class AggNode(Node):
         n_final = (self.channels or ctx.exec_channels) if keys else 1
         part = HashPartitioner(keys) if keys else PassThroughPartitioner()
         final = graph.new_exec_node(
-            lambda: FinalAggExecutor(keys, plan, having, order_by, limit),
+            functools.partial(FinalAggExecutor, keys, plan, having, order_by, limit),
             {0: (partial, TargetInfo(part))},
             n_final,
             self.stage,
@@ -263,9 +292,9 @@ class AggNode(Node):
             names = [n for n, _ in (order_by or [])]
             desc = [d for _, d in (order_by or [])]
             if limit is not None:
-                merge_factory = lambda: TopKExecutor(names, limit, desc)
+                merge_factory = functools.partial(TopKExecutor, names, limit, desc)
             else:
-                merge_factory = lambda: SortExecutor(names, desc)
+                merge_factory = functools.partial(SortExecutor, names, desc)
             final = graph.new_exec_node(
                 merge_factory,
                 {0: (final, TargetInfo(PassThroughPartitioner()))},
@@ -288,7 +317,7 @@ class DistinctNode(Node):
 
         keys = self.keys
         actor_of[node_id] = graph.new_exec_node(
-            lambda: DistinctExecutor(keys),
+            functools.partial(DistinctExecutor, keys),
             {0: (actor_of[self.parents[0]], TargetInfo(HashPartitioner(keys)))},
             self.channels or ctx.exec_channels,
             self.stage,
@@ -310,13 +339,13 @@ class TopKNode(Node):
 
         by, k, desc = self.by, self.k, self.descending
         local = graph.new_exec_node(
-            lambda: TopKExecutor(by, k, desc),
+            functools.partial(TopKExecutor, by, k, desc),
             {0: (actor_of[self.parents[0]], _passthrough_edge())},
             self.channels or ctx.exec_channels,
             self.stage,
         )
         actor_of[node_id] = graph.new_exec_node(
-            lambda: TopKExecutor(by, k, desc),
+            functools.partial(TopKExecutor, by, k, desc),
             {0: (local, _passthrough_edge())},
             1,
             self.stage,
@@ -352,7 +381,7 @@ class SortNode(Node):
                 RangePartitioner(by[0], bounds, descending=bool(desc and desc[0]))
             )
             actor_of[node_id] = graph.new_exec_node(
-                lambda: SortExecutor(by, desc),
+                functools.partial(SortExecutor, by, desc),
                 {0: (actor_of[self.parents[0]], edge)},
                 n,
                 self.stage,
@@ -362,7 +391,7 @@ class SortNode(Node):
             )
         else:
             actor_of[node_id] = graph.new_exec_node(
-                lambda: SortExecutor(by, desc),
+                functools.partial(SortExecutor, by, desc),
                 {0: (actor_of[self.parents[0]], _passthrough_edge())},
                 1,
                 self.stage,
@@ -382,20 +411,10 @@ class SinkNode(Node):
         super().__init__(parents, schema)
 
     def lower(self, ctx, graph, actor_of, node_id):
-        from quokka_tpu.executors.sql_execs import StorageExecutor
-
-        schema = list(self.schema)
-
-        class _SelectingStorage(StorageExecutor):
-            def execute(self, batches, stream_id, channel):
-                out = StorageExecutor.execute(self, batches, stream_id, channel)
-                if out is None:
-                    return None
-                keep = [c for c in schema if c in out.columns]
-                return out.select(keep)
+        from quokka_tpu.executors.sql_execs import SelectingStorageExecutor
 
         actor_of[node_id] = graph.new_exec_node(
-            _SelectingStorage,
+            functools.partial(SelectingStorageExecutor, list(self.schema)),
             {0: (actor_of[self.parents[0]], _passthrough_edge())},
             1,
             self.stage,

@@ -153,7 +153,6 @@ class FusedPartialAgg:
     def __init__(self, keys: List[str], plan):
         self.keys = keys
         self.plan = plan
-        self._cache = _FUSED_PROGRAMS
 
     def __call__(self, batch: DeviceBatch) -> DeviceBatch:
         pre = Prepass(batch)
@@ -191,10 +190,10 @@ class FusedPartialAgg:
             tuple((p, op, tmp) for p, op, tmp in self.plan.partials),
             bool(self.keys),
         )
-        fn = self._cache.get(sig)
+        fn = _FUSED_PROGRAMS.get(sig)
         if fn is None:
             fn = self._build(pre_exprs, list(num_inputs), sorted(pre.bound), len(key_limbs))
-            self._cache[sig] = fn
+            _FUSED_PROGRAMS[sig] = fn
         hi_arrays = tuple(
             c.hi if c.hi is not None else jnp.zeros(0, jnp.int32) for c in num_inputs.values()
         )
@@ -263,7 +262,6 @@ class FusedPredicate:
 
     def __init__(self, expr: Expr):
         self.expr = expr
-        self._cache = _FUSED_PROGRAMS
 
     def __call__(self, batch: DeviceBatch) -> DeviceBatch:
         pre = Prepass(batch)
@@ -292,7 +290,7 @@ class FusedPredicate:
             tuple(sorted(pre.bound)),
             e.sql(),
         )
-        fn = self._cache.get(sig)
+        fn = _FUSED_PROGRAMS.get(sig)
         if fn is None:
             names, bnames = list(num_inputs), sorted(pre.bound)
 
@@ -307,7 +305,7 @@ class FusedPredicate:
                 return valid & expr_compile.evaluate_predicate(e, shim)
 
             fn = fused
-            self._cache[sig] = fn
+            _FUSED_PROGRAMS[sig] = fn
         mask = fn(
             tuple(num_inputs[n].data for n in num_inputs),
             tuple(pre.bound[k] for k in sorted(pre.bound)),

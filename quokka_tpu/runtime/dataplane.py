@@ -1,0 +1,66 @@
+"""Socket data plane: per-worker batch cache service.
+
+Plays the role of the reference's per-machine Arrow Flight server
+(pyquokka/flight.py:16-339): producers PUSH partitioned batches to the worker
+that owns the consuming channel (channel-location table CLT); consumers read
+and plan against their LOCAL cache only.  Batches travel as Arrow IPC bytes
+and land on-device (bridge.arrow_to_device) at the receiving worker.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional, Tuple
+
+import pyarrow as pa
+
+from quokka_tpu.ops import bridge
+from quokka_tpu.runtime.cache import BatchCache
+from quokka_tpu.runtime.rpc import RpcClient, RpcServer
+
+
+def table_to_ipc(table: pa.Table) -> bytes:
+    sink = pa.BufferOutputStream()
+    with pa.ipc.new_stream(sink, table.schema) as w:
+        w.write_table(table)
+    return sink.getvalue().to_pybytes()
+
+
+def ipc_to_table(data: bytes) -> pa.Table:
+    with pa.ipc.open_stream(pa.BufferReader(data)) as r:
+        return r.read_all()
+
+
+class CacheService:
+    """RPC target wrapping a worker's local BatchCache for remote do_put."""
+
+    def __init__(self, cache: BatchCache):
+        self.cache = cache
+        self._lock = threading.RLock()  # for RpcServer __multi__ (unused)
+
+    def put_ipc(self, name: Tuple, ipc: bytes, sorted_by=None):
+        batch = bridge.arrow_to_device(ipc_to_table(ipc), sorted_by=sorted_by)
+        self.cache.put(tuple(name), batch)
+
+    def size(self) -> int:
+        return self.cache.size()
+
+
+class DataPlaneClient:
+    """Push batches to a peer worker's cache."""
+
+    def __init__(self, address: Tuple[str, int]):
+        self._rpc = RpcClient(address)
+
+    def put(self, name: Tuple, batch, sorted_by=None) -> None:
+        self._rpc.call(
+            "put_ipc", tuple(name), table_to_ipc(bridge.device_to_arrow(batch)),
+            sorted_by,
+        )
+
+    def close(self) -> None:
+        self._rpc.close()
+
+
+def serve_cache(cache: BatchCache) -> RpcServer:
+    return RpcServer(CacheService(cache))

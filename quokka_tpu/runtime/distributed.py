@@ -1,0 +1,248 @@
+"""Distributed runtime coordinator: multi-process execution of a TaskGraph.
+
+The reference's Coordinator actor (pyquokka/coordinator.py:131-205) serves the
+control plane from Redis, places channels on Ray TaskManagers, detects worker
+death through Ray, and drives the recovery barrier.  Here:
+
+- the coordinator process serves the graph's ControlStore (store_service),
+- channels are round-robin placed onto N spawned worker processes (CLT),
+- liveness = heartbeats written through the store; a silent or dead worker
+  triggers recovery: its input channels are re-derived from GIT/LT and its
+  exec channels are adopted by survivors (checkpoint + tape + HBQ replay),
+- blocking-node results ship back as Arrow IPC and land in the same
+  ResultDataset the embedded engine fills, so collect() is oblivious.
+
+Workers are spawned (not forked): executor factories/readers/predicates are
+picklable by construction (functools.partial over module-level classes).
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import pickle
+import signal
+import time
+from typing import Dict, List, Optional, Tuple
+
+from quokka_tpu.runtime.dataplane import ipc_to_table
+from quokka_tpu.runtime.store_service import CoordinatorStore, serve_store
+from quokka_tpu.runtime.worker import worker_main
+
+
+def _build_spec(graph) -> Dict:
+    actors = {}
+    for aid, info in graph.actors.items():
+        actors[aid] = {
+            "kind": info.kind,
+            "channels": info.channels,
+            "stage": info.stage,
+            "sorted_actor": info.sorted_actor,
+            "reader": info.reader,
+            "factory": info.executor_factory,
+            "targets": info.targets,
+            "source_streams": info.source_streams,
+            "sorted_by": info.sorted_by,
+            "predicate": info.predicate,
+            "projection": info.projection,
+            "blocking": info.blocking_dataset is not None,
+        }
+    from quokka_tpu import config as qconfig
+
+    return {
+        "actors": actors,
+        "exec_config": graph.exec_config,
+        "hbq_path": graph.hbq.path if graph.hbq is not None else None,
+        "ckpt_dir": graph.ckpt_dir,
+        # spawned children start with default jax config; mirror the parent's
+        # x64 mode or float dtypes diverge between the two runtimes
+        "x64": qconfig.x64_enabled(),
+    }
+
+
+def _assign_channels(graph, n_workers: int) -> Dict[int, Dict[int, List[int]]]:
+    """Round-robin (actor, channel) -> worker.  Returns worker -> owned map."""
+    owned: Dict[int, Dict[int, List[int]]] = {w: {} for w in range(n_workers)}
+    i = 0
+    for aid in sorted(graph.actors):
+        info = graph.actors[aid]
+        for ch in range(info.channels):
+            w = i % n_workers
+            owned[w].setdefault(aid, []).append(ch)
+            i += 1
+    return owned
+
+
+def run_distributed(
+    graph,
+    n_workers: int = 2,
+    timeout: float = 600.0,
+    kill_after_inputs: Optional[Tuple[int, int]] = None,
+    heartbeat_timeout: Optional[float] = None,
+) -> None:
+    """Execute the graph over worker processes; fills blocking datasets.
+    kill_after_inputs=(worker_id, n): SIGKILL that worker once n input seqs
+    exist globally — the kill -9 fault-injection path for tests."""
+    # promote the graph's embedded store (already populated by lowering) to a
+    # served CoordinatorStore: rebind the same table/kv dicts
+    cs = CoordinatorStore()
+    cs.kv = graph.store.kv
+    cs.tables = graph.store.tables
+    graph.store = cs
+    server = serve_store(cs)
+    procs: Dict[int, mp.Process] = {}
+    try:
+        owned = _assign_channels(graph, n_workers)
+        with cs.transaction():
+            for w, per_actor in owned.items():
+                for aid, chs in per_actor.items():
+                    for ch in chs:
+                        cs.tset("CLT", (aid, ch), w)
+        cs.set("expected_workers", n_workers)
+        spec = pickle.dumps(_build_spec(graph))
+        ctx = mp.get_context("spawn")
+        for w in range(n_workers):
+            p = ctx.Process(
+                target=worker_main, args=(spec, server.address, w, owned[w]),
+                daemon=True,
+            )
+            p.start()
+            procs[w] = p
+        _coordinate(graph, cs, procs, owned, timeout, kill_after_inputs,
+                    heartbeat_timeout)
+    finally:
+        cs.set("SHUTDOWN", True)
+        time.sleep(0.05)
+        for p in procs.values():
+            p.join(timeout=10)
+            if p.is_alive():
+                p.terminate()
+        server.close()
+    _drain_results(graph, cs)
+
+
+def _drain_results(graph, cs: CoordinatorStore) -> None:
+    for (actor, channel, seq), ipc in sorted(cs.results.items()):
+        info = graph.actors.get(actor)
+        if info is not None and info.blocking_dataset is not None:
+            info.blocking_dataset.append(channel, ipc_to_table(ipc), seq=seq)
+
+
+def _stage_undone(graph, cs, stage: int) -> bool:
+    for info in graph.actors.values():
+        if info.stage != stage:
+            continue
+        for ch in range(info.channels):
+            if not cs.scontains("DST", (info.id, ch), "done"):
+                return True
+    return False
+
+
+def _all_done(graph, cs) -> bool:
+    for info in graph.actors.values():
+        for ch in range(info.channels):
+            if not cs.scontains("DST", (info.id, ch), "done"):
+                return False
+    return True
+
+
+def _coordinate(graph, cs, procs, owned, timeout, kill_after_inputs,
+                heartbeat_timeout) -> None:
+    stages = sorted({a.stage for a in graph.actors.values()})
+    stage_idx = 0
+    cs.set("STAGE", stages[0])
+    t0 = time.time()
+    started = set()
+    dead: set = set()
+    while True:
+        if time.time() - t0 > timeout:
+            raise TimeoutError("distributed run exceeded timeout")
+        time.sleep(0.05)
+        # merge newly registered worker cache addresses for peers to read
+        addrs = dict(cs.get("worker_addrs") or {})
+        changed = False
+        for w in procs:
+            a = cs.get(f"worker_addr:{w}")
+            if a is not None and addrs.get(w) != tuple(a):
+                addrs[w] = tuple(a)
+                changed = True
+            if w not in started and cs.heartbeats.get(w):
+                started.add(w)
+        if changed:
+            cs.set("worker_addrs", addrs)
+        # fault injection: SIGKILL a worker once enough input seqs exist
+        if kill_after_inputs is not None:
+            wid, n = kill_after_inputs
+            total_inputs = sum(
+                len(v) for k, v in cs.tables["GIT"].items()
+            )
+            if total_inputs >= n and procs[wid].is_alive():
+                os.kill(procs[wid].pid, signal.SIGKILL)
+                kill_after_inputs = None
+        # failure detection: dead process or stale heartbeat
+        now = time.time()
+        for w, p in procs.items():
+            if w in dead:
+                continue
+            err = cs.kv.get(f"worker_error:{w}")
+            if err is not None:
+                raise RuntimeError(f"worker {w} crashed at startup:\n{err}")
+            if not p.is_alive() and w not in started:
+                raise RuntimeError(
+                    f"worker {w} exited (code {p.exitcode}) before its first "
+                    "heartbeat — likely an import/spawn failure; if launching "
+                    "from a script, guard it with if __name__ == '__main__'"
+                )
+            hb = cs.heartbeats.get(w)
+            # stale-heartbeat detection is opt-in: a long jit compile can
+            # legitimately stall heartbeats on a loaded machine; process death
+            # (kill -9, crash) is always detected
+            stale = (
+                heartbeat_timeout is not None
+                and hb is not None
+                and (now - hb) > heartbeat_timeout
+            )
+            if (not p.is_alive() and w in started) or stale:
+                if stale and p.is_alive():
+                    # split-brain guard: a stalled-but-alive worker must die
+                    # BEFORE its channels are reassigned, or both processes
+                    # would execute (and tape) the same channels
+                    p.kill()
+                    p.join(timeout=10)
+                if graph.hbq is None:
+                    raise RuntimeError(
+                        f"worker {w} died and fault_tolerance is not enabled "
+                        "(no HBQ spill to recover from)"
+                    )
+                dead.add(w)
+                self_heal = _recover_worker(graph, cs, w, owned, procs, dead)
+                if not self_heal:
+                    raise RuntimeError(f"worker {w} died and no survivor exists")
+        if _all_done(graph, cs):
+            return
+        while stage_idx < len(stages) - 1 and not _stage_undone(
+            graph, cs, stages[stage_idx]
+        ):
+            stage_idx += 1
+            cs.set("STAGE", stages[stage_idx])
+
+
+def _recover_worker(graph, cs, dead_worker: int, owned, procs, dead) -> bool:
+    """Reassign the dead worker's channels to survivors and trigger adoption
+    (reference: coordinator.py:219-421 recovery barrier, simplified to the
+    single-host case where HBQ spill is on shared disk)."""
+    survivors = [w for w in procs if w not in dead]
+    if not survivors:
+        return False
+    per_actor = owned.get(dead_worker, {})
+    i = 0
+    with cs.transaction():
+        for aid, chs in per_actor.items():
+            for ch in chs:
+                w = survivors[i % len(survivors)]
+                i += 1
+                cs.tset("CLT", (aid, ch), w)
+                owned[w].setdefault(aid, []).append(ch)
+                cs.mailbox_push(w, ("adopt", aid, ch))
+    owned[dead_worker] = {}
+    return True

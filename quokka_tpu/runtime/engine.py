@@ -300,10 +300,16 @@ class Engine:
                     # spill post-partition (core.py:311-313): replayable
                     # without recomputing the producer
                     self.g.hbq.put(name, bridge.device_to_arrow(part))
-                self.cache.put(name, part)
+                self._cache_put(name, part)
                 with self.store.transaction():
                     self.store.sadd("NOT", (actor, channel), name)
                     self.store.tset("PT", name, (actor, channel))
+
+    def _cache_put(self, name: Tuple, part: DeviceBatch) -> None:
+        """Deliver a partition to its consumer channel's cache.  The embedded
+        engine has one cache; the distributed worker overrides this to route
+        by the channel-location table (CLT) over the socket data plane."""
+        self.cache.put(name, part)
 
     # -- input task (core.py:824-965) ----------------------------------------
     def handle_input_task(self, task: TapedInputTask) -> bool:
@@ -387,13 +393,12 @@ class Engine:
                 self.store.tset("EST", (task.actor, task.channel), task.state_seq)
                 self.store.sadd("DST", (task.actor, task.channel), "done")
             return True
-        stages = dict(self.store.titems("AST"))
         plan = self.cache.plan_get(
             task.actor,
             task.channel,
             task.input_reqs,
-            stages,
-            self.store.smembers("SAT"),
+            self._actor_stages(),
+            self._sorted_actors(),
             max_batches=self.max_batches,
         )
         if plan is None:
@@ -425,6 +430,14 @@ class Engine:
         self.store.ntt_push(task.actor, new_task)
         return True
 
+    def _actor_stages(self) -> Dict[int, int]:
+        """AST is write-once at graph build; workers cache it locally instead
+        of a per-task RPC (distributed hot loop)."""
+        return dict(self.store.titems("AST"))
+
+    def _sorted_actors(self):
+        return self.store.smembers("SAT")
+
     # -- fault tolerance ------------------------------------------------------
     def _tape(self, actor: int, ch: int, event) -> None:
         """Record the exec channel's event history (the lineage 'tape'): which
@@ -434,12 +447,7 @@ class Engine:
         TapedExecutorTask discipline, pyquokka/task.py:139, fault-tolerance.md)."""
         if self.g.hbq is None:
             return
-        with self.store.transaction():
-            tape = self.store.tget("LT", ("tape", actor, ch))
-            if tape is None:
-                tape = []
-                self.store.tset("LT", ("tape", actor, ch), tape)
-            tape.append(event)
+        self.store.tappend("LT", ("tape", actor, ch), event)
 
     def _ckpt_file(self, actor: int, ch: int, state_seq: int) -> str:
         return os.path.join(self.g.ckpt_dir, f"ckpt-{actor}-{ch}-{state_seq}.pkl")
@@ -454,12 +462,12 @@ class Engine:
         state = executor.checkpoint()
         with open(self._ckpt_file(task.actor, task.channel, task.state_seq), "wb") as f:
             pickle.dump(state, f)
+        tape_len = self.store.tlen("LT", ("tape", task.actor, task.channel))
         with self.store.transaction():
-            tape = self.store.tget("LT", ("tape", task.actor, task.channel)) or []
             self.store.tset(
                 "LCT",
                 (task.actor, task.channel),
-                (task.state_seq, task.out_seq, len(tape)),
+                (task.state_seq, task.out_seq, tape_len),
             )
             self.store.tset(
                 "IRT",
@@ -477,38 +485,52 @@ class Engine:
         for (a, ch) in failed:
             info = self.g.actors[a]
             assert info.kind == "exec", "simulated failures target exec workers"
-            self.execs[(a, ch)] = info.executor_factory()
             for name in list(self.cache.flights_info()):
                 if name[3] == a and name[5] == ch:
                     self.cache.gc([name])
-            with self.store.transaction():
-                self.store.tables["DST"].pop((a, ch), None)
-            q = self.store.tables["NTT"][a]
-            keep = [t for t in q if not (t.name == "exec" and t.channel == ch)]
-            q.clear()
-            q.extend(keep)
-            lct = self.store.tget("LCT", (a, ch))
-            if lct is not None:
-                state_seq, out_seq, tape_pos = lct
-                with open(self._ckpt_file(a, ch, state_seq), "rb") as f:
-                    self.execs[(a, ch)].restore(pickle.load(f))
-                reqs = {
-                    s: dict(c)
-                    for s, c in self.store.tget("IRT", (a, ch, state_seq)).items()
-                }
+            self._recover_channel(a, ch)
+
+    def _recover_channel(self, a: int, ch: int) -> None:
+        """Rebuild one lost channel: recreate its executor/input task, restore
+        the latest checkpoint, replay the lineage tape, and refill the cache
+        from the HBQ spill.  Shared by the embedded failure simulation and the
+        distributed worker's channel adoption (runtime/worker.py)."""
+        info = self.g.actors[a]
+        self.store.tdel("DST", (a, ch))
+        self.store.ntt_remove_channel(a, ch)
+        if info.kind == "input":
+            # inputs carry no state: re-derive the remaining tape from GIT
+            last = self.store.tget("LIT", (a, ch), -1)
+            done = self.store.smembers("GIT", (a, ch))
+            remaining = [s for s in range(last + 1) if s not in done]
+            if remaining:
+                self.store.ntt_push(a, TapedInputTask(a, ch, remaining))
             else:
-                state_seq, out_seq, tape_pos = 0, 0, 0
-                reqs = {
-                    s: dict(c) for s, c in self.store.tget("IRT", (a, ch, 0)).items()
-                }
-            tape = list(self.store.tget("LT", ("tape", a, ch)) or [])
-            state_seq, out_seq = self._replay_tape(
-                a, ch, tape[tape_pos:], reqs, state_seq, out_seq
-            )
-            with self.store.transaction():
-                self.store.tset("EST", (a, ch), state_seq)
-            self.store.ntt_push(a, ExecutorTask(a, ch, state_seq, out_seq, reqs))
-            self._replay_from_hbq(a, ch, reqs)
+                self.store.sadd("DST", (a, ch), "done")
+            return
+        self.execs[(a, ch)] = info.executor_factory()
+        lct = self.store.tget("LCT", (a, ch))
+        if lct is not None:
+            state_seq, out_seq, tape_pos = lct
+            with open(self._ckpt_file(a, ch, state_seq), "rb") as f:
+                self.execs[(a, ch)].restore(pickle.load(f))
+            reqs = {
+                s: dict(c)
+                for s, c in self.store.tget("IRT", (a, ch, state_seq)).items()
+            }
+        else:
+            state_seq, out_seq, tape_pos = 0, 0, 0
+            reqs = {
+                s: dict(c) for s, c in self.store.tget("IRT", (a, ch, 0)).items()
+            }
+        tape = list(self.store.tget("LT", ("tape", a, ch)) or [])
+        state_seq, out_seq = self._replay_tape(
+            a, ch, tape[tape_pos:], reqs, state_seq, out_seq
+        )
+        with self.store.transaction():
+            self.store.tset("EST", (a, ch), state_seq)
+        self.store.ntt_push(a, ExecutorTask(a, ch, state_seq, out_seq, reqs))
+        self._replay_from_hbq(a, ch, reqs)
 
     def _replay_tape(self, actor: int, ch: int, events, reqs,
                      state_seq: int, out_seq: int):
@@ -563,11 +585,16 @@ class Engine:
                     seq += 1
 
     def _emit(self, info: ActorInfo, channel: int, seq: int, out: DeviceBatch) -> None:
-        if info.blocking_dataset is not None:
-            # seq-keyed so fault-tolerant replay overwrites, never duplicates
-            info.blocking_dataset.append(channel, bridge.device_to_arrow(out), seq=seq)
+        if getattr(info, "blocking", False) or info.blocking_dataset is not None:
+            self._result_append(info, channel, seq, bridge.device_to_arrow(out))
         else:
             self.push(info.id, channel, seq, out)
+
+    def _result_append(self, info: ActorInfo, channel: int, seq: int, table) -> None:
+        """Blocking-node output sink; the distributed worker overrides this to
+        ship result tables to the coordinator.  seq-keyed so fault-tolerant
+        replay overwrites, never duplicates."""
+        info.blocking_dataset.append(channel, table, seq=seq)
 
     # -- coordinator loop (coordinator.py:106-165) ----------------------------
     # Stage discipline follows the reference exactly: INPUT tasks only run when

@@ -1,0 +1,119 @@
+"""Minimal length-prefixed pickle RPC over TCP.
+
+The multi-worker runtime needs two services the reference gets from Redis and
+Arrow Flight (pyquokka/tables.py, flight.py): a served control store and a
+per-worker batch data plane.  Both are method-call shaped, so one tiny RPC
+layer serves them: each request is (method_name, args) pickled with a 4-byte
+length prefix; each response is (ok, value_or_exception).
+
+Single-host localhost trust model (same as the reference's unauthenticated
+Redis/Flight inside a cluster).  Threaded server: one thread per connection,
+so a blocking call from one worker never stalls another's.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+from typing import Any, Tuple
+
+_LEN = struct.Struct(">I")
+
+
+def _send_msg(sock: socket.socket, obj: Any) -> None:
+    data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _recv_msg(sock: socket.socket) -> Any:
+    (n,) = _LEN.unpack(_recv_exact(sock, 4))
+    return pickle.loads(_recv_exact(sock, n))
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        target = self.server.target  # type: ignore[attr-defined]
+        while True:
+            try:
+                method, args = _recv_msg(self.request)
+            except (ConnectionError, EOFError):
+                return
+            try:
+                if method == "__multi__":
+                    # atomic batch (transaction): applied under one lock hold
+                    with target._lock:
+                        out = [getattr(target, m)(*a) for m, a in args]
+                else:
+                    out = getattr(target, method)(*args)
+                _send_msg(self.request, (True, out))
+            except Exception as e:  # noqa: BLE001 — ship the error to the caller
+                try:
+                    _send_msg(self.request, (False, e))
+                except Exception:
+                    return
+
+
+class RpcServer:
+    """Serve an object's methods.  The object must expose a `_lock` (RLock)
+    for `__multi__` atomic batches."""
+
+    def __init__(self, target: Any, host: str = "127.0.0.1", port: int = 0):
+        class _Srv(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._srv = _Srv((host, port), _Handler)
+        self._srv.target = target  # type: ignore[attr-defined]
+        self.address: Tuple[str, int] = self._srv.server_address
+        self._thread = threading.Thread(target=self._srv.serve_forever, daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+class RpcClient:
+    """One persistent connection; thread-safe via a per-client lock."""
+
+    def __init__(self, address: Tuple[str, int], timeout: float = 120.0):
+        self.address = tuple(address)
+        self._sock = socket.create_connection(self.address, timeout=timeout)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._lock = threading.Lock()
+
+    def call(self, method: str, *args):
+        with self._lock:
+            _send_msg(self._sock, (method, args))
+            ok, out = _recv_msg(self._sock)
+        if not ok:
+            raise out
+        return out
+
+    def call_multi(self, calls):
+        """[(method, args), ...] applied atomically server-side."""
+        with self._lock:
+            _send_msg(self._sock, ("__multi__", list(calls)))
+            ok, out = _recv_msg(self._sock)
+        if not ok:
+            raise out
+        return out
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except Exception:
+            pass

@@ -1,0 +1,103 @@
+"""Served ControlStore: the multi-process control plane.
+
+The coordinator serves the SAME embedded ControlStore the single-process
+engine uses (runtime/tables.py keeps the reference's 17-table taxonomy,
+pyquokka/tables.py); workers talk to it through ControlStoreClient, which
+implements the identical method surface over runtime/rpc.py — so
+runtime/engine.py's scheduling/recovery logic runs unchanged on either side.
+
+Coordinator extras carried on the same connection:
+- result_append / results: blocking-node outputs ship to the coordinator as
+  Arrow IPC bytes (the reference's Dataset actor, quokka_dataset.py:7)
+- heartbeat / heartbeats: worker liveness for failure detection
+  (coordinator.py:131-205)
+- control messages: per-worker mailboxes (channel adoption on recovery)
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from typing import Dict, List, Optional, Tuple
+
+from quokka_tpu.runtime.rpc import RpcClient, RpcServer
+from quokka_tpu.runtime.tables import ControlStore
+
+
+class CoordinatorStore(ControlStore):
+    """ControlStore + coordinator-side mailboxes (served by RpcServer)."""
+
+    def __init__(self):
+        super().__init__()
+        self.results: Dict[Tuple[int, int, int], bytes] = {}  # (actor,ch,seq)
+        self.heartbeats: Dict[int, float] = {}
+        self.mailboxes: Dict[int, List] = {}
+
+    def result_append(self, actor: int, channel: int, seq: int, ipc: bytes):
+        with self._lock:
+            self.results[(actor, channel, seq)] = ipc
+
+    def heartbeat(self, worker_id: int):
+        with self._lock:
+            self.heartbeats[worker_id] = time.time()
+
+    def mailbox_push(self, worker_id: int, msg):
+        with self._lock:
+            self.mailboxes.setdefault(worker_id, []).append(msg)
+
+    def mailbox_drain(self, worker_id: int) -> List:
+        with self._lock:
+            out = self.mailboxes.get(worker_id, [])
+            self.mailboxes[worker_id] = []
+            return out
+
+
+def serve_store(store: CoordinatorStore) -> RpcServer:
+    return RpcServer(store)
+
+
+class ControlStoreClient:
+    """ControlStore interface over RPC.  Reads pass through immediately;
+    transaction() batches WRITES and flushes them atomically on exit — safe
+    under the engine's single-writer-per-channel discipline (each channel's
+    rows are only written by the worker that owns it)."""
+
+    _WRITES = {
+        "set", "ntt_push", "tset", "tappend", "tdel", "sadd",
+        "ntt_remove_exec", "result_append", "heartbeat", "mailbox_push",
+    }
+
+    def __init__(self, address: Tuple[str, int]):
+        self._rpc = RpcClient(address)
+        self._txn: Optional[List] = None
+
+    @contextmanager
+    def transaction(self):
+        if self._txn is not None:  # nested: join the outer batch
+            yield self
+            return
+        self._txn = []
+        try:
+            yield self
+        finally:
+            calls, self._txn = self._txn, None
+            if calls:
+                self._rpc.call_multi(calls)
+
+    def _call(self, method: str, *args):
+        if self._txn is not None and method in self._WRITES:
+            self._txn.append((method, args))
+            return None
+        return self._rpc.call(method, *args)
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+
+        def method(*args):
+            return self._call(name, *args)
+
+        return method
+
+    def close(self):
+        self._rpc.close()

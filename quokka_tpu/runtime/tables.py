@@ -79,10 +79,38 @@ class ControlStore:
         with self._lock:
             self.tables["NTT"][node].append(task)
 
-    def ntt_pop(self, node: Tuple):
+    def ntt_pop(self, node: Tuple, channels: Optional[List[int]] = None):
+        """Pop the next task for `node`; with `channels`, only a task whose
+        channel is in the set (multi-worker: each worker owns channels)."""
         with self._lock:
             q = self.tables["NTT"][node]
-            return q.popleft() if q else None
+            if not q:
+                return None
+            if channels is None:
+                return q.popleft()
+            chans = set(channels)
+            for i, t in enumerate(q):
+                if t.channel in chans:
+                    del q[i]
+                    return t
+            return None
+
+    def ntt_remove_exec(self, node: Tuple, channel: int) -> None:
+        """Drop queued exec tasks of one channel (failure recovery)."""
+        with self._lock:
+            q = self.tables["NTT"][node]
+            keep = [t for t in q if not (t.name == "exec" and t.channel == channel)]
+            q.clear()
+            q.extend(keep)
+
+    def ntt_remove_channel(self, node: Tuple, channel: int) -> None:
+        """Drop EVERY queued task of one channel — adoption replaces them with
+        rebuilt tasks; stale queued duplicates would double-execute."""
+        with self._lock:
+            q = self.tables["NTT"][node]
+            keep = [t for t in q if t.channel != channel]
+            q.clear()
+            q.extend(keep)
 
     def ntt_peek_all(self, node: Tuple) -> List:
         with self._lock:
@@ -108,6 +136,24 @@ class ControlStore:
     def titems(self, table: str):
         with self._lock:
             return list(self.tables[table].items())
+
+    def tappend(self, table: str, key, value):
+        """Append to a list-valued entry (creating it) — replaces the
+        read-modify-write pattern, which a served store cannot support."""
+        with self._lock:
+            t = self.tables[table]
+            if key not in t:
+                t[key] = []
+            t[key].append(value)
+
+    def tlen(self, table: str, key) -> int:
+        with self._lock:
+            v = self.tables[table].get(key)
+            return 0 if v is None else len(v)
+
+    def tdel(self, table: str, key) -> None:
+        with self._lock:
+            self.tables[table].pop(key, None)
 
     # -- set-valued tables ---------------------------------------------------
     def sadd(self, table: str, key, value=None):

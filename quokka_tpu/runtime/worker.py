@@ -1,0 +1,232 @@
+"""Worker process: runs a subset of the TaskGraph's channels.
+
+The reference spreads channels across Ray TaskManager actors
+(pyquokka/core.py:54-151); here each worker process owns a set of
+(actor, channel) pairs, reuses the embedded Engine's task handlers verbatim
+against a ControlStoreClient, keeps a LOCAL BatchCache served over the socket
+data plane, and routes pushes by the channel-location table (CLT).
+
+Recovery: on a peer's death the coordinator mails surviving workers
+("adopt", actor, channel) messages; the adopter replays checkpoint + tape +
+HBQ with the same Engine recovery code the embedded runtime uses.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, List, Optional, Tuple
+
+
+from quokka_tpu.runtime.cache import BatchCache
+from quokka_tpu.runtime.dataplane import DataPlaneClient, serve_cache, table_to_ipc
+from quokka_tpu.runtime.engine import ActorInfo, Engine
+from quokka_tpu.runtime.store_service import ControlStoreClient
+
+
+
+class WorkerGraph:
+    """Duck-typed TaskGraph for Engine: store client + local cache + actors."""
+
+    def __init__(self, store, cache, actors, exec_config, hbq, ckpt_dir):
+        self.store = store
+        self.cache = cache
+        self.actors = actors
+        self.exec_config = exec_config
+        self.hbq = hbq
+        self.ckpt_dir = ckpt_dir
+
+
+def _actors_from_spec(spec: Dict) -> Dict[int, ActorInfo]:
+    actors = {}
+    for aid, d in spec["actors"].items():
+        info = ActorInfo(aid, d["kind"], d["channels"], d["stage"], d["sorted_actor"])
+        info.reader = d["reader"]
+        info.executor_factory = d["factory"]
+        info.targets = d["targets"]
+        info.source_streams = d["source_streams"]
+        info.sorted_by = d["sorted_by"]
+        info.predicate = d["predicate"]
+        info.projection = d["projection"]
+        info.blocking = d["blocking"]
+        info.blocking_dataset = None
+        actors[aid] = info
+    return actors
+
+
+class Worker(Engine):
+    def __init__(self, spec: Dict, store, cache: BatchCache, worker_id: int,
+                 owned: Dict[int, List[int]]):
+        actors = _actors_from_spec(spec)
+        hbq = None
+        if spec["hbq_path"]:
+            from quokka_tpu.runtime.hbq import HBQ
+
+            hbq = HBQ(spec["hbq_path"])
+        g = WorkerGraph(store, cache, actors, spec["exec_config"], hbq,
+                        spec["ckpt_dir"])
+        self.worker_id = worker_id
+        self.owned = {a: set(chs) for a, chs in owned.items()}
+        self._peers: Dict[int, DataPlaneClient] = {}
+        self._peer_addrs: Dict[int, Tuple[str, int]] = {}
+        self._clt: Dict[Tuple[int, int], int] = {}
+        # Engine.__init__ builds every exec channel; do it owned-only
+        self.g = g
+        self.store = store
+        self.cache = cache
+        self.max_batches = g.exec_config.get("max_pipeline_batches", 8)
+        self.execs = {}
+        self._partition_fns = {}
+        for info in actors.values():
+            if info.kind == "exec":
+                for ch in self.owned.get(info.id, ()):
+                    self.execs[(info.id, ch)] = info.executor_factory()
+        # AST/SAT are write-once at graph build: snapshot from the spec so the
+        # scheduling hot loop never round-trips them through the store
+        self._stages_cache = {a.id: a.stage for a in actors.values()}
+        self._sorted_cache = {a.id for a in actors.values() if a.sorted_actor}
+
+    def _actor_stages(self):
+        return self._stages_cache
+
+    def _sorted_actors(self):
+        return self._sorted_cache
+
+    # -- routing --------------------------------------------------------------
+    def _refresh_clt(self):
+        self._clt = dict(self.store.titems("CLT"))
+
+    def _peer(self, worker_id: int) -> DataPlaneClient:
+        cli = self._peers.get(worker_id)
+        if cli is None:
+            addr = self._peer_addrs.get(worker_id)
+            if addr is None:
+                self._peer_addrs = dict(self.store.get("worker_addrs") or {})
+                addr = self._peer_addrs[worker_id]
+            cli = self._peers[worker_id] = DataPlaneClient(addr)
+        return cli
+
+    def _cache_put(self, name, part):
+        tgt = (name[3], name[5])
+        deadline = time.time() + 30
+        while True:
+            owner = self._clt.get(tgt)
+            if owner is None:
+                self._refresh_clt()
+                owner = self._clt[tgt]
+            if owner == self.worker_id:
+                self.cache.put(name, part)
+                return
+            try:
+                self._peer(owner).put(name, part, part.sorted_by)
+                return
+            except (ConnectionError, OSError):
+                # peer died mid-push: drop the stale client and wait for the
+                # coordinator to repoint the channel in CLT
+                self._peers.pop(owner, None)
+                if time.time() > deadline:
+                    raise
+                time.sleep(0.2)
+                self._refresh_clt()
+
+    def _result_append(self, info, channel, seq, table):
+        self.store.result_append(info.id, channel, seq, table_to_ipc(table))
+
+    # -- recovery adoption ----------------------------------------------------
+    def _adopt(self, actor: int, channel: int):
+        """Take over a failed peer's channel: the shared Engine recovery path
+        (checkpoint + tape + HBQ replay) against this worker's local cache."""
+        self.owned.setdefault(actor, set()).add(channel)
+        self._recover_channel(actor, channel)
+
+    # -- main loop ------------------------------------------------------------
+    def run_worker(self, heartbeat_every: float = 0.2):
+        # startup barrier: wait until every worker's data-plane address is
+        # registered, or the first push to a late-starting peer would fail
+        expected = self.store.get("expected_workers")
+        t0 = time.time()
+        while expected:
+            addrs = self.store.get("worker_addrs") or {}
+            if len(addrs) >= expected:
+                self._peer_addrs = {int(k): tuple(v) for k, v in addrs.items()}
+                break
+            if self.store.get("SHUTDOWN"):
+                return
+            if time.time() - t0 > 120:
+                raise TimeoutError("peer workers never registered")
+            self.store.heartbeat(self.worker_id)
+            time.sleep(0.05)
+        last_hb = 0.0
+        actors = sorted(self.g.actors.values(), key=lambda a: (a.stage, a.id))
+        while True:
+            now = time.time()
+            if now - last_hb >= heartbeat_every:
+                self.store.heartbeat(self.worker_id)
+                last_hb = now
+            for msg in self.store.mailbox_drain(self.worker_id):
+                if msg[0] == "adopt":
+                    self._refresh_clt()
+                    self._adopt(msg[1], msg[2])
+            if self.store.get("SHUTDOWN"):
+                return
+            stage = self.store.get("STAGE", 0)
+            progress = False
+            for info in actors:
+                chans = self.owned.get(info.id)
+                if not chans:
+                    continue
+                if info.kind == "input" and info.stage > stage:
+                    continue
+                task = self.store.ntt_pop(info.id, list(chans))
+                if task is None:
+                    continue
+                if task.name == "input":
+                    progress |= self.handle_input_task(task)
+                else:
+                    progress |= self.handle_exec_task(task)
+            if not progress:
+                time.sleep(0.01)
+
+
+def worker_main(spec_bytes: bytes, store_addr, worker_id: int, owned):
+    """Spawn entry point (module-level for multiprocessing spawn)."""
+    # honor a CPU platform request before any backend init (the axon
+    # sitecustomize would otherwise force the TPU platform)
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+    import pickle
+
+    spec = pickle.loads(spec_bytes)
+    if spec.get("x64"):
+        import jax
+
+        jax.config.update("jax_enable_x64", True)
+    store = ControlStoreClient(tuple(store_addr))
+    try:
+        cache = BatchCache()
+        server = serve_cache(cache)
+        store.set(f"worker_addr:{worker_id}", server.address)
+        # the coordinator merges individual keys into 'worker_addrs' itself
+        store.heartbeat(worker_id)
+        w = Worker(spec, store, cache, worker_id, owned)
+        try:
+            w.run_worker()
+        finally:
+            server.close()
+    except Exception:
+        import traceback
+
+        # ship the traceback to the coordinator — a spawned child's stderr is
+        # otherwise invisible and the run would stall until timeout
+        try:
+            store.set(f"worker_error:{worker_id}", traceback.format_exc())
+        except Exception:
+            pass
+        raise
+    finally:
+        store.close()

@@ -16,12 +16,17 @@ from typing import List, Optional
 
 
 class LocalCluster:
-    """Single-host execution: all channels share this process and one
-    accelerator (or the virtual CPU mesh)."""
+    """Single-host execution.  n_workers == 0: all channels run in this
+    process (embedded engine).  n_workers >= 1: channels spread over that many
+    spawned worker processes with a served ControlStore and socket data plane
+    (runtime/distributed.py) — the reference's multi-TaskManager deployment on
+    one machine (pyquokka/utils.py:96 LocalCluster + core.py TaskManagers)."""
 
-    def __init__(self, io_per_node: int = 2, exec_per_node: int = 2):
+    def __init__(self, io_per_node: int = 2, exec_per_node: int = 2,
+                 n_workers: int = 0):
         self.io_per_node = io_per_node
         self.exec_per_node = exec_per_node
+        self.n_workers = n_workers
         self.leader_ip = "127.0.0.1"
 
     @property

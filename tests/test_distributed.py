@@ -1,0 +1,106 @@
+"""Multi-process runtime: served ControlStore + spawned workers + socket data
+plane (VERDICT r1 item 3).  Queries must produce the same results as the
+embedded engine, and a kill -9'd worker must be detected by the coordinator
+and its channels adopted by the survivor with checkpoint+tape+HBQ recovery."""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from quokka_tpu import QuokkaContext
+from quokka_tpu.utils.cluster import LocalCluster
+
+
+def make_data(seed=0, n=20000):
+    r = np.random.default_rng(seed)
+    fact = pa.table(
+        {
+            "k": r.integers(0, 200, n).astype(np.int64),
+            "s": np.array(["a", "b", "c", "d"])[r.integers(0, 4, n)],
+            "v": r.uniform(0, 10, n).round(4),
+        }
+    )
+    dim = pa.table(
+        {
+            "k": np.arange(200, dtype=np.int64),
+            "grp": np.array(["X", "Y"])[np.arange(200) % 2],
+        }
+    )
+    return fact, dim
+
+
+def q1_shape(ctx, fact):
+    return (
+        ctx.from_arrow(fact)
+        .filter_sql("v > 2")
+        .groupby("s")
+        .agg_sql("sum(v) as sv, count(*) as n, avg(v) as av")
+        .collect()
+        .sort_values("s")
+        .reset_index(drop=True)
+    )
+
+
+def q3_shape(ctx, fact, dim):
+    return (
+        ctx.from_arrow(fact)
+        .join(ctx.from_arrow(dim), on="k")
+        .filter_sql("v < 9")
+        .groupby("grp")
+        .agg_sql("sum(v) as sv, count(*) as n")
+        .collect()
+        .sort_values("grp")
+        .reset_index(drop=True)
+    )
+
+
+class TestTwoWorkers:
+    def test_groupby_matches_embedded(self):
+        fact, dim = make_data()
+        got = q1_shape(QuokkaContext(cluster=LocalCluster(n_workers=2)), fact)
+        exp = q1_shape(QuokkaContext(), fact)
+        pd.testing.assert_frame_equal(got, exp, check_dtype=False)
+
+    def test_join_matches_embedded(self):
+        fact, dim = make_data(seed=1)
+        got = q3_shape(QuokkaContext(cluster=LocalCluster(n_workers=2)), fact, dim)
+        exp = q3_shape(QuokkaContext(), fact, dim)
+        pd.testing.assert_frame_equal(got, exp, check_dtype=False)
+
+
+class TestKill9Recovery:
+    def test_kill_worker_mid_run(self, tmp_path):
+        import pyarrow.parquet as pq
+
+        fact, dim = make_data(seed=2)
+        fp, dp = str(tmp_path / "fact.parquet"), str(tmp_path / "dim.parquet")
+        # small row groups -> many input batches, so the SIGKILL lands while
+        # the stream is genuinely mid-flight
+        pq.write_table(fact, fp, row_group_size=1024)
+        pq.write_table(dim, dp)
+
+        def q(ctx):
+            return (
+                ctx.read_parquet(fp)
+                .join(ctx.read_parquet(dp), on="k")
+                .filter_sql("v < 9")
+                .groupby("grp")
+                .agg_sql("sum(v) as sv, count(*) as n")
+                .collect()
+                .sort_values("grp")
+                .reset_index(drop=True)
+            )
+
+        ctx = QuokkaContext(
+            cluster=LocalCluster(n_workers=2),
+            exec_config={
+                "fault_tolerance": True,
+                "checkpoint_interval": 2,
+                # SIGKILL worker 1 once 6 input seqs have been produced
+                "inject_kill_worker": (1, 6),
+            },
+        )
+        got = q(ctx)
+        exp = q(QuokkaContext())
+        pd.testing.assert_frame_equal(got, exp, check_dtype=False)
