@@ -92,3 +92,16 @@ DEFAULT_EXEC_CONFIG = {
     "max_pipeline": 4,
     "batch_attempt": 4,
 }
+
+
+# ---------------------------------------------------------------------------
+# Spill tier (external sort / grace join) — reference sql_executors.py:88-188
+# (SuperFastSortExecutor) and 456-515 (DiskBuildProbeJoinExecutor).
+# Thresholds are ROWS accumulated before an operator switches to disk; the
+# defaults keep small queries fully in memory.  Tests lower them to force the
+# spill paths on tiny data.
+SPILL_SORT_ROWS = int(os.environ.get("QUOKKA_TPU_SPILL_SORT_ROWS", 1 << 22))
+SPILL_MERGE_CHUNK_ROWS = int(os.environ.get("QUOKKA_TPU_SPILL_CHUNK_ROWS", 1 << 16))
+SPILL_JOIN_BUILD_ROWS = int(os.environ.get("QUOKKA_TPU_SPILL_JOIN_ROWS", 1 << 22))
+SPILL_JOIN_FANOUT = int(os.environ.get("QUOKKA_TPU_SPILL_JOIN_FANOUT", 8))
+SPILL_DIR = os.environ.get("QUOKKA_TPU_SPILL_DIR", "/tmp/quokka_tpu_spill")

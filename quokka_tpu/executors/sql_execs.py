@@ -47,6 +47,39 @@ class CountExecutor(Executor):
         return bridge.arrow_to_device(pa.table({"count": [self.count]}))
 
 
+
+
+# ---------------------------------------------------------------------------
+# spill-directory registry: executors that never reach done() (failed query,
+# killed worker) must not leak dirs under config.SPILL_DIR forever
+_SPILL_DIRS: set = set()
+
+
+def _new_spill_dir(prefix: str) -> str:
+    import atexit
+    import os
+    import tempfile
+
+    os.makedirs(config.SPILL_DIR, exist_ok=True)
+    if not _SPILL_DIRS:
+        atexit.register(_purge_spill_dirs)
+    d = tempfile.mkdtemp(prefix=prefix, dir=config.SPILL_DIR)
+    _SPILL_DIRS.add(d)
+    return d
+
+
+def _drop_spill_dir(d: str) -> None:
+    import shutil
+
+    shutil.rmtree(d, ignore_errors=True)
+    _SPILL_DIRS.discard(d)
+
+
+def _purge_spill_dirs() -> None:
+    for d in list(_SPILL_DIRS):
+        _drop_spill_dir(d)
+
+
 class StorageExecutor(Executor):
     """Pass batches through unchanged (terminal collect node)."""
 
@@ -236,6 +269,17 @@ class BuildProbeJoinExecutor(Executor):
         self.build_unique: Optional[bool] = None
         self.payload: Optional[List[str]] = None
         self.rename: Dict[str, str] = {}
+        # grace-join spill tier (DiskBuildProbeJoinExecutor,
+        # sql_executors.py:456-515): past SPILL_JOIN_BUILD_ROWS accumulated
+        # build rows, both sides hash-partition to disk and done() joins
+        # partition-by-partition in bounded memory
+        self.spill_rows = config.SPILL_JOIN_BUILD_ROWS
+        self.fanout = config.SPILL_JOIN_FANOUT
+        self._disk = False
+        self._build_rows = 0
+        self._spill_dir: Optional[str] = None
+        self._writers: Dict[Tuple[str, int], object] = {}
+        self._files: Dict[Tuple[str, int], str] = {}
 
     def _finalize_build(self, probe_cols: List[str]):
         if not self.build_parts:
@@ -266,7 +310,18 @@ class BuildProbeJoinExecutor(Executor):
             return None
         if stream_id == 1:
             assert self.build is None, "build batch arrived after probing began"
+            if self._disk:
+                for b in live:
+                    self._spill(b, "build", self.right_on)
+                return None
             self.build_parts.extend(live)
+            self._build_rows += sum(b.count_valid() for b in live)
+            if self._build_rows > self.spill_rows:
+                self._enter_disk_mode()
+            return None
+        if self._disk:
+            for b in live:
+                self._spill(b, "probe", self.left_on)
             return None
         # probe: if the build stream hasn't been declared exhausted yet
         # (stage-tie cases like self-joins), buffer and flush on source_done
@@ -275,13 +330,98 @@ class BuildProbeJoinExecutor(Executor):
             return None
         return self._probe(live)
 
+    # -- grace-join spill tier -------------------------------------------------
+    def _enter_disk_mode(self):
+        self._disk = True
+        # interval checkpoints can't capture on-disk partition state cheaply;
+        # recovery falls back to full lineage-tape replay (deterministic)
+        self.SUPPORTS_CHECKPOINT = False
+        parts, self.build_parts = self.build_parts, []
+        self._build_rows = 0
+        for b in parts:
+            self._spill(b, "build", self.right_on)
+        # stage-tie probes buffered before build completion spill too
+        buffered, self.probe_buffer = self.probe_buffer, []
+        for b in buffered:
+            self._spill(b, "probe", self.left_on)
+
+    def _spill(self, batch: DeviceBatch, side: str, keys) -> None:
+        import os
+        import tempfile
+
+        import pyarrow as pa
+
+        if self._spill_dir is None:
+            self._spill_dir = _new_spill_dir("join-")
+        pids = kernels.partition_ids(batch, list(keys), self.fanout)
+        for p, part in enumerate(kernels.split_by_partition(batch, pids, self.fanout)):
+            if part.count_valid() == 0:
+                continue
+            table = bridge.device_to_arrow(part)
+            key = (side, p)
+            w = self._writers.get(key)
+            if w is None:
+                path = os.path.join(self._spill_dir, f"{side}-{p}.arrow")
+                self._files[key] = path
+                sink = pa.OSFile(path, "wb")
+                w = pa.ipc.new_file(sink, table.schema)
+                self._writers[key] = (w, sink)
+            self._writers[key][0].write_table(table)
+
+    def _disk_join(self):
+        import pyarrow as pa
+
+        for w, sink in self._writers.values():
+            w.close()
+            sink.close()
+        self._writers = {}
+        try:
+            for p in range(self.fanout):
+                probe_path = self._files.get(("probe", p))
+                if probe_path is None:
+                    continue  # no probe rows in this partition -> no output
+                build_path = self._files.get(("build", p))
+                inner = BuildProbeJoinExecutor(
+                    self.left_on, self.right_on, self.how, self.suffix,
+                    rename=self.planned_rename, out_schema=self.out_schema,
+                )
+                inner.build_done = True
+                if build_path is not None:
+                    with pa.ipc.open_file(build_path) as r:
+                        inner.build_parts = [
+                            bridge.arrow_to_device(
+                                pa.Table.from_batches([r.get_batch(i)])
+                            )
+                            for i in range(r.num_record_batches)
+                        ]
+                with pa.ipc.open_file(probe_path) as r:
+                    for i in range(r.num_record_batches):
+                        chunk = bridge.arrow_to_device(
+                            pa.Table.from_batches([r.get_batch(i)])
+                        )
+                        o = inner._probe([chunk])
+                        if o is not None and o.count_valid() > 0:
+                            yield o
+        finally:
+            if self._spill_dir is not None:
+                _drop_spill_dir(self._spill_dir)
+
     def source_done(self, stream_id, channel):
         if stream_id != 1 or self.build_done:
             return None
         self.build_done = True
         buffered, self.probe_buffer = self.probe_buffer, []
+        if self._disk:
+            for b in buffered:
+                self._spill(b, "probe", self.left_on)
+            return None
         if buffered:
             return self._probe(buffered)
+        return None
+
+    def done(self, channel):
+        if self._disk:
+            return self._disk_join()
         return None
 
     def _probe(self, live):
@@ -437,20 +577,149 @@ class TopKExecutor(Executor):
 
 
 class SortExecutor(Executor):
-    """Blocking sort: accumulate, sort once at done.  (External merge-sort
-    with spill, as in SuperFastSortExecutor, is a later tier.)"""
+    """Blocking sort with an external-merge spill tier.
 
-    def __init__(self, by: List[str], descending: List[bool]):
+    Small inputs: accumulate and sort once at done (the original path).
+    Past config.SPILL_SORT_ROWS accumulated rows, each bucket is sorted on
+    device and written to disk as a sorted RUN (Arrow IPC, chunked); done()
+    k-way-merges the runs in bounded memory and emits a LIST of batches.
+    Reference: SuperFastSortExecutor, sql_executors.py:88-188 — same
+    sorted-run + merge design, with the device doing every sort.
+
+    Merge invariant: after device-sorting the in-memory buffers, every row at
+    or before the FIRST buffer-tail row (the min over live runs of each run's
+    last buffered row) is globally final — later chunks of every run sort
+    after their run's tail.  Rows are tagged (__run, __pos) so that boundary
+    is found by identity, not by re-comparing keys on the host."""
+
+    def __init__(self, by: List[str], descending: List[bool],
+                 spill_rows: Optional[int] = None,
+                 chunk_rows: Optional[int] = None):
         self.by = by
         self.descending = descending
         self.parts: List[DeviceBatch] = []
+        self.rows = 0
+        self.spill_rows = spill_rows or config.SPILL_SORT_ROWS
+        self.chunk_rows = chunk_rows or config.SPILL_MERGE_CHUNK_ROWS
+        self.runs: List[str] = []
+        self._dir: Optional[str] = None
 
     def execute(self, batches, stream_id, channel):
-        self.parts.extend(b for b in batches if b is not None)
+        for b in batches:
+            if b is None:
+                continue
+            self.parts.append(b)
+            self.rows += b.count_valid()
+        if self.rows >= self.spill_rows:
+            self._spill_run()
+
+    def _spill_run(self):
+        import os
+        import tempfile
+
+        import pyarrow as pa
+
+        if not self.parts:
+            return
+        if self._dir is None:
+            self._dir = _new_spill_dir("sort-")
+        merged = bridge.concat_batches(self.parts) if len(self.parts) > 1 else self.parts[0]
+        s = kernels.sort_batch(merged, self.by, self.descending)
+        table = bridge.device_to_arrow(s)
+        path = os.path.join(self._dir, f"run-{len(self.runs)}.arrow")
+        with pa.OSFile(path, "wb") as f:
+            with pa.ipc.new_file(f, table.schema) as w:
+                w.write_table(table, max_chunksize=self.chunk_rows)
+        self.runs.append(path)
+        self.parts = []
+        self.rows = 0
 
     def done(self, channel):
-        if not self.parts:
-            return None
-        merged = bridge.concat_batches(self.parts) if len(self.parts) > 1 else self.parts[0]
-        self.parts = []
-        return kernels.sort_batch(merged, self.by, self.descending)
+        if not self.runs:
+            if not self.parts:
+                return None
+            merged = bridge.concat_batches(self.parts) if len(self.parts) > 1 else self.parts[0]
+            self.parts = []
+            return kernels.sort_batch(merged, self.by, self.descending)
+        self._spill_run()
+        return self._merge_and_cleanup()
+
+    def _merge_and_cleanup(self):
+        try:
+            yield from self._merge_runs()
+        finally:
+            _drop_spill_dir(self._dir)
+
+    def _merge_runs(self):
+        import jax.numpy as jnp
+        import numpy as np
+        import pyarrow as pa
+
+        from quokka_tpu.ops.batch import NumCol
+
+        readers = [pa.ipc.open_file(p) for p in self.runs]
+        n_chunks = [r.num_record_batches for r in readers]
+        next_chunk = [0] * len(readers)
+        next_pos = [0] * len(readers)
+        buffers: List[Optional[DeviceBatch]] = [None] * len(readers)
+        # bounds[i]: (run, pos) tag of run i's last READ row.  While set, no
+        # row sorting after it may be emitted (unread rows of run i all sort
+        # after it).  None <=> the run is fully read AND its tail was emitted.
+        bounds: List[Optional[Tuple[int, int]]] = [None] * len(readers)
+        carry: Optional[DeviceBatch] = None
+
+        def load(i) -> None:
+            if next_chunk[i] >= n_chunks[i]:
+                bounds[i] = None  # exhausted
+                return
+            rb = readers[i].get_batch(next_chunk[i])
+            next_chunk[i] += 1
+            t = pa.Table.from_batches([rb])
+            b = bridge.arrow_to_device(t)
+            n = b.padded_len
+            b = b.with_column("__run", NumCol(jnp.full(n, i, dtype=jnp.int32), "i"))
+            b = b.with_column(
+                "__pos",
+                NumCol(jnp.arange(next_pos[i], next_pos[i] + n, dtype=jnp.int32), "i"),
+            )
+            next_pos[i] += t.num_rows
+            bounds[i] = (i, next_pos[i] - 1)
+            buffers[i] = b
+
+        for i in range(len(readers)):
+            load(i)
+        while True:
+            parts = [b for b in buffers if b is not None]
+            if carry is not None and carry.count_valid() > 0:
+                parts.append(carry)
+            if not parts:
+                break
+            merged = bridge.concat_batches(parts) if len(parts) > 1 else parts[0]
+            s = kernels.sort_batch(merged, self.by, self.descending)
+            nvalid = s.count_valid()
+            run_arr = np.asarray(s.columns["__run"].data)[:nvalid]
+            pos_arr = np.asarray(s.columns["__pos"].data)[:nvalid]
+            pending = [b for b in bounds if b is not None]
+            if pending:
+                cut = min(
+                    int(np.nonzero((run_arr == r) & (pos_arr == p))[0][0])
+                    for (r, p) in pending
+                ) + 1
+            else:
+                cut = nvalid
+            yield kernels.head(s, cut).drop(["__run", "__pos"])
+            rest_mask = s.valid & (jnp.arange(s.padded_len) >= cut)
+            rest = kernels.compact(kernels.apply_mask(s, rest_mask))
+            carry = rest if rest.count_valid() > 0 else None
+            # all buffered rows now live in carry (or were emitted); reload
+            # any run whose tail row was emitted — only then can its next
+            # chunk contribute to the frontier
+            emitted_runs = {int(r) for r in run_arr[:cut]}
+            for i in range(len(readers)):
+                buffers[i] = None
+                if bounds[i] is not None and bounds[i][0] in emitted_runs:
+                    r, p = bounds[i]
+                    if (run_arr[:cut] == r).any() and (
+                        pos_arr[:cut][run_arr[:cut] == r].max() >= p
+                    ):
+                        load(i)

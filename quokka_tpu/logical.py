@@ -161,11 +161,20 @@ class MapNode(Node):
         super().__init__(parents, schema)
         self.fn = fn
         self.exprs = exprs
+        self.folded = False  # set by optimizer.fold_maps: ride the edge
 
     def lower(self, ctx, graph, actor_of, node_id):
         from quokka_tpu.executors.sql_execs import UDFExecutor
 
         fn = self.fn
+        if self.folded:
+            # no actor: the map becomes a batch_func on every edge leaving
+            # the parent's actor (optimizer.fold_maps guarantees this node is
+            # the parent's only consumer)
+            src = actor_of[self.parents[0]]
+            actor_of[node_id] = src
+            graph.add_pending_batch_fn(src, fn)
+            return
         actor_of[node_id] = graph.new_exec_node(
             functools.partial(UDFExecutor, fn),
             {0: (actor_of[self.parents[0]], _passthrough_edge())},
@@ -175,9 +184,10 @@ class MapNode(Node):
         )
 
     def describe(self):
+        label = "FoldedMap" if self.folded else "Map"
         if self.exprs:
-            return "Map(" + ", ".join(f"{k}={v.sql()}" for k, v in self.exprs.items()) + ")"
-        return "Map(udf)"
+            return f"{label}(" + ", ".join(f"{k}={v.sql()}" for k, v in self.exprs.items()) + ")"
+        return f"{label}(udf)"
 
 
 class StatefulNode(Node):
@@ -385,9 +395,10 @@ class SortNode(Node):
                 {0: (actor_of[self.parents[0]], edge)},
                 n,
                 self.stage,
-                # consumers must read channel 0's range before channel 1's:
-                # SAT-interleaved delivery preserves the global order
-                sorted_actor=True,
+                # consumers must drain channel 0's whole range before channel
+                # 1's — channel-major delivery (SAT's (seq, channel)
+                # interleave breaks once a spilled sort emits multiple seqs)
+                channel_major=True,
             )
         else:
             actor_of[node_id] = graph.new_exec_node(

@@ -28,8 +28,10 @@ def optimize(sub: Dict[int, logical.Node], sink_id: int,
              exec_channels: int = 2) -> int:
     push_filters(sub, sink_id)
     early_projection(sub, sink_id)
+    reorder_joins(sub, sink_id)
     choose_broadcast(sub, sink_id)
     plan_parallel_sorts(sub, sink_id, exec_channels)
+    fold_maps(sub, sink_id)
     return sink_id
 
 
@@ -257,13 +259,17 @@ def _needed_from_parent(sub, node: logical.Node, i: int, need: Set[str]) -> Set[
 _CATALOG = None
 
 
-def choose_broadcast(sub: Dict[int, logical.Node], sink_id: int) -> None:
+def _get_catalog():
     from quokka_tpu.catalog import Catalog
 
     global _CATALOG
     if _CATALOG is None:
         _CATALOG = Catalog()
-    cat = _CATALOG
+    return _CATALOG
+
+
+def choose_broadcast(sub: Dict[int, logical.Node], sink_id: int) -> None:
+    cat = _get_catalog()
     for nid in _reachable(sub, sink_id):
         node = sub[nid]
         if not isinstance(node, logical.JoinNode) or node.broadcast:
@@ -275,17 +281,126 @@ def choose_broadcast(sub: Dict[int, logical.Node], sink_id: int) -> None:
             node.broadcast = True
 
 
+def fold_maps(sub: Dict[int, logical.Node], sink_id: int) -> None:
+    """Fold expression-only MapNodes into their consumer edges
+    (df.py:1354-1399 fold_map): instead of a separate actor hop, the map runs
+    as a TargetInfo.batch_func inside the producer's partition function
+    (engine executes batch_funcs at push time, runtime/engine.py).  Safe only
+    when the map's parent has no OTHER consumer — the map rides every edge
+    leaving the parent's actor."""
+    cons = _consumers(sub, sink_id)
+    for nid in _reachable(sub, sink_id):
+        node = sub.get(nid)
+        if not isinstance(node, logical.MapNode) or node.exprs is None:
+            continue
+        if getattr(node, "folded", False):
+            continue
+        pid = node.parents[0]
+        if len(cons.get(pid, [])) != 1:
+            continue
+        parent = sub[pid]
+        if isinstance(parent, logical.SourceNode):
+            continue  # the source predicate path already fuses; keep readers lean
+        node.folded = True
+
+
+def reorder_joins(sub: Dict[int, logical.Node], sink_id: int) -> None:
+    """Greedy cardinality ordering of left-deep inner-join chains
+    (df.py:1401-1513 merged multi-joins + 1570-1594 ordering): collect the
+    chain's build subtrees, estimate each, and re-attach them smallest-first
+    subject to key availability (snowflake joins whose keys come from an
+    earlier dimension keep their dependency order).  Only applies when no
+    column renames are involved and payload names are globally unique, so
+    output schemas are order-independent."""
+    cat = _get_catalog()
+    cons = _consumers(sub, sink_id)
+
+    def chain_join(nid) -> bool:
+        n = sub.get(nid)
+        return (
+            isinstance(n, logical.JoinNode)
+            and n.how == "inner"
+            and not n.broadcast
+            and not (n.rename or {})
+        )
+
+    for nid in _reachable(sub, sink_id):
+        if not chain_join(nid):
+            continue
+        # only start from the TOP of a chain
+        c = cons.get(nid, [])
+        if (
+            len(c) == 1
+            and chain_join(c[0])
+            and sub[c[0]].parents[0] == nid
+        ):
+            continue
+        chain: List[int] = []  # top-down join node ids
+        cur = nid
+        while chain_join(cur):
+            chain.append(cur)
+            pid = sub[cur].parents[0]
+            if not chain_join(pid) or len(cons.get(pid, [])) != 1:
+                break
+            cur = pid
+        if len(chain) < 2:
+            continue
+        base_id = sub[chain[-1]].parents[0]
+        base_schema = list(sub[base_id].schema)
+        levels = []  # bottom-up original order
+        names = set(base_schema)
+        ok = True
+        for jid in reversed(chain):
+            j = sub[jid]
+            payload = [c for c in sub[j.parents[1]].schema if c not in set(j.right_on)]
+            if any(p in names for p in payload):
+                ok = False
+                break
+            names |= set(payload)
+            est = _estimate_subtree(sub, j.parents[1], cat)
+            if est is None:
+                ok = False
+                break
+            levels.append({
+                "build": j.parents[1], "left_on": list(j.left_on),
+                "right_on": list(j.right_on), "payload": payload, "est": est,
+            })
+        if not ok:
+            continue
+        # greedy: among joins whose keys are available, take the smallest build
+        avail = set(base_schema)
+        remaining = levels[:]
+        order = []
+        while remaining:
+            cands = [lv for lv in remaining if set(lv["left_on"]) <= avail]
+            if not cands:
+                order = None
+                break
+            pick = min(cands, key=lambda lv: lv["est"])
+            order.append(pick)
+            remaining.remove(pick)
+            avail |= set(pick["payload"])
+        if order is None or order == levels:
+            continue
+        # reuse the chain's node ids positionally (bottom-up) so the top node
+        # keeps its id and consumers stay untouched
+        prev_id, prev_schema = base_id, base_schema
+        for jid, lv in zip(reversed(chain), order):
+            j = sub[jid]
+            j.parents = [prev_id, lv["build"]]
+            j.left_on = lv["left_on"]
+            j.right_on = lv["right_on"]
+            j.schema = prev_schema + lv["payload"]
+            prev_id, prev_schema = jid, list(j.schema)
+
+
 def plan_parallel_sorts(sub: Dict[int, logical.Node], sink_id: int,
                         exec_channels: int) -> None:
     """Give global sorts range boundaries from a source sample so they run
     partitioned across channels instead of on one."""
     if exec_channels < 2:
         return
-    from quokka_tpu.catalog import Catalog
-
-    global _CATALOG
-    if _CATALOG is None:
-        _CATALOG = Catalog()
+    _get_catalog()
     for nid in _reachable(sub, sink_id):
         node = sub[nid]
         if not isinstance(node, logical.SortNode) or node.boundaries is not None:

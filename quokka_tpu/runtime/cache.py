@@ -21,8 +21,24 @@ from collections import defaultdict
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 
+def _batch_nbytes(batch) -> int:
+    """Approximate device bytes of a batch (data + limbs + validity)."""
+    total = batch.valid.nbytes
+    for c in batch.columns.values():
+        data = getattr(c, "data", None)
+        if data is None:
+            data = getattr(c, "codes", None)
+        if data is not None:
+            total += data.nbytes
+        hi = getattr(c, "hi", None)
+        if hi is not None:
+            total += hi.nbytes
+    return total
+
+
 class BatchCache:
-    def __init__(self, mem_limit_batches: int = 10_000):
+    def __init__(self, mem_limit_batches: int = 10_000,
+                 mem_limit_bytes: int = 2 << 30):
         self._lock = threading.Lock()
         self._data: Dict[Tuple, object] = {}  # 6-tuple name -> DeviceBatch
         # index: (tgt_actor, tgt_ch) -> (src_actor, src_ch) -> set of seqs
@@ -30,16 +46,30 @@ class BatchCache:
             lambda: defaultdict(set)
         )
         self.mem_limit_batches = mem_limit_batches
+        # byte-based backpressure (reference gates on memory fraction,
+        # flight.py:293-297 — a batch COUNT says nothing about memory)
+        self.mem_limit_bytes = mem_limit_bytes
+        self._bytes: Dict[Tuple, int] = {}
+        self._total_bytes = 0
 
     def put(self, name: Tuple, batch) -> None:
         src_actor, src_ch, seq, tgt_actor, _, tgt_ch = name
+        nb = _batch_nbytes(batch)
         with self._lock:
+            old = self._bytes.get(name)
+            if old is not None:
+                self._total_bytes -= old
             self._data[name] = batch  # dedup: latest write wins (flight.py:67-76)
+            self._bytes[name] = nb
+            self._total_bytes += nb
             self._index[(tgt_actor, tgt_ch)][(src_actor, src_ch)].add(seq)
 
     def puttable(self) -> bool:
         with self._lock:
-            return len(self._data) < self.mem_limit_batches
+            return (
+                len(self._data) < self.mem_limit_batches
+                and self._total_bytes < self.mem_limit_bytes
+            )
 
     def plan_get(
         self,
@@ -49,15 +79,19 @@ class BatchCache:
         actor_stages: Dict[int, int],
         sorted_actors: Set[int],
         max_batches: int = 8,
+        channel_major: Optional[Set[int]] = None,
     ) -> Optional[Tuple[int, List[Tuple]]]:
         """Return (source_actor, [names...]) to consume next, or None."""
+        channel_major = channel_major or set()
         with self._lock:
             idx = self._index.get((tgt_actor, tgt_ch))
             if not idx:
                 return None
             candidates = []  # (stage, ready_count, src_actor, [names])
             for src_actor, chans in input_reqs.items():
-                if src_actor in sorted_actors:
+                if src_actor in channel_major:
+                    names = self._plan_channel_major(idx, src_actor, tgt_actor, tgt_ch, chans, max_batches)
+                elif src_actor in sorted_actors:
                     names = self._plan_sorted(idx, src_actor, tgt_actor, tgt_ch, chans, max_batches)
                 else:
                     names = self._plan_contiguous(idx, src_actor, tgt_actor, tgt_ch, chans, max_batches)
@@ -84,6 +118,19 @@ class BatchCache:
             if len(names) >= max_batches:
                 break
         return names
+
+    def _plan_channel_major(self, idx, src_actor, tgt_actor, tgt_ch, chans,
+                            max_batches):
+        """Range-partitioned producers (parallel sort): channel c's whole
+        output precedes channel c+1's.  Exhausted channels are pruned from
+        `chans` by the engine (DST+LIT), so serving only the lowest remaining
+        channel converges."""
+        if not chans:
+            return []
+        ch = min(chans)
+        return self._plan_contiguous(
+            idx, src_actor, tgt_actor, tgt_ch, {ch: chans[ch]}, max_batches
+        )
 
     def _plan_sorted(self, idx, src_actor, tgt_actor, tgt_ch, chans, max_batches):
         """Global (seq, channel) order across all source channels; stop at the
@@ -123,6 +170,9 @@ class BatchCache:
         with self._lock:
             for name in names:
                 self._data.pop(name, None)
+                nb = self._bytes.pop(name, None)
+                if nb is not None:
+                    self._total_bytes -= nb
                 src_actor, src_ch, seq, tgt_actor, _, tgt_ch = name
                 chans = self._index.get((tgt_actor, tgt_ch))
                 if chans is not None:

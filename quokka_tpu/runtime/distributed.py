@@ -46,6 +46,7 @@ def _build_spec(graph) -> Dict:
             "predicate": info.predicate,
             "projection": info.projection,
             "blocking": info.blocking_dataset is not None,
+            "channel_major": getattr(info, "channel_major", False),
         }
     from quokka_tpu import config as qconfig
 

@@ -49,12 +49,14 @@ from quokka_tpu.target_info import (
 
 
 class ActorInfo:
-    def __init__(self, actor_id, kind, channels, stage=0, sorted_actor=False):
+    def __init__(self, actor_id, kind, channels, stage=0, sorted_actor=False,
+                 channel_major=False):
         self.id = actor_id
         self.kind = kind  # 'input' | 'exec'
         self.channels = channels
         self.stage = stage
         self.sorted_actor = sorted_actor
+        self.channel_major = channel_major  # range-partitioned sort output
         self.reader = None
         self.executor_factory = None
         self.targets: Dict[int, TargetInfo] = {}  # tgt_actor -> TargetInfo
@@ -76,6 +78,9 @@ class TaskGraph:
             self.exec_config.update(exec_config)
         self.actors: Dict[int, ActorInfo] = {}
         self._next_actor = 0
+        # folded maps (optimizer.fold_maps): batch_funcs to prepend on every
+        # edge whose source is this actor
+        self._pending_batch_fns: Dict[int, List[Callable]] = {}
         self.hbq = None
         self.ckpt_dir = None
         if self.exec_config.get("fault_tolerance"):
@@ -143,6 +148,7 @@ class TaskGraph:
         stage: int = 0,
         blocking: bool = False,
         sorted_actor: bool = False,
+        channel_major: bool = False,
     ) -> int:
         # per-source routing state is keyed by src_actor, so two streams from
         # the SAME actor (direct self-join / self-union) would collide; give
@@ -157,15 +163,22 @@ class TaskGraph:
             deduped[stream_id] = (src_actor, tinfo)
         sources = deduped
         info = self._new_actor("exec", channels, stage, sorted_actor)
+        info.channel_major = channel_major
         info.executor_factory = executor_factory
         self.store.tset("FOT", info.id, executor_factory)
         self.store.tset("AST", info.id, stage)
         if sorted_actor:
             self.store.sadd("SAT", info.id)
+        if channel_major:
+            self.store.sadd("CMT", info.id)
         if blocking:
             info.blocking_dataset = ResultDataset(f"ds-{info.id}")
         for stream_id, (src_actor, tinfo) in sources.items():
             src = self.actors[src_actor]
+            pending = self._pending_batch_fns.get(src_actor)
+            if pending:
+                tinfo = copy.copy(tinfo)
+                tinfo.batch_funcs = list(pending) + list(tinfo.batch_funcs)
             src.targets[info.id] = tinfo
             info.source_streams[src_actor] = stream_id
             self.store.tset("PFT", (src_actor, info.id), tinfo)
@@ -182,6 +195,9 @@ class TaskGraph:
             self.store.tset("IRT", (info.id, ch, 0), copy.deepcopy(reqs))
             self.store.ntt_push(info.id, ExecutorTask(info.id, ch, 0, 0, reqs))
         return info.id
+
+    def add_pending_batch_fn(self, src_actor: int, fn: Callable) -> None:
+        self._pending_batch_fns.setdefault(src_actor, []).append(fn)
 
     def _relay_actor(self, src_actor: int, stage: int) -> int:
         from quokka_tpu.executors.sql_execs import StorageExecutor
@@ -385,9 +401,17 @@ class Engine:
         task.out_seq = out_seq
         if not task.input_reqs:
             out = executor.done(task.channel)
-            if out is not None and out.count_valid() > 0:
-                self._emit(info, task.channel, out_seq, out)
-                out_seq += 1
+            # spill-tier executors (external sort, grace join) emit their
+            # result as a lazy SEQUENCE of bounded batches — a generator keeps
+            # only one merged batch on device at a time
+            if out is None or isinstance(out, DeviceBatch):
+                outs = [out]
+            else:
+                outs = out  # list or generator
+            for o in outs:
+                if o is not None and o.count_valid() > 0:
+                    self._emit(info, task.channel, out_seq, o)
+                    out_seq += 1
             with self.store.transaction():
                 self.store.tset("LIT", (task.actor, task.channel), out_seq - 1)
                 self.store.tset("EST", (task.actor, task.channel), task.state_seq)
@@ -400,6 +424,7 @@ class Engine:
             self._actor_stages(),
             self._sorted_actors(),
             max_batches=self.max_batches,
+            channel_major=self._channel_major_actors(),
         )
         if plan is None:
             self.store.ntt_push(task.actor, task)
@@ -437,6 +462,9 @@ class Engine:
 
     def _sorted_actors(self):
         return self.store.smembers("SAT")
+
+    def _channel_major_actors(self):
+        return self.store.smembers("CMT")
 
     # -- fault tolerance ------------------------------------------------------
     def _tape(self, actor: int, ch: int, event) -> None:

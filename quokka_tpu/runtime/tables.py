@@ -37,7 +37,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 TABLE_NAMES = (
     "CT", "NOT", "PT", "NTT", "GIT", "LT", "DST", "LCT", "EST", "CLT",
-    "FOT", "IRT", "SAT", "PFT", "AST", "LIT", "EWT",
+    "FOT", "IRT", "SAT", "PFT", "AST", "LIT", "EWT", "CMT",
 )
 
 
@@ -53,6 +53,10 @@ class ControlStore:
         # set-valued tables
         self.tables["CT"] = set()
         self.tables["SAT"] = set()
+        # CMT: channel-major actors (range-partitioned sorts) — consumers read
+        # channel c fully before channel c+1; SAT's (seq, channel) interleave
+        # would shuffle ranges once a channel emits more than one batch
+        self.tables["CMT"] = set()
         self.tables["NOT"] = defaultdict(set)
         self.tables["DST"] = defaultdict(set)
         self.tables["GIT"] = defaultdict(set)

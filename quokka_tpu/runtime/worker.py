@@ -49,6 +49,7 @@ def _actors_from_spec(spec: Dict) -> Dict[int, ActorInfo]:
         info.predicate = d["predicate"]
         info.projection = d["projection"]
         info.blocking = d["blocking"]
+        info.channel_major = d.get("channel_major", False)
         info.blocking_dataset = None
         actors[aid] = info
     return actors
@@ -85,12 +86,18 @@ class Worker(Engine):
         # scheduling hot loop never round-trips them through the store
         self._stages_cache = {a.id: a.stage for a in actors.values()}
         self._sorted_cache = {a.id for a in actors.values() if a.sorted_actor}
+        self._cm_cache = {
+            a.id for a in actors.values() if getattr(a, "channel_major", False)
+        }
 
     def _actor_stages(self):
         return self._stages_cache
 
     def _sorted_actors(self):
         return self._sorted_cache
+
+    def _channel_major_actors(self):
+        return self._cm_cache
 
     # -- routing --------------------------------------------------------------
     def _refresh_clt(self):

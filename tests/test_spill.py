@@ -1,0 +1,141 @@
+"""Spill tier (VERDICT r1 item 5): external merge sort, grace
+(disk-partitioned) join, and byte-based cache backpressure.  Thresholds are
+monkeypatched low so tiny datasets exercise the disk paths; results must be
+identical to the in-memory paths / pandas."""
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from quokka_tpu import QuokkaContext, config
+from quokka_tpu.ops import bridge
+
+
+@pytest.fixture
+def spill_small(monkeypatch):
+    monkeypatch.setattr(config, "SPILL_SORT_ROWS", 4000)
+    monkeypatch.setattr(config, "SPILL_MERGE_CHUNK_ROWS", 1500)
+    monkeypatch.setattr(config, "SPILL_JOIN_BUILD_ROWS", 3000)
+    monkeypatch.setattr(config, "SPILL_JOIN_FANOUT", 4)
+
+
+def test_external_sort_query(spill_small):
+    r = np.random.default_rng(1)
+    n = 30000
+    t = pa.table({
+        "x": r.integers(-10**12, 10**12, n),
+        "s": np.array(["p", "q", "r"])[r.integers(0, 3, n)],
+        "v": r.uniform(0, 1, n).round(6),
+    })
+    from quokka_tpu import logical
+    from quokka_tpu.dataset.readers import InputArrowDataset
+
+    ctx = QuokkaContext()
+    # small reader batches -> the sort accumulates past the spill threshold
+    # repeatedly and must merge MANY sorted runs
+    src = ctx.new_stream(
+        logical.SourceNode(InputArrowDataset(t, batch_rows=3000), list(t.column_names))
+    )
+    got = src.sort(["s", "x"], descending=[False, True]).collect()
+    exp = t.to_pandas().sort_values(["s", "x"], ascending=[True, False]).reset_index(drop=True)
+    np.testing.assert_array_equal(got.x.to_numpy(), exp.x.to_numpy())
+    assert got.s.tolist() == exp.s.tolist()
+    np.testing.assert_allclose(got.v.to_numpy(), exp.v.to_numpy())
+
+
+def test_grace_join_query(spill_small):
+    r = np.random.default_rng(2)
+    n_build, n_probe = 12000, 25000
+    build = pa.table({
+        "k": r.permutation(n_build).astype(np.int64),
+        "name": np.array([f"n{i % 17}" for i in range(n_build)]),
+        "w": r.uniform(0, 5, n_build).round(4),
+    })
+    probe = pa.table({
+        "k": r.integers(0, n_build * 2, n_probe).astype(np.int64),  # ~half miss
+        "v": r.uniform(0, 9, n_probe).round(4),
+    })
+    ctx = QuokkaContext()
+    for how in ("inner", "left", "semi", "anti"):
+        got = (
+            ctx.from_arrow(probe)
+            .join(ctx.from_arrow(build), on="k", how=how)
+            .collect()
+        )
+        pdf, bdf = probe.to_pandas(), build.to_pandas()
+        if how in ("semi", "anti"):
+            hit = pdf.k.isin(bdf.k)
+            exp = pdf[hit] if how == "semi" else pdf[~hit]
+            assert len(got) == len(exp), how
+            np.testing.assert_allclose(
+                np.sort(got.v.to_numpy()), np.sort(exp.v.to_numpy()), err_msg=how
+            )
+        else:
+            exp = pdf.merge(bdf, on="k", how=how)
+            assert len(got) == len(exp), how
+            np.testing.assert_allclose(got.v.sum(), exp.v.sum(), rtol=1e-9)
+            if how == "left":
+                assert got.name.isna().sum() == exp.name.isna().sum()
+            np.testing.assert_allclose(
+                got.w.sum(), exp.w.sum(), rtol=1e-9, err_msg=how
+            )
+
+
+def test_grace_join_then_agg(spill_small):
+    r = np.random.default_rng(3)
+    build = pa.table({
+        "k": np.arange(8000, dtype=np.int64),
+        "grp": np.array(["A", "B", "C", "D"])[np.arange(8000) % 4],
+    })
+    probe = pa.table({
+        "k": r.integers(0, 8000, 20000).astype(np.int64),
+        "v": r.uniform(0, 2, 20000).round(5),
+    })
+    ctx = QuokkaContext()
+    got = (
+        ctx.from_arrow(probe)
+        .join(ctx.from_arrow(build), on="k")
+        .groupby("grp")
+        .agg_sql("sum(v) as sv, count(*) as n")
+        .collect()
+        .sort_values("grp")
+        .reset_index(drop=True)
+    )
+    df = probe.to_pandas().merge(build.to_pandas(), on="k")
+    exp = df.groupby("grp").v.agg(["sum", "size"]).reset_index()
+    np.testing.assert_allclose(got.sv.to_numpy(), exp["sum"].to_numpy(), rtol=1e-9)
+    assert got.n.tolist() == exp["size"].tolist()
+
+
+def test_byte_backpressure():
+    from quokka_tpu.runtime.cache import BatchCache
+
+    t = pa.table({"v": np.arange(5000, dtype=np.int64)})
+    b = bridge.arrow_to_device(t)
+    cache = BatchCache(mem_limit_bytes=1)
+    assert cache.puttable()
+    cache.put((0, 0, 0, 1, 0, 0), b)
+    assert not cache.puttable()  # bytes, not batch count, gate ingestion
+    cache.gc([(0, 0, 0, 1, 0, 0)])
+    assert cache.puttable()
+
+
+def test_parallel_range_sort_with_spill(spill_small, tmp_path):
+    """Review regression: a range-partitioned parallel sort whose channels
+    each spill (multi-seq output) must still concat in channel order —
+    (seq, channel)-interleaved delivery would shuffle the ranges."""
+    import pyarrow.parquet as pq
+
+    r = np.random.default_rng(9)
+    n = 40000
+    t = pa.table({"x": r.permutation(n).astype(np.int64),
+                  "v": r.uniform(0, 1, n)})
+    p = str(tmp_path / "t.parquet")
+    pq.write_table(t, p, row_group_size=4096)  # sampleable, multi-batch
+    ctx = QuokkaContext(exec_channels=2)
+    got = ctx.read_parquet(p).sort("x").collect()
+    assert (np.diff(got.x.to_numpy()) >= 0).all()
+    assert len(got) == n
+    got_desc = ctx.read_parquet(p).sort("x", descending=[True]).collect()
+    assert (np.diff(got_desc.x.to_numpy()) <= 0).all()
