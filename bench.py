@@ -74,15 +74,68 @@ def run_q1(path):
     return time.time() - t0, df
 
 
+def probe_tpu(attempts: int = 2, timeout: int = 150, backoff: int = 20) -> bool:
+    """Check the TPU backend from a SUBPROCESS so a wedged tunnel (which hangs
+    jax.devices() indefinitely) can't hang the bench itself.  Bounded retries
+    with backoff; False means the tunnel is down after all attempts."""
+    import subprocess
+
+    probe = (
+        "import jax, jax.numpy as jnp;"
+        "d = jax.devices();"
+        "(jnp.ones((64, 64)) @ jnp.ones((64, 64))).block_until_ready();"
+        "print('ok', d[0].platform)"
+    )
+    for i in range(attempts):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", probe],
+                timeout=timeout, capture_output=True, text=True,
+            )
+            if r.returncode == 0 and "ok" in r.stdout:
+                platform = r.stdout.strip().split()[-1].lower()
+                if platform not in ("cpu",):
+                    return True
+                # JAX silently picked CPU (plugin missing): that is NOT a TPU
+                sys.stderr.write(
+                    f"bench: probe initialized platform {platform!r}, not TPU\n"
+                )
+                return False
+            sys.stderr.write(
+                f"bench: TPU probe {i + 1}/{attempts} failed rc={r.returncode}: "
+                f"{(r.stderr or r.stdout)[-200:]}\n"
+            )
+        except subprocess.TimeoutExpired:
+            sys.stderr.write(f"bench: TPU probe {i + 1}/{attempts} timed out\n")
+        if i < attempts - 1:
+            time.sleep(backoff)
+    return False
+
+
 def main():
     path = ensure_data()
     nbytes = os.path.getsize(path)
+    tpu_ok = probe_tpu()
     import jax
 
+    fallback = False
+    if not tpu_ok:
+        # LOUD CPU fallback: the result still parses, but the platform field
+        # and fallback flag make it unmistakable that this is not a TPU number
+        sys.stderr.write("bench: TPU unavailable after retries; CPU fallback\n")
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+        fallback = True
     platform = jax.default_backend()
-    # warm-up run compiles the kernel set; the measured run reflects steady state
+    # warm-up run compiles the kernel set; measured runs reflect steady state
     warm, df = run_q1(path)
-    t, df = run_q1(path)
+    times = []
+    for _ in range(3):
+        t, df = run_q1(path)
+        times.append(t)
+    t = min(times)
     assert len(df) == 6, df
     gbps = nbytes / t / 1e9
     result = {
@@ -94,8 +147,10 @@ def main():
             "sf": SF,
             "parquet_bytes": nbytes,
             "q1_seconds": round(t, 4),
+            "q1_seconds_all": [round(x, 4) for x in times],
             "warmup_seconds": round(warm, 4),
             "platform": platform,
+            "tpu_fallback_to_cpu": fallback,
         },
     }
     print(json.dumps(result))

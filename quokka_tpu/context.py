@@ -72,7 +72,14 @@ class QuokkaContext:
 
     # -- readers ---------------------------------------------------------------
     def read_parquet(self, path, columns=None) -> DataStream:
-        reader = InputParquetDataset(path, columns=columns)
+        if "://" in str(path):
+            # object-store URL (s3://, gs://, file://, ...): fsspec byte-range
+            # reader with the same row-group partitioning + stats pruning
+            from quokka_tpu.dataset.cloud import InputObjectParquetDataset
+
+            reader = InputObjectParquetDataset(path, columns=columns)
+        else:
+            reader = InputParquetDataset(path, columns=columns)
         schema = [f for f in reader.schema.names]
         if columns:
             schema = list(columns)
@@ -80,8 +87,23 @@ class QuokkaContext:
 
     def read_csv(self, path, schema: Optional[List[str]] = None,
                  has_header: bool = True, sep: str = ",") -> DataStream:
+        if "://" in str(path):
+            from quokka_tpu.dataset.cloud import InputObjectCSVDataset
+
+            obj = InputObjectCSVDataset(path, names=schema,
+                                        has_header=has_header, sep=sep)
+            return self.new_stream(logical.SourceNode(obj, list(obj.schema)))
         reader = InputCSVDataset(path, schema=schema, has_header=has_header, sep=sep)
         return self.new_stream(logical.SourceNode(reader, list(reader.schema.names)))
+
+    def read_rest(self, requests_list, record_path=None, schema=None) -> DataStream:
+        """Paged REST endpoint: each (url, params) request is one lineage unit
+        (reference crypto_dataset.py)."""
+        from quokka_tpu.dataset.cloud import InputRestDataset
+
+        reader = InputRestDataset(requests_list, record_path=record_path,
+                                  schema=schema)
+        return self.new_stream(logical.SourceNode(reader, list(reader.schema)))
 
     def read_json(self, path) -> DataStream:
         reader = InputJSONDataset(path)

@@ -1,0 +1,237 @@
+"""Object-store and REST readers.
+
+The reference reads S3 with byte-range GETs for CSV (newline-boundary
+refinement, pyquokka/dataset/unordered_readers.py:3-72 InputS3CSVDataset) and
+threaded footer/row-group GETs for Parquet (unordered_readers.py:646-760).
+Here the same designs sit behind fsspec, so one implementation serves
+local files (file://), S3 (s3:// when s3fs is installed), GCS, HTTP, etc.,
+and the tests drive the exact S3 code path against local files.
+
+The REST reader mirrors the reference's crypto_dataset.py: paged HTTP GETs as
+lineage units, JSON records to Arrow.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import pyarrow as pa
+import pyarrow.csv as pacsv
+import pyarrow.parquet as pq
+
+
+def resolve_fs(url: str):
+    """(filesystem, path) for a URL; local paths work bare."""
+    import fsspec
+
+    try:
+        fs, path = fsspec.core.url_to_fs(url)
+    except ImportError as e:  # e.g. s3:// without s3fs in the image
+        raise ImportError(
+            f"filesystem for {url!r} needs an fsspec backend that is not "
+            f"installed ({e}); local file paths and file:// always work"
+        ) from None
+    return fs, path
+
+
+def _expand(fs, path: str) -> List[str]:
+    if any(ch in path for ch in "*?["):
+        return sorted(fs.glob(path))
+    if fs.isdir(path):
+        return sorted(p for p in fs.ls(path) if not fs.isdir(p))
+    return [path]
+
+
+class InputObjectCSVDataset:
+    """Byte-range partitioned CSV over any fsspec filesystem.
+
+    Lineage = (file, start, end): each channel reads its ranges with two
+    range-GETs at most — the range itself plus a small tail read to finish
+    the last row — and trims to newline boundaries so every row is parsed
+    exactly once (the InputS3CSVDataset technique)."""
+
+    def __init__(self, url: str, names: Optional[Sequence[str]] = None,
+                 stride: int = 16 << 20, has_header: bool = True, sep: str = ","):
+        self.url = url
+        self.names = list(names) if names else None
+        self.stride = stride
+        self.has_header = has_header
+        self.sep = sep
+        self._schema_names: Optional[List[str]] = None
+        self._arrow_schema = None  # inferred once; pins types across ranges
+
+    @property
+    def schema(self) -> List[str]:
+        if self._schema_names is None:
+            fs, path = resolve_fs(self.url)
+            f0 = _expand(fs, path)[0]
+            head = fs.open(f0, "rb").read(1 << 16)
+            first = head.split(b"\n", 1)[0].decode("utf-8", "replace")
+            cols = [c.strip().strip('"') for c in first.split(self.sep)]
+            if self.has_header:
+                self._schema_names = cols
+            else:
+                self._schema_names = self.names or [f"f{i}" for i in range(len(cols))]
+        return self._schema_names
+
+    def get_own_state(self, num_channels: int) -> Dict[int, List]:
+        fs, path = resolve_fs(self.url)
+        lineages: List[Tuple[str, int, int]] = []
+        for f in _expand(fs, path):
+            size = fs.size(f)
+            start = 0
+            while start < size:
+                end = min(start + self.stride, size)
+                lineages.append((f, start, end))
+                start = end
+        return {ch: lineages[ch::num_channels] for ch in range(num_channels)}
+
+    def _pinned_schema(self, fs, f) -> pa.Schema:
+        """Column types inferred ONCE from the file head and pinned for every
+        range — per-range inference could type '123' as int in one range and
+        string in another (readers.py pins the same way)."""
+        if self._arrow_schema is None:
+            head = fs.cat_file(f, 0, min(1 << 20, fs.size(f)))
+            head = head[: head.rfind(b"\n") + 1] or head
+            ro = (pacsv.ReadOptions() if self.has_header
+                  else pacsv.ReadOptions(column_names=self.schema))
+            t = pacsv.read_csv(
+                pa.BufferReader(head), read_options=ro,
+                parse_options=pacsv.ParseOptions(delimiter=self.sep),
+            )
+            self._arrow_schema = t.schema
+        return self._arrow_schema
+
+    def execute(self, channel: int, lineage) -> pa.Table:
+        fs, _ = resolve_fs(self.url)
+        f, start, end = lineage
+        size = fs.size(f)
+        schema = self._pinned_schema(fs, f)
+        raw = fs.cat_file(f, start, min(end, size))
+        if end < size:
+            # FIRST extend to the end of the last row (tail reads until a
+            # newline) — extending after dropping the torn head would parse a
+            # foreign row's tail bytes as a row when a row spans the stride
+            tail_at = end
+            while True:
+                chunk = fs.cat_file(f, tail_at, min(tail_at + (1 << 20), size))
+                nl = chunk.find(b"\n")
+                if nl >= 0:
+                    raw += chunk[:nl]
+                    break
+                raw += chunk
+                tail_at += len(chunk)
+                if tail_at >= size or not chunk:
+                    break
+        if start > 0:
+            # then drop the torn first row: it belongs to the previous range
+            nl = raw.find(b"\n")
+            raw = raw[nl + 1:] if nl >= 0 else b""
+        names = self.schema
+        if not raw.strip():
+            return schema.empty_table()
+        read_opts = pacsv.ReadOptions(column_names=names)
+        if self.has_header and start == 0:
+            read_opts = pacsv.ReadOptions()  # header row present in this range
+        return pacsv.read_csv(
+            pa.BufferReader(raw),
+            read_options=read_opts,
+            parse_options=pacsv.ParseOptions(delimiter=self.sep),
+            convert_options=pacsv.ConvertOptions(
+                column_types={n: schema.field(n).type for n in schema.names}
+            ),
+        )
+
+
+class InputObjectParquetDataset:
+    """Row-group partitioned Parquet over any fsspec filesystem: footer read
+    per file at plan time, one row-group read per lineage, with column
+    pushdown and row-group min/max skipping (unordered_readers.py:646-760)."""
+
+    def __init__(self, url: str, columns: Optional[Sequence[str]] = None,
+                 predicate=None):
+        self.url = url
+        self.columns = list(columns) if columns else None
+        self.predicate = predicate  # conjunction usable for row-group skipping
+        self._schema: Optional[pa.Schema] = None
+
+    @property
+    def schema(self) -> pa.Schema:
+        if self._schema is None:
+            fs, path = resolve_fs(self.url)
+            f0 = _expand(fs, path)[0]
+            self._schema = pq.ParquetFile(fs.open(f0, "rb")).schema_arrow
+        return self._schema
+
+    def get_own_state(self, num_channels: int) -> Dict[int, List]:
+        from quokka_tpu.dataset.readers import _rowgroup_prunable
+
+        fs, path = resolve_fs(self.url)
+        lineages: List[Tuple[str, int]] = []
+        for f in _expand(fs, path):
+            pf = pq.ParquetFile(fs.open(f, "rb"))
+            meta = pf.metadata
+            schema = pf.schema_arrow
+            for rg in range(meta.num_row_groups):
+                if self.predicate is not None and _rowgroup_prunable(
+                    meta.row_group(rg), self.predicate, schema
+                ):
+                    continue
+                lineages.append((f, rg))
+        return {ch: lineages[ch::num_channels] for ch in range(num_channels)}
+
+    def execute(self, channel: int, lineage) -> pa.Table:
+        fs, _ = resolve_fs(self.url)
+        f, rg = lineage
+        pf = pq.ParquetFile(fs.open(f, "rb"))
+        cols = self.columns
+        if cols is not None:
+            cols = [c for c in cols if c in set(pf.schema_arrow.names)]
+        return pf.read_row_group(rg, columns=cols)
+
+
+class InputRestDataset:
+    """Paged REST endpoint reader (the reference's crypto_dataset.py shape):
+    lineage = one (url, params) request; JSON records become Arrow rows."""
+
+    def __init__(self, requests_list: Sequence[Tuple[str, Optional[dict]]],
+                 record_path: Optional[str] = None,
+                 schema: Optional[Sequence[str]] = None):
+        self.requests_list = [(u, dict(p) if p else None) for u, p in requests_list]
+        self.record_path = record_path
+        self._schema_names = list(schema) if schema else None
+        self._first_page: Optional[pa.Table] = None  # plan-time fetch reuse
+
+    @property
+    def schema(self) -> Optional[List[str]]:
+        if self._schema_names is None:
+            # schema inference must fetch page 0; CACHE it so the runtime's
+            # first lineage doesn't re-hit a rate-limited/non-idempotent API
+            self._first_page = self._fetch(self.requests_list[0])
+            self._schema_names = list(self._first_page.column_names)
+        return self._schema_names
+
+    def get_own_state(self, num_channels: int) -> Dict[int, List]:
+        return {
+            ch: self.requests_list[ch::num_channels] for ch in range(num_channels)
+        }
+
+    def execute(self, channel: int, lineage) -> pa.Table:
+        url, params = lineage
+        if self._first_page is not None and (url, params) == tuple(self.requests_list[0]):
+            t, self._first_page = self._first_page, None
+            return t
+        return self._fetch((url, params))
+
+    def _fetch(self, req) -> pa.Table:
+        import requests
+
+        url, params = req
+        r = requests.get(url, params=params, timeout=60)
+        r.raise_for_status()
+        data = r.json()
+        if self.record_path is not None:
+            data = data[self.record_path]
+        if not isinstance(data, list):
+            data = [data]
+        return pa.Table.from_pylist(data)

@@ -88,6 +88,10 @@ class InputParquetDataset:
         self.path = path
         self.columns = list(columns) if columns else None
         self.predicate = predicate  # conjunction usable for row-group skipping
+        # ANN pushdown (optimizer.push_ann): (queries, nprobe) restricts the
+        # scan to row groups owning the queries' closest IVF cells when an
+        # .ivf.npz sidecar exists (dataset/vector.py — the Lance-index role)
+        self.ann_prune = None
 
     @property
     def schema(self) -> pa.Schema:
@@ -97,10 +101,20 @@ class InputParquetDataset:
     def get_own_state(self, num_channels: int) -> Dict[int, List]:
         pieces = []
         for f in _expand_paths(self.path):
+            keep_rgs = None
+            if self.ann_prune is not None:
+                from quokka_tpu.dataset.vector import prune_row_groups
+
+                queries, nprobe = self.ann_prune
+                keep = prune_row_groups(f, queries, nprobe)
+                if keep is not None:
+                    keep_rgs = set(int(i) for i in keep)
             pf = pq.ParquetFile(f)
             meta = pf.metadata
             schema = pf.schema_arrow
             for rg in range(meta.num_row_groups):
+                if keep_rgs is not None and rg not in keep_rgs:
+                    continue
                 if self.predicate is not None and _rowgroup_prunable(
                     meta.row_group(rg), self.predicate, schema
                 ):

@@ -1,0 +1,87 @@
+"""Vector dataset indexing: IVF sidecar + ANN row-group pruning.
+
+The reference reads Lance datasets and pushes approximate nearest-neighbor
+search into the format's vector index (df.py:1264-1352 push_ann,
+unordered_readers.py:101-205 InputLanceDataset).  Lance isn't in this image,
+so the same capability is built natively over Parquet: `build_vector_index`
+writes an IVF sidecar (k-means centroids + the set of cells present in each
+row group; assignment runs as device matmuls), and an indexed source prunes
+row groups to the query's closest `nprobe` cells.  Approximate by nature —
+the optimizer only applies it when nearest_neighbors(..., approximate=True).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+def sidecar_path(parquet_path: str) -> str:
+    return parquet_path + ".ivf.npz"
+
+
+def build_vector_index(parquet_path: str, vec_col: str, n_cells: int = 32,
+                       iters: int = 8, seed: int = 0) -> str:
+    """K-means the vectors (Lloyd iterations as device matmuls — assignment is
+    one [n, d] @ [d, c] per pass, MXU-shaped) and record per-row-group cell
+    membership.  Returns the sidecar path."""
+    import jax.numpy as jnp
+    import pyarrow.parquet as pq
+
+    pf = pq.ParquetFile(parquet_path)
+    tables = [pf.read_row_group(rg, columns=[vec_col]) for rg in range(pf.metadata.num_row_groups)]
+    mats = []
+    for t in tables:
+        arr = t.column(vec_col).combine_chunks()
+        dim = arr.type.list_size
+        mats.append(
+            np.asarray(arr.flatten().to_numpy(zero_copy_only=False), dtype=np.float32).reshape(-1, dim)
+        )
+    all_vecs = np.concatenate(mats)
+    n = len(all_vecs)
+    n_cells = min(n_cells, n)
+    r = np.random.default_rng(seed)
+    cents = all_vecs[r.choice(n, n_cells, replace=False)].copy()
+    x = jnp.asarray(all_vecs)
+    xn = x / jnp.maximum(jnp.linalg.norm(x, axis=1, keepdims=True), 1e-9)
+    for _ in range(iters):
+        c = jnp.asarray(cents)
+        cn = c / jnp.maximum(jnp.linalg.norm(c, axis=1, keepdims=True), 1e-9)
+        assign = jnp.argmax(xn @ cn.T, axis=1)  # cosine assignment on the MXU
+        a = np.asarray(assign)
+        for j in range(n_cells):
+            sel = all_vecs[a == j]
+            if len(sel):
+                cents[j] = sel.mean(axis=0)
+    # per-row-group cell membership
+    a = np.asarray(assign)
+    rg_cells = np.zeros((len(mats), n_cells), dtype=bool)
+    off = 0
+    for i, m in enumerate(mats):
+        rg_cells[i, np.unique(a[off:off + len(m)])] = True
+        off += len(m)
+    out = sidecar_path(parquet_path)
+    np.savez(out, centroids=cents, rg_cells=rg_cells, vec_col=np.array([vec_col]))
+    return out
+
+
+def prune_row_groups(parquet_path: str, queries: np.ndarray,
+                     nprobe: int) -> Optional[np.ndarray]:
+    """Row-group indices that may contain any query's nprobe closest cells,
+    or None when no sidecar index exists."""
+    p = sidecar_path(parquet_path)
+    if not os.path.exists(p):
+        return None
+    idx = np.load(p, allow_pickle=False)
+    cents = idx["centroids"]
+    rg_cells = idx["rg_cells"]
+    q = np.asarray(queries, dtype=np.float32)
+    qn = q / np.maximum(np.linalg.norm(q, axis=1, keepdims=True), 1e-9)
+    cn = cents / np.maximum(np.linalg.norm(cents, axis=1, keepdims=True), 1e-9)
+    sims = qn @ cn.T  # [nq, n_cells]
+    nprobe = min(nprobe, sims.shape[1])
+    probed = np.unique(np.argpartition(-sims, nprobe - 1, axis=1)[:, :nprobe])
+    keep = np.nonzero(rg_cells[:, probed].any(axis=1))[0]
+    return keep

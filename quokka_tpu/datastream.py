@@ -279,9 +279,14 @@ class DataStream:
 
     # -- vectors (datastream.py:396 vector_nn_join) ---------------------------
     def nearest_neighbors(self, queries, vec_col: str, k: int,
-                          payload=None) -> "DataStream":
+                          payload=None, approximate: bool = False,
+                          nprobe: int = 4) -> "DataStream":
         """Top-k cosine matches of each query vector against this stream's
-        `vec_col` vectors (brute force on the MXU)."""
+        `vec_col` vectors (brute force on the MXU).  approximate=True lets the
+        optimizer push the search into an IVF sidecar index when the source
+        has one (dataset/vector.build_vector_index): only row groups owning
+        the queries' nprobe closest cells are scanned — Lance-style ANN
+        pushdown (reference df.py:1264-1352)."""
         import numpy as _np
 
         from quokka_tpu.executors.vector import (
@@ -299,6 +304,8 @@ class DataStream:
             out_schema,
             functools.partial(NearestNeighborExecutor, queries, vec_col, k, payload_cols),
         )
+        if approximate:
+            local.ann_info = {"queries": queries, "nprobe": int(nprobe)}
         local_id = self.ctx.add_node(local)
         reduce_node = logical.StatefulNode(
             [local_id], out_schema, functools.partial(GlobalTopKReduceExecutor, k)

@@ -280,6 +280,7 @@ class BuildProbeJoinExecutor(Executor):
         self._spill_dir: Optional[str] = None
         self._writers: Dict[Tuple[str, int], object] = {}
         self._files: Dict[Tuple[str, int], str] = {}
+        self._build_arrow_schema = None
 
     def _finalize_build(self, probe_cols: List[str]):
         if not self.build_parts:
@@ -358,6 +359,10 @@ class BuildProbeJoinExecutor(Executor):
             if part.count_valid() == 0:
                 continue
             table = bridge.device_to_arrow(part)
+            if side == "build" and self._build_arrow_schema is None:
+                # remember the build schema: probe-only partitions still need
+                # a schema'd (empty) build for typed left-join null payloads
+                self._build_arrow_schema = table.schema
             key = (side, p)
             w = self._writers.get(key)
             if w is None:
@@ -394,6 +399,12 @@ class BuildProbeJoinExecutor(Executor):
                             )
                             for i in range(r.num_record_batches)
                         ]
+                elif self._build_arrow_schema is not None:
+                    # probe-only partition: a schema'd empty build keeps
+                    # left-join null payloads correctly typed
+                    inner.build_parts = [
+                        bridge.arrow_to_device(self._build_arrow_schema.empty_table())
+                    ]
                 with pa.ipc.open_file(probe_path) as r:
                     for i in range(r.num_record_batches):
                         chunk = bridge.arrow_to_device(

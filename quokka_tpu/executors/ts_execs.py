@@ -558,9 +558,9 @@ class SlidingWindowExecutor(_TimeRebase, Executor):
         self.size = window.size_before
         self.plan = plan
         for _, op, _ in plan.partials:
-            if op not in ("sum", "count"):
+            if op not in ("sum", "count", "min", "max"):
                 raise NotImplementedError(
-                    "sliding windows support sum/count/avg aggregates (min/max todo)"
+                    f"sliding windows support sum/count/avg/min/max (got {op})"
                 )
         self.tail: Optional[DeviceBatch] = None
 
@@ -632,6 +632,15 @@ class SlidingWindowExecutor(_TimeRebase, Executor):
         right = _bisect_right_segmented(t, t, iota, seg_end)
         outs = {}
         for pname, op, tmp in self.plan.partials:
+            if op in ("min", "max"):
+                # arbitrary [left, right] range min/max via a sparse table:
+                # log2(n) doubling levels, query = two overlapping power-of-2
+                # blocks (prefix sums can't invert min/max)
+                x = s.columns[tmp].data
+                fill = _max_fill(x.dtype) if op == "min" else _min_fill(x.dtype)
+                x = jnp.where(s.valid, x, fill)
+                outs[pname] = _range_minmax(x, left, right, op)
+                continue
             if op == "count":
                 x = s.valid.astype(jnp.float32 if not kernels.config.x64_enabled() else jnp.float64)
             else:
@@ -720,6 +729,44 @@ class ShiftExecutor(Executor):
         only_new = kernels.apply_mask(out, out.valid & out.columns["__new"].data)
         keep = [c for c in out.names if not c.startswith("__")]
         return kernels.compact(only_new.select(keep))
+
+
+def _max_fill(dtype):
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.array(jnp.inf, dtype)
+    return jnp.array(jnp.iinfo(dtype).max, dtype)
+
+
+def _min_fill(dtype):
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.array(-jnp.inf, dtype)
+    return jnp.array(jnp.iinfo(dtype).min, dtype)
+
+
+def _range_minmax(x, left, right, op: str):
+    """Per-row min/max over x[left[i] .. right[i]] (inclusive), arbitrary
+    ranges: O(n log n) sparse table + two-block queries, all vectorized."""
+    import math
+
+    combine = jnp.minimum if op == "min" else jnp.maximum
+    n = x.shape[0]
+    levels = [x]
+    span = 1
+    while span < n:
+        prev = levels[-1]
+        shifted = jnp.concatenate([prev[span:], prev[-1:].repeat(span)])
+        levels.append(combine(prev, shifted))
+        span *= 2
+    length = jnp.maximum(right - left + 1, 1)
+    k = jnp.clip(
+        jnp.floor(jnp.log2(length.astype(jnp.float32))).astype(jnp.int32),
+        0, len(levels) - 1,
+    )
+    table = jnp.stack(levels)  # [L, n]
+    a = table[k, left]
+    b_start = jnp.clip(right - (1 << k) + 1, 0, n - 1)
+    b = table[k, b_start]
+    return combine(a, b)
 
 
 def _rows_from_segment_end(iota, seg_start_flag, n):

@@ -31,8 +31,47 @@ def optimize(sub: Dict[int, logical.Node], sink_id: int,
     reorder_joins(sub, sink_id)
     choose_broadcast(sub, sink_id)
     plan_parallel_sorts(sub, sink_id, exec_channels)
+    push_ann(sub, sink_id)
     fold_maps(sub, sink_id)
     return sink_id
+
+
+def push_ann(sub: Dict[int, logical.Node], sink_id: int) -> None:
+    """Approximate nearest-neighbor pushdown (df.py:1264-1352 push_ann):
+    an opted-in nearest_neighbors over an IVF-indexed Parquet source prunes
+    the scan to row groups owning the queries' closest cells."""
+    # readers are shared with the user's plan object: reset first so a prior
+    # approximate query can't leak pruning into a later exact one
+    for nid in _reachable(sub, sink_id):
+        node = sub[nid]
+        if isinstance(node, logical.SourceNode) and hasattr(node.reader, "ann_prune"):
+            node.reader.ann_prune = None
+    cons = _consumers(sub, sink_id)
+    for nid in _reachable(sub, sink_id):
+        node = sub[nid]
+        info = getattr(node, "ann_info", None)
+        if info is None:
+            continue
+        # the walked chain (including the source) must feed ONLY this ANN
+        # branch — pruning a shared source would drop rows from exact branches
+        cur_id = node.parents[0]
+        ok = True
+        guard = 0
+        while guard < 16:
+            guard += 1
+            if len(cons.get(cur_id, [])) > 1:
+                ok = False
+                break
+            cur = sub[cur_id]
+            if isinstance(cur, (logical.ProjectionNode, logical.FilterNode)):
+                cur_id = cur.parents[0]
+                continue
+            break
+        if not ok:
+            continue
+        cur = sub[cur_id]
+        if isinstance(cur, logical.SourceNode) and hasattr(cur.reader, "ann_prune"):
+            cur.reader.ann_prune = (info["queries"], info["nprobe"])
 
 
 # ---------------------------------------------------------------------------

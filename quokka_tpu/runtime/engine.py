@@ -490,7 +490,7 @@ class Engine:
         state = executor.checkpoint()
         with open(self._ckpt_file(task.actor, task.channel, task.state_seq), "wb") as f:
             pickle.dump(state, f)
-        tape_len = self.store.tlen("LT", ("tape", task.actor, task.channel))
+        tape_len = self.store.tape_len(task.actor, task.channel)
         with self.store.transaction():
             self.store.tset(
                 "LCT",
@@ -502,6 +502,9 @@ class Engine:
                 (task.actor, task.channel, task.state_seq),
                 {a: dict(c) for a, c in task.input_reqs.items()},
             )
+        # events before the checkpoint position are dead: recovery always
+        # restores from this (latest) checkpoint — GC the tape prefix
+        self.store.tape_trim(task.actor, task.channel, tape_len)
 
     def simulate_failure_and_recover(self, failed: List[Tuple[int, int]]) -> None:
         """Kill the given exec (actor, channel) workers — losing executor
@@ -551,9 +554,9 @@ class Engine:
             reqs = {
                 s: dict(c) for s, c in self.store.tget("IRT", (a, ch, 0)).items()
             }
-        tape = list(self.store.tget("LT", ("tape", a, ch)) or [])
+        tape = self.store.tape_slice(a, ch, tape_pos)
         state_seq, out_seq = self._replay_tape(
-            a, ch, tape[tape_pos:], reqs, state_seq, out_seq
+            a, ch, tape, reqs, state_seq, out_seq
         )
         with self.store.transaction():
             self.store.tset("EST", (a, ch), state_seq)

@@ -64,7 +64,8 @@ class ControlStoreClient:
 
     _WRITES = {
         "set", "ntt_push", "tset", "tappend", "tdel", "sadd",
-        "ntt_remove_exec", "result_append", "heartbeat", "mailbox_push",
+        "ntt_remove_exec", "ntt_remove_channel", "tape_trim",
+        "result_append", "heartbeat", "mailbox_push",
     }
 
     def __init__(self, address: Tuple[str, int]):

@@ -159,6 +159,34 @@ class ControlStore:
         with self._lock:
             self.tables[table].pop(key, None)
 
+    # -- lineage tape GC ------------------------------------------------------
+    # Tapes grow per event for a run's whole life; checkpoints make the prefix
+    # before the checkpoint position dead.  Positions stay LOGICAL (base +
+    # list index) so LCT tape_pos values survive trimming.
+
+    def tape_len(self, actor, ch) -> int:
+        with self._lock:
+            base = self.tables["LT"].get(("tape_base", actor, ch), 0)
+            tape = self.tables["LT"].get(("tape", actor, ch))
+            return base + (0 if tape is None else len(tape))
+
+    def tape_slice(self, actor, ch, from_logical: int) -> List:
+        with self._lock:
+            base = self.tables["LT"].get(("tape_base", actor, ch), 0)
+            tape = self.tables["LT"].get(("tape", actor, ch)) or []
+            return list(tape[max(0, from_logical - base):])
+
+    def tape_trim(self, actor, ch, upto_logical: int) -> None:
+        with self._lock:
+            base = self.tables["LT"].get(("tape_base", actor, ch), 0)
+            tape = self.tables["LT"].get(("tape", actor, ch))
+            if tape is None:
+                return
+            drop = max(0, min(upto_logical - base, len(tape)))
+            if drop:
+                del tape[:drop]
+                self.tables["LT"][("tape_base", actor, ch)] = base + drop
+
     # -- set-valued tables ---------------------------------------------------
     def sadd(self, table: str, key, value=None):
         with self._lock:

@@ -1,0 +1,238 @@
+"""Object-store + REST readers (VERDICT r1 item 8): the S3 byte-range CSV /
+row-group Parquet designs run over fsspec, driven here against file:// so the
+exact cloud code path is tested without network.  REST pages come from a
+local HTTP server."""
+
+import json
+import threading
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from quokka_tpu import QuokkaContext
+
+
+@pytest.fixture(scope="module")
+def data_dir(tmp_path_factory):
+    root = tmp_path_factory.mktemp("cloud")
+    r = np.random.default_rng(5)
+    n = 20000
+    df = pd.DataFrame({
+        "k": r.integers(0, 100, n),
+        "name": np.array(["aa", "bb", "cc"])[r.integers(0, 3, n)],
+        "v": r.uniform(0, 10, n).round(4),
+    })
+    df.to_csv(root / "t.csv", index=False)
+    pq.write_table(pa.Table.from_pandas(df), root / "t.parquet",
+                   row_group_size=2048)
+    return root, df
+
+
+class TestObjectCSV:
+    def test_byte_range_csv_matches(self, data_dir):
+        root, df = data_dir
+        ctx = QuokkaContext()
+        # tiny stride -> many byte ranges; every row parsed exactly once
+        from quokka_tpu import logical
+        from quokka_tpu.dataset.cloud import InputObjectCSVDataset
+
+        reader = InputObjectCSVDataset(f"file://{root}/t.csv", stride=64 << 10)
+        s = ctx.new_stream(logical.SourceNode(reader, list(reader.schema)))
+        got = s.collect()
+        assert len(got) == len(df)
+        np.testing.assert_allclose(
+            np.sort(got.v.to_numpy(dtype=float)), np.sort(df.v.to_numpy())
+        )
+        got2 = (
+            ctx.new_stream(logical.SourceNode(
+                InputObjectCSVDataset(f"file://{root}/t.csv", stride=64 << 10),
+                list(reader.schema)))
+            .groupby("name").agg_sql("count(*) as n, sum(v) as sv").collect()
+            .sort_values("name").reset_index(drop=True)
+        )
+        exp = df.groupby("name").v.agg(["size", "sum"]).reset_index()
+        assert got2.n.tolist() == exp["size"].tolist()
+        np.testing.assert_allclose(got2.sv.to_numpy(), exp["sum"].to_numpy(), rtol=1e-9)
+
+    def test_url_routing_via_context(self, data_dir):
+        root, df = data_dir
+        got = QuokkaContext().read_csv(f"file://{root}/t.csv").collect()
+        assert len(got) == len(df)
+
+
+class TestObjectParquet:
+    def test_row_groups_and_pruning(self, data_dir):
+        root, df = data_dir
+        ctx = QuokkaContext()
+        got = (
+            ctx.read_parquet(f"file://{root}/t.parquet")
+            .filter_sql("k < 10")
+            .groupby("k").agg_sql("count(*) as n")
+            .collect().sort_values("k").reset_index(drop=True)
+        )
+        exp = df[df.k < 10].groupby("k").size().reset_index(name="n")
+        assert got.k.tolist() == exp.k.tolist()
+        assert got.n.tolist() == exp.n.tolist()
+
+
+class TestRest:
+    def test_paged_rest_reader(self, data_dir):
+        import http.server
+
+        pages = {
+            "0": [{"t": 1, "price": 10.0}, {"t": 2, "price": 11.0}],
+            "1": [{"t": 3, "price": 12.5}, {"t": 4, "price": 9.0}],
+            "2": [{"t": 5, "price": 13.0}],
+        }
+
+        class H(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                from urllib.parse import parse_qs, urlparse
+
+                q = parse_qs(urlparse(self.path).query)
+                body = json.dumps(
+                    {"data": pages.get(q.get("page", ["0"])[0], [])}
+                ).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
+        th = threading.Thread(target=srv.serve_forever, daemon=True)
+        th.start()
+        try:
+            url = f"http://127.0.0.1:{srv.server_address[1]}/ticks"
+            ctx = QuokkaContext()
+            got = (
+                ctx.read_rest(
+                    [(url, {"page": str(i)}) for i in range(3)],
+                    record_path="data",
+                )
+                .agg_sql("sum(price) as s, count(*) as n")
+                .collect()
+            )
+            assert got.n[0] == 5
+            np.testing.assert_allclose(got.s[0], 55.5)
+        finally:
+            srv.shutdown()
+
+
+class TestAnnPushdown:
+    """IVF sidecar + push_ann (the Lance vector-index role, VERDICT item 8)."""
+
+    def test_index_prunes_and_recall_holds(self, tmp_path):
+        r = np.random.default_rng(0)
+        # clustered vectors so IVF cells align with row groups poorly enough
+        # to be honest but well enough to prune
+        n, dim = 8000, 16
+        centers = r.normal(size=(8, dim)) * 5
+        assign = r.integers(0, 8, n)
+        vecs = centers[assign] + r.normal(size=(n, dim)) * 0.3
+        t = pa.table({
+            "id": np.arange(n, dtype=np.int64),
+            "vec": pa.FixedSizeListArray.from_arrays(
+                pa.array(vecs.astype(np.float32).reshape(-1)), dim
+            ),
+        })
+        path = str(tmp_path / "vecs.parquet")
+        pq.write_table(t, path, row_group_size=512)
+
+        from quokka_tpu.dataset.vector import build_vector_index, prune_row_groups
+        build_vector_index(path, "vec", n_cells=16, iters=5)
+
+        queries = centers[:3] + r.normal(size=(3, dim)) * 0.1
+        keep = prune_row_groups(path, queries, nprobe=2)
+        assert keep is not None and 0 < len(keep) <= 16
+
+        ctx = QuokkaContext()
+        exact = (
+            ctx.read_parquet(path)
+            .nearest_neighbors(queries, "vec", k=5, payload=["id"])
+            .collect()
+        )
+        approx = (
+            ctx.read_parquet(path)
+            .nearest_neighbors(queries, "vec", k=5, payload=["id"],
+                               approximate=True, nprobe=4)
+            .collect()
+        )
+        assert len(approx) == len(exact) == 15
+        # clustered data + generous nprobe: recall should be near-perfect
+        overlap = len(set(map(tuple, approx[["query_idx", "id"]].to_numpy()))
+                      & set(map(tuple, exact[["query_idx", "id"]].to_numpy())))
+        assert overlap >= 12, overlap
+
+    def test_ann_prune_does_not_leak_to_exact_queries(self, tmp_path):
+        r = np.random.default_rng(1)
+        n, dim = 2000, 8
+        vecs = r.normal(size=(n, dim)).astype(np.float32)
+        t = pa.table({
+            "id": np.arange(n, dtype=np.int64),
+            "vec": pa.FixedSizeListArray.from_arrays(pa.array(vecs.reshape(-1)), dim),
+        })
+        path = str(tmp_path / "v.parquet")
+        pq.write_table(t, path, row_group_size=256)
+        from quokka_tpu.dataset.vector import build_vector_index
+        build_vector_index(path, "vec", n_cells=8, iters=3)
+        q = vecs[:2]
+        ctx = QuokkaContext()
+        src = ctx.read_parquet(path)
+        _ = src.nearest_neighbors(q, "vec", 3, payload=["id"],
+                                  approximate=True, nprobe=1).collect()
+        # the SAME source re-queried exactly must scan everything again
+        exact = src.nearest_neighbors(q, "vec", 3, payload=["id"]).collect()
+        import jax.numpy as jnp
+        xn = vecs / np.linalg.norm(vecs, axis=1, keepdims=True)
+        qn = q / np.linalg.norm(q, axis=1, keepdims=True)
+        sims = qn @ xn.T
+        for qi in range(2):
+            top = set(np.argsort(-sims[qi])[:3].tolist())
+            got = set(exact[exact.query_idx == qi].id.tolist())
+            assert got == top
+
+
+class TestTornRows:
+    def test_row_longer_than_stride(self, tmp_path):
+        # a row spanning MULTIPLE byte ranges must be parsed exactly once,
+        # by the range owning its first byte
+        big = "x" * 5000
+        lines = ["a,b", f"1,{big}", "2,yy", f"3,{'z' * 4000}", "4,w"]
+        p = tmp_path / "wide.csv"
+        p.write_text("\n".join(lines) + "\n")
+        from quokka_tpu import logical
+        from quokka_tpu.dataset.cloud import InputObjectCSVDataset
+
+        reader = InputObjectCSVDataset(f"file://{p}", stride=1000)
+        ctx = QuokkaContext()
+        got = (
+            ctx.new_stream(logical.SourceNode(reader, list(reader.schema)))
+            .collect()
+            .sort_values("a")
+            .reset_index(drop=True)
+        )
+        assert got.a.tolist() == [1, 2, 3, 4]
+        assert got.b.tolist() == [big, "yy", "z" * 4000, "w"]
+
+    def test_type_pinning_across_ranges(self, tmp_path):
+        # numeric-looking prefix + text later: types must not flip per range
+        rows = [f"{i},{i}" for i in range(3000)] + ["9999,not_a_number"]
+        p = tmp_path / "mix.csv"
+        p.write_text("a,b\n" + "\n".join(rows) + "\n")
+        from quokka_tpu import logical
+        from quokka_tpu.dataset.cloud import InputObjectCSVDataset
+
+        reader = InputObjectCSVDataset(f"file://{p}", stride=4 << 10)
+        ctx = QuokkaContext()
+        got = ctx.new_stream(
+            logical.SourceNode(reader, list(reader.schema))
+        ).collect()
+        assert len(got) == 3001
+        assert "not_a_number" in set(got.b.astype(str))

@@ -139,3 +139,29 @@ def test_parallel_range_sort_with_spill(spill_small, tmp_path):
     assert len(got) == n
     got_desc = ctx.read_parquet(p).sort("x", descending=[True]).collect()
     assert (np.diff(got_desc.x.to_numpy()) <= 0).all()
+
+
+def test_grace_left_join_probe_only_partitions(spill_small):
+    # build keys hash into FEW partitions (all equal mod small set) while
+    # probes cover every partition: probe-only partitions must emit typed
+    # null payloads, not the degraded float-NaN path
+    r = np.random.default_rng(11)
+    build = pa.table({
+        "k": (np.arange(5000, dtype=np.int64) * 4),  # clusters of hash cells
+        "name": np.array([f"s{i % 5}" for i in range(5000)]),
+    })
+    probe = pa.table({
+        "k": r.integers(0, 20000, 15000).astype(np.int64),
+        "v": r.uniform(0, 1, 15000).round(5),
+    })
+    ctx = QuokkaContext()
+    got = (
+        ctx.from_arrow(probe)
+        .join(ctx.from_arrow(build), on="k", how="left")
+        .collect()
+    )
+    exp = probe.to_pandas().merge(build.to_pandas(), on="k", how="left")
+    assert len(got) == len(exp)
+    assert got.name.isna().sum() == exp.name.isna().sum()
+    matched = got[~got.name.isna()]
+    assert set(matched.name) <= {f"s{i}" for i in range(5)}

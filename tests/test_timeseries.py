@@ -351,3 +351,36 @@ class TestAsofForward:
         # t=1 -> quote 4 (1.0); t=5 -> quote 7 (2.0); t=9 -> unmatched/dropped
         assert got.time.tolist() == [1, 5]
         assert got.bid.tolist() == [1.0, 2.0]
+
+
+class TestSlidingMinMax:
+    def test_rolling_min_max_matches_pandas(self):
+        r = np.random.default_rng(8)
+        n = 4000
+        t = pa.table({
+            "time": np.sort(r.choice(100_000, n, replace=False)).astype(np.int64),
+            "sym": np.array(["A", "B"])[r.integers(0, 2, n)],
+            "px": r.uniform(10, 20, n).round(4),
+        })
+        size = 500
+        ctx = QuokkaContext()
+        s = ctx.from_arrow_sorted(t, sorted_by="time")
+        got = s.window_agg(
+            SlidingWindow(size),
+            "min(px) as lo, max(px) as hi, sum(px) as tot",
+            by="sym",
+        ).collect()
+        df = t.to_pandas()
+        exp_rows = []
+        for sym, g in df.groupby("sym"):
+            g = g.sort_values("time")
+            for _, row in g.iterrows():
+                w = g[(g.time >= row.time - size) & (g.time <= row.time)]
+                exp_rows.append((sym, row.time, w.px.min(), w.px.max(), w.px.sum()))
+        exp = pd.DataFrame(exp_rows, columns=["sym", "time", "lo", "hi", "tot"])
+        got = got.sort_values(["sym", "time"]).reset_index(drop=True)
+        exp = exp.sort_values(["sym", "time"]).reset_index(drop=True)
+        assert len(got) == len(exp)
+        np.testing.assert_allclose(got.lo.to_numpy(), exp.lo.to_numpy(), rtol=1e-9)
+        np.testing.assert_allclose(got.hi.to_numpy(), exp.hi.to_numpy(), rtol=1e-9)
+        np.testing.assert_allclose(got.tot.to_numpy(), exp.tot.to_numpy(), rtol=1e-9)
