@@ -162,6 +162,36 @@ class DataStream:
             )
         )
 
+    def cogroup(self, right: "DataStream", fn, new_schema, on=None,
+                left_on=None, right_on=None) -> "DataStream":
+        """Group BOTH streams by key and run fn(key, left_df, right_df) per
+        distinct key (host DataFrames; either side may be empty, with the
+        stream's columns) — the reference's cogroup (datastream.py:2073).
+        Keys are colocated by hash-partitioned edges; fn is a host UDF, so
+        this path is embedded-engine only (not picklable)."""
+        from quokka_tpu.executors.sql_execs import CogroupExecutor
+        from quokka_tpu.target_info import HashPartitioner
+
+        if on is not None:
+            left_on = right_on = on
+        if left_on not in self.schema:
+            raise ValueError(f"cogroup key {left_on} not in {self.schema}")
+        if right_on not in right.schema:
+            raise ValueError(f"cogroup key {right_on} not in {right.schema}")
+        node = logical.StatefulNode(
+            [self.node_id, right.node_id],
+            list(new_schema),
+            functools.partial(
+                CogroupExecutor, left_on, right_on, fn, list(new_schema),
+                list(self.schema), list(right.schema),
+            ),
+            partitioners={
+                0: HashPartitioner([left_on]),
+                1: HashPartitioner([right_on]),
+            },
+        )
+        return self._child(node)
+
     def clip(self, limit: int) -> "DataStream":
         return self.head(limit)
 
@@ -352,7 +382,7 @@ class DataStream:
         out_schema = ["quantile", column]
         local = logical.StatefulNode(
             [self.node_id],
-            out_schema,
+            ["__td_mean", "__td_weight"],  # serialized t-digest centroids
             functools.partial(ReservoirQuantileExecutor, column, quantiles),
         )
         local_id = self.ctx.add_node(local)
@@ -718,3 +748,4 @@ class OrderedStream(DataStream):
         ds = self.stateful_transform(executor, new_schema, by=by)
         ds._node.sorted_by = self.sorted_by
         return OrderedStream(self.ctx, ds.node_id)
+

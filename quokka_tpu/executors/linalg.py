@@ -103,63 +103,66 @@ class CombineGramianExecutor(Executor):
 
 
 class ReservoirQuantileExecutor(Executor):
-    """Approximate quantiles by uniform reservoir sampling per channel; the
-    final quantile is computed on the merged reservoir."""
+    """Per-channel MERGEABLE quantile sketch (merging t-digest,
+    ops/tdigest.py — the ldbpy t-digest role in the reference).  Emits the
+    serialized digest; the combine stage merges digests exactly, so results
+    are partitioning-independent (the round-1 reservoir version averaged
+    per-channel quantiles).  Name kept for API stability."""
 
-    def __init__(self, column: str, quantiles: Sequence[float], reservoir: int = 65_536,
-                 seed: int = 0):
+    def __init__(self, column: str, quantiles: Sequence[float],
+                 compression: float = 200.0, **_legacy):
+        from quokka_tpu.ops.tdigest import TDigest
+
         self.column = column
         self.quantiles = list(quantiles)
-        self.cap = reservoir
-        self.rng = np.random.default_rng(seed)
-        self.sample = np.zeros(0, dtype=np.float64)
-        self.seen = 0
+        self.digest = TDigest(compression)
 
     def execute(self, batches, stream_id, channel):
         for b in batches:
             if b is None or b.count_valid() == 0:
                 continue
             x = np.asarray(b.columns[self.column].data)[np.asarray(b.valid)]
-            x = x.astype(np.float64)
-            if len(self.sample) < self.cap:
-                take = min(self.cap - len(self.sample), len(x))
-                self.sample = np.concatenate([self.sample, x[:take]])
-                x = x[take:]
-                self.seen += take
-            for v in x:  # classic reservoir replacement
-                self.seen += 1
-                j = self.rng.integers(0, self.seen)
-                if j < self.cap:
-                    self.sample[j] = v
+            self.digest.add(x.astype(np.float64))
 
     def done(self, channel):
-        if self.seen == 0:
+        means, weights = self.digest.to_arrays()
+        if len(means) == 0:
             return None
-        qs = np.quantile(self.sample, self.quantiles)
         return bridge.arrow_to_device(
-            pa.table({"quantile": np.array(self.quantiles), self.column: qs})
+            pa.table({"__td_mean": means, "__td_weight": weights})
         )
 
 
 class CombineQuantileExecutor(Executor):
-    """Merge per-channel reservoirs is approximated by re-sampling the emitted
-    per-channel quantiles weighted equally (adequate for the advertised
-    approximate semantics); single-channel plans skip this."""
+    """Merge the per-channel t-digests EXACTLY, then evaluate the quantiles
+    on the combined sketch — no partitioning dependence."""
 
-    def __init__(self, column: str, quantiles: Sequence[float]):
+    def __init__(self, column: str, quantiles: Sequence[float],
+                 compression: float = 200.0):
+        from quokka_tpu.ops.tdigest import TDigest
+
         self.column = column
         self.quantiles = list(quantiles)
-        self.parts: List[DeviceBatch] = []
+        self.digest = TDigest(compression)
+        self.any = False
 
     def execute(self, batches, stream_id, channel):
-        self.parts.extend(b for b in batches if b is not None)
+        from quokka_tpu.ops.tdigest import TDigest
+
+        for b in batches:
+            if b is None or b.count_valid() == 0:
+                continue
+            t = bridge.device_to_arrow(b)
+            self.digest.merge(TDigest.from_arrays(
+                t.column("__td_mean").to_numpy(zero_copy_only=False),
+                t.column("__td_weight").to_numpy(zero_copy_only=False),
+            ))
+            self.any = True
 
     def done(self, channel):
-        if not self.parts:
+        if not self.any:
             return None
-        import pandas as pd
-
-        df = pd.concat([bridge.to_pandas(b) for b in self.parts], ignore_index=True)
-        self.parts = []
-        out = df.groupby("quantile")[self.column].mean().reset_index()
-        return bridge.arrow_to_device(pa.Table.from_pandas(out, preserve_index=False))
+        qs = [self.digest.quantile(q) for q in self.quantiles]
+        return bridge.arrow_to_device(
+            pa.table({"quantile": np.array(self.quantiles), self.column: np.array(qs)})
+        )

@@ -734,3 +734,66 @@ class SortExecutor(Executor):
                         pos_arr[:cut][run_arr[:cut] == r].max() >= p
                     ):
                         load(i)
+
+
+class CogroupExecutor(Executor):
+    """Cogroup two key-partitioned streams (reference datastream.py:2073):
+    buffer both sides, then per distinct key call fn(key, left_df, right_df)
+    with host DataFrames (either may be empty) and emit the concatenated
+    results.  Keys are colocated per channel by the hash-partitioned edges."""
+
+    def __init__(self, left_on: str, right_on: str, fn: Callable,
+                 out_schema: Sequence[str],
+                 left_schema: Optional[Sequence[str]] = None,
+                 right_schema: Optional[Sequence[str]] = None):
+        self.left_on = left_on
+        self.right_on = right_on
+        self.fn = fn
+        self.out_schema = list(out_schema)
+        # plan-time schemas: a channel that received zero rows on one side
+        # must still hand fn an empty frame WITH that side's columns
+        self.left_schema = list(left_schema) if left_schema else None
+        self.right_schema = list(right_schema) if right_schema else None
+        self.left_parts: List[DeviceBatch] = []
+        self.right_parts: List[DeviceBatch] = []
+
+    def execute(self, batches, stream_id, channel):
+        live = [b for b in batches if b is not None and b.count_valid() > 0]
+        (self.left_parts if stream_id == 0 else self.right_parts).extend(live)
+        return None
+
+    def done(self, channel):
+        import pandas as pd
+        import pyarrow as pa
+
+        def to_df(parts):
+            if not parts:
+                return None
+            return pd.concat(
+                [bridge.to_pandas(b) for b in parts], ignore_index=True
+            )
+
+        ldf, rdf = to_df(self.left_parts), to_df(self.right_parts)
+        self.left_parts, self.right_parts = [], []
+        if ldf is None and rdf is None:
+            return None
+        keys = set()
+        if ldf is not None:
+            keys |= set(ldf[self.left_on].dropna().unique().tolist())
+        if rdf is not None:
+            keys |= set(rdf[self.right_on].dropna().unique().tolist())
+        outs = []
+        empty_l = (ldf.iloc[0:0] if ldf is not None
+                   else pd.DataFrame(columns=self.left_schema or []))
+        empty_r = (rdf.iloc[0:0] if rdf is not None
+                   else pd.DataFrame(columns=self.right_schema or []))
+        for k in sorted(keys):
+            lg = ldf[ldf[self.left_on] == k] if ldf is not None else empty_l
+            rg = rdf[rdf[self.right_on] == k] if rdf is not None else empty_r
+            out = self.fn(k, lg, rg)
+            if out is not None and len(out):
+                outs.append(out)
+        if not outs:
+            return None
+        res = pd.concat(outs, ignore_index=True)[self.out_schema]
+        return bridge.arrow_to_device(pa.Table.from_pandas(res, preserve_index=False))

@@ -224,6 +224,7 @@ def worker_main(spec_bytes: bytes, store_addr, worker_id: int, owned):
         try:
             w.run_worker()
         finally:
+            w._shutdown_prefetch()
             server.close()
     except Exception:
         import traceback

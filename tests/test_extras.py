@@ -117,3 +117,91 @@ class TestNative:
             pytest.skip("native lib not built")
         exp = [fnv1a64(v) if v is not None else 0 for v in vals]
         np.testing.assert_array_equal(out, np.array(exp, dtype=np.uint64))
+
+
+class TestCogroup:
+    def test_cogroup_udf(self, ctx):
+        import pandas as pd
+        import pyarrow as pa
+
+        a = pa.table({"k": [1, 1, 2, 3], "v": [1.0, 2.0, 3.0, 4.0]})
+        b = pa.table({"k": [1, 2, 2, 4], "w": [10.0, 20.0, 30.0, 40.0]})
+
+        def f(k, l, r):
+            return pd.DataFrame({
+                "k": [k], "nv": [len(l)], "nw": [len(r)],
+                "ratio": [(l.v.sum() + 1) / (r.w.sum() + 1)],
+            })
+
+        got = (
+            ctx.from_arrow(a)
+            .cogroup(ctx.from_arrow(b), f, ["k", "nv", "nw", "ratio"], on="k")
+            .collect()
+            .sort_values("k")
+            .reset_index(drop=True)
+        )
+        assert got.k.tolist() == [1, 2, 3, 4]
+        assert got.nv.tolist() == [2, 1, 1, 0]
+        assert got.nw.tolist() == [1, 2, 0, 1]
+
+
+class TestTDigest:
+    def test_mergeable_accuracy(self):
+        import numpy as np
+
+        from quokka_tpu.ops.tdigest import TDigest
+
+        r = np.random.default_rng(3)
+        x = np.concatenate([r.normal(size=50000), r.exponential(2, 50000)])
+        parts = [TDigest() for _ in range(4)]
+        for i, p in enumerate(parts):
+            p.add(x[i::4])
+        d = parts[0]
+        for p in parts[1:]:
+            d.merge(p)
+        for q in (0.05, 0.5, 0.95, 0.99):
+            exact = np.quantile(x, q)
+            est = d.quantile(q)
+            denom = max(abs(exact), 0.1)
+            assert abs(est - exact) / denom < 0.02, (q, est, exact)
+
+    def test_quantile_query_partition_independent(self, ctx):
+        import numpy as np
+        import pyarrow as pa
+
+        r = np.random.default_rng(4)
+        x = r.normal(size=30000)
+        t = pa.table({"v": x})
+        got = ctx.from_arrow(t).approximate_quantile("v", [0.25, 0.5, 0.75]).collect()
+        got = got.sort_values("quantile").reset_index(drop=True)
+        exp = np.quantile(x, [0.25, 0.5, 0.75])
+        np.testing.assert_allclose(got.v.to_numpy(), exp, atol=0.02)
+
+    def test_cogroup_one_sided_channels(self):
+        # channels whose hash partition receives rows on only ONE side must
+        # still hand fn a schema'd empty frame for the other side
+        import pandas as pd
+        import pyarrow as pa
+
+        from quokka_tpu import QuokkaContext
+
+        ctx4 = QuokkaContext(exec_channels=4)
+        left = pa.table({"k": [1], "v": [7.0]})
+        right = pa.table({"k": list(range(20)), "w": [float(i) for i in range(20)]})
+
+        def f(k, l, r):
+            return pd.DataFrame({
+                "k": [k], "sv": [l["v"].sum() if len(l) else 0.0],
+                "sw": [r["w"].sum() if len(r) else 0.0],
+            })
+
+        got = (
+            ctx4.from_arrow(left)
+            .cogroup(ctx4.from_arrow(right), f, ["k", "sv", "sw"], on="k")
+            .collect()
+            .sort_values("k")
+            .reset_index(drop=True)
+        )
+        assert len(got) == 20
+        assert got[got.k == 1].sv.iloc[0] == 7.0
+        assert got.sw.sum() == sum(range(20))
