@@ -116,7 +116,10 @@ def evaluate_predicate(e: Expr, batch: DeviceBatch) -> jnp.ndarray:
 
 
 def evaluate_to_column(e: Expr, batch: DeviceBatch):
-    v = evaluate(e, batch)
+    return value_to_column(evaluate(e, batch), batch)
+
+
+def value_to_column(v, batch: DeviceBatch):
     if isinstance(v, (NumCol, StrCol)):
         return v
     if isinstance(v, _DateScalar):
@@ -613,20 +616,20 @@ def _dt_field(e: DtField, batch: DeviceBatch):
 
 
 def _case(e: Case, batch: DeviceBatch):
+    # string-valued CASE: any string branch routes to the dictionary path
+    raw_vals = [evaluate(v, batch) for _, v in e.whens]
+    raw_default = evaluate(e.default, batch) if e.default is not None else None
+    if any(isinstance(v, (StrCol, str)) for v in raw_vals + [raw_default]):
+        return _case_string(e, batch, raw_vals, raw_default)
+    # numeric path: reuse the already-evaluated branch values (a second
+    # evaluate() would re-run every branch subtree on device)
     default = (
-        evaluate_to_column(e.default, batch)
-        if e.default is not None
+        value_to_column(raw_default, batch)
+        if raw_default is not None
         else NumCol(jnp.full(batch.padded_len, jnp.nan, dtype=config.float_dtype()), "f")
     )
-    if isinstance(default, StrCol):
-        raise CompileError("string-valued CASE (todo)")
-    conds, vals = [], []
-    for cond, val in e.whens:
-        conds.append(evaluate_predicate(cond, batch))
-        vcol = evaluate_to_column(val, batch)
-        if isinstance(vcol, StrCol):
-            raise CompileError("string-valued CASE (todo)")
-        vals.append(vcol)
+    conds = [evaluate_predicate(cond, batch) for cond, _ in e.whens]
+    vals = [value_to_column(v, batch) for v in raw_vals]
     # promote all branches to a common dtype before any where()
     dtype = jnp.result_type(default.data, *(v.data for v in vals))
     out = default.data.astype(dtype)
@@ -634,6 +637,46 @@ def _case(e: Case, batch: DeviceBatch):
     for c, vcol in zip(reversed(conds), reversed(vals)):
         out = jnp.where(c, vcol.data.astype(dtype), out)
     return NumCol(out, kind)
+
+
+def _case_string(e: Case, batch: DeviceBatch, raw_vals, raw_default):
+    """String-valued CASE: merge the branch dictionaries, pick codes with
+    nested where (the string work stays host-side over small dictionaries;
+    per-row selection is int32 code arithmetic on device)."""
+    from quokka_tpu.ops import bridge
+
+    n = batch.padded_len
+    branches = list(raw_vals) + ([raw_default] if raw_default is not None else [])
+    dicts = []
+    for v in branches:
+        if isinstance(v, StrCol):
+            dicts.append(v.dictionary)
+        elif isinstance(v, str):
+            dicts.append(StringDict(np.array([v], dtype=object)))
+        elif v is None:
+            dicts.append(StringDict(np.array([None], dtype=object)))
+        else:
+            raise CompileError("CASE mixes string and non-string branches")
+    merged, remaps = bridge.merge_dicts(dicts)
+
+    def codes_of(v, remap):
+        if isinstance(v, StrCol):
+            if remap is None:
+                return v.codes
+            g = jnp.asarray(remap)[jnp.maximum(v.codes, 0)]
+            return jnp.where(v.codes < 0, -1, g)
+        code = 0 if remap is None else int(remap[0])
+        return jnp.full(n, code, dtype=jnp.int32)
+
+    if raw_default is not None:
+        out = codes_of(raw_default, remaps[-1])
+    else:
+        out = jnp.full(n, -1, dtype=jnp.int32)  # ELSE missing -> null
+    conds = [evaluate_predicate(c, batch) for c, _ in e.whens]
+    for cond, v, remap in zip(reversed(conds), reversed(raw_vals),
+                              reversed(remaps[: len(raw_vals)])):
+        out = jnp.where(cond, codes_of(v, remap), out)
+    return StrCol(out, merged)
 
 
 def _cast(e: Cast, batch: DeviceBatch):
@@ -663,8 +706,69 @@ def _cast(e: Cast, batch: DeviceBatch):
         if isinstance(v, NumCol):
             return NumCol(v.data.astype(jnp.int32), "d")
     if to.startswith(("varchar", "string", "text")):
-        raise CompileError("cast to string (todo)")
+        return _cast_to_string(v, batch)
     raise CompileError(f"cast to {to}")
+
+
+def _cast_to_string(v, batch: DeviceBatch) -> StrCol:
+    """Numeric/date -> dictionary-encoded string.  Costs one host sync per
+    batch (string materialization is host work by design); distinct values
+    become the dictionary, rows gather by code."""
+    if isinstance(v, StrCol):
+        return v
+    if isinstance(v, str):
+        return StrCol(
+            jnp.zeros(batch.padded_len, dtype=jnp.int32),
+            StringDict(np.array([v], dtype=object)),
+        )
+    if isinstance(v, bool):
+        # match the bool COLUMN stringification ("true"/"false"), not str(True)
+        return StrCol(
+            jnp.zeros(batch.padded_len, dtype=jnp.int32),
+            StringDict(np.array(["true" if v else "false"], dtype=object)),
+        )
+    if isinstance(v, (int, float)):
+        return StrCol(
+            jnp.zeros(batch.padded_len, dtype=jnp.int32),
+            StringDict(np.array([str(v)], dtype=object)),
+        )
+    if not isinstance(v, NumCol):
+        raise CompileError(f"cast to string from {type(v).__name__}")
+    from quokka_tpu.ops import timewide
+    from quokka_tpu.ops.batch import null_mask
+
+    # stringify only VALID, non-null rows: padded/invalid slots hold garbage
+    # that would bloat the dictionary and waste host time
+    valid = np.asarray(batch.valid)
+    nm = np.asarray(null_mask(v))
+    live = valid & ~nm
+    idx = np.nonzero(live)[0]
+    if v.kind == "d":
+        days = np.asarray(v.data)[idx].astype("datetime64[D]")
+        host = np.array([str(x) for x in days], dtype=object)
+    elif v.kind == "t" or v.hi is not None:
+        vals = timewide.host_i64(v, jnp.asarray(live))
+        if v.kind == "t":
+            unit = v.unit or "us"
+            host = np.array(
+                [str(x) for x in vals.astype(f"datetime64[{unit}]")], dtype=object
+            )
+        else:
+            host = np.array([str(int(x)) for x in vals], dtype=object)
+    elif v.kind == "b":
+        host = np.array(
+            ["true" if x else "false" for x in np.asarray(v.data)[idx]], dtype=object
+        )
+    else:
+        data = np.asarray(v.data)[idx]
+        if v.kind == "f":
+            host = np.array([str(float(x)) for x in data], dtype=object)
+        else:
+            host = np.array([str(int(x)) for x in data], dtype=object)
+    uniq, live_codes = np.unique(host, return_inverse=True)
+    codes = np.full(batch.padded_len, -1, dtype=np.int32)
+    codes[idx] = live_codes.astype(np.int32)
+    return StrCol(jnp.asarray(codes), StringDict(uniq.astype(object)))
 
 
 def _func(e: Func, batch: DeviceBatch):

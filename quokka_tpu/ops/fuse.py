@@ -52,6 +52,8 @@ def _is_string_dependent(e: Expr, batch: DeviceBatch) -> bool:
     """Does evaluating e require dictionary VALUES (host data)?"""
     if isinstance(e, (StrOp,)):
         return True
+    if _is_string_cast(e):
+        return True
     if isinstance(e, UnaryOp) and e.op == "not":
         # bind the whole NOT subtree, not just its string child: evaluate()'s
         # 3VL null guard lives inside the NOT handling, and `not __bound`
@@ -67,11 +69,19 @@ def _is_string_dependent(e: Expr, batch: DeviceBatch) -> bool:
     return False
 
 
+def _is_string_cast(e: Expr) -> bool:
+    """cast(x as varchar) builds a dictionary on the HOST — it can never run
+    inside a traced (fused) program, even over numeric inputs."""
+    return isinstance(e, Cast) and e.to.startswith(("varchar", "string", "text"))
+
+
 def _refs_string(e: Expr, batch: DeviceBatch) -> bool:
     if isinstance(e, ColRef):
         return isinstance(batch.columns.get(e.name), StrCol)
     if isinstance(e, Literal):
         return isinstance(e.value, str)
+    if _is_string_cast(e):
+        return True
     return any(_refs_string(c, batch) for c in e.children())
 
 

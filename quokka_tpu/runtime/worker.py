@@ -224,6 +224,10 @@ def worker_main(spec_bytes: bytes, store_addr, worker_id: int, owned):
         try:
             w.run_worker()
         finally:
+            try:
+                w._flush_metrics()
+            except Exception:
+                pass  # a dead coordinator store must not block shutdown
             w._shutdown_prefetch()
             server.close()
     except Exception:

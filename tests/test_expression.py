@@ -196,3 +196,71 @@ class TestAggPlan:
 
 def make_batch_table(table):
     return table
+
+
+class TestStringCaseAndCast:
+    def test_string_valued_case(self):
+        import pyarrow as pa
+
+        from quokka_tpu import QuokkaContext
+
+        t = pa.table({"x": [1, 5, 9, None], "s": ["lo", "hi", "hi", None]})
+        out = QuokkaContext().from_arrow(t).with_columns_sql(
+            "case when x < 3 then 'small' when x < 7 then s else 'big' end as bucket"
+        ).collect()
+        # null x: both predicates false (3VL) -> ELSE branch
+        assert out["bucket"].tolist() == ["small", "hi", "big", "big"]
+
+    def test_string_case_null_else(self):
+        import pyarrow as pa
+
+        from quokka_tpu import QuokkaContext
+
+        t = pa.table({"x": [1, 9]})
+        out = QuokkaContext().from_arrow(t).with_columns_sql(
+            "case when x < 3 then 'small' end as bucket"
+        ).collect()
+        assert out["bucket"].tolist()[0] == "small"
+        assert out["bucket"].isna().tolist() == [False, True]
+
+    def test_cast_to_string(self):
+        import pyarrow as pa
+
+        from quokka_tpu import QuokkaContext
+
+        t = pa.table({
+            "x": [1, 5, None],
+            "f": [1.5, 2.25, 3.0],
+            "d": pa.array([10957, None, 11100], type=pa.int32()).cast(pa.date32()),
+        })
+        out = QuokkaContext().from_arrow(t).with_columns_sql(
+            "cast(x as varchar) as xs, cast(f as varchar) as fs, "
+            "cast(d as varchar) as ds"
+        ).collect()
+        assert out["xs"].tolist()[:2] == ["1", "5"] and out["xs"].isna().iloc[2]
+        assert out["fs"].tolist() == ["1.5", "2.25", "3.0"]
+        assert out["ds"].iloc[0] == "2000-01-01" and out["ds"].isna().iloc[1]
+
+    def test_string_case_groupby(self):
+        import numpy as np
+        import pyarrow as pa
+
+        from quokka_tpu import QuokkaContext
+
+        r = np.random.default_rng(0)
+        t = pa.table({"x": r.integers(0, 100, 5000), "v": r.uniform(0, 1, 5000)})
+        got = (
+            QuokkaContext().from_arrow(t)
+            .with_columns_sql(
+                "case when x < 30 then 'low' when x < 70 then 'mid' "
+                "else 'high' end as band"
+            )
+            .groupby("band").agg_sql("count(*) as n, sum(v) as sv")
+            .collect().sort_values("band").reset_index(drop=True)
+        )
+        df = t.to_pandas()
+        df["band"] = np.where(df.x < 30, "low", np.where(df.x < 70, "mid", "high"))
+        exp = df.groupby("band").v.agg(["size", "sum"]).reset_index()
+        assert got.band.tolist() == exp.band.tolist()
+        assert got.n.tolist() == exp["size"].tolist()
+        np.testing.assert_allclose(got.sv.to_numpy(), exp["sum"].to_numpy(), rtol=1e-9)

@@ -205,3 +205,23 @@ class TestTDigest:
         assert len(got) == 20
         assert got[got.k == 1].sv.iloc[0] == 7.0
         assert got.sw.sum() == sum(range(20))
+
+
+class TestMetrics:
+    def test_progress_counters(self):
+        import numpy as np
+        import pyarrow as pa
+
+        from quokka_tpu import QuokkaContext
+
+        r = np.random.default_rng(0)
+        t = pa.table({"k": r.integers(0, 10, 5000).astype(np.int64),
+                      "v": r.uniform(0, 1, 5000)})
+        ctx = QuokkaContext()
+        got = ctx.from_arrow(t).groupby("k").agg_sql("sum(v) as s").collect()
+        assert len(got) == 10
+        m = ctx.latest_graph.metrics()
+        assert m, "no metrics flushed"
+        input_rows = sum(v["rows"] for k, v in m.items() if v["bytes"] > 0)
+        assert input_rows == 5000
+        assert all(v["tasks"] > 0 for v in m.values())
