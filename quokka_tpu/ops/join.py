@@ -132,6 +132,25 @@ def _mm_expand(match_count, offsets, build_pos_sorted, rp, total, out_padded: in
     return probe_idx, build_idx, out_valid
 
 
+def mm_plan_for(limbs, valid, p: int, how: str, probe_valid=None):
+    """Shared many-to-many planning for the embedded AND mesh join paths:
+    per-probe match counts (left joins get a synthetic row for unmatched
+    probes), total output rows, and the sorted-build expansion tables."""
+    match_count, total, offsets, build_pos_sorted, rp = _mm_plan(tuple(limbs), valid, p)
+    if how == "left":
+        pv = valid[:p] if probe_valid is None else probe_valid
+        match_count = jnp.where(pv & (match_count == 0), 1, match_count)
+        total = jnp.sum(match_count)
+    return match_count, total, offsets, build_pos_sorted, rp
+
+
+def mm_unmatched(limbs, valid, p: int, probe_idx, match_count):
+    """Output-aligned mask of left-join rows with no real build match."""
+    return (match_count[probe_idx] == 1) & _is_unmatched_gather(
+        tuple(limbs), valid, p, probe_idx
+    )
+
+
 def hash_join_general(
     probe: DeviceBatch,
     build: DeviceBatch,
@@ -143,15 +162,14 @@ def hash_join_general(
     """Many-to-many join.  One host sync per batch for the output bucket."""
     p = probe.padded_len
     limbs, valid = _concat_limbs(probe, build, probe_keys, build_keys)
-    match_count, total, offsets, build_pos_sorted, rp = _mm_plan(tuple(limbs), valid, p)
     if how in ("semi", "anti"):
+        match_count, *_ = _mm_plan(tuple(limbs), valid, p)
         matched = match_count > 0
         mask = matched if how == "semi" else (probe.valid & ~matched)
         return kernels.apply_mask(probe, mask)
-    if how == "left":
-        # unmatched probe rows still emit one row
-        match_count = jnp.where(probe.valid & (match_count == 0), 1, match_count)
-        total = jnp.sum(match_count)
+    match_count, total, offsets, build_pos_sorted, rp = mm_plan_for(
+        limbs, valid, p, how, probe_valid=probe.valid
+    )
     ntotal = int(total)  # host sync: pick output bucket
     out_padded = config.bucket_size(ntotal)
     probe_idx, build_idx, out_valid = _mm_expand(
@@ -162,9 +180,7 @@ def hash_join_general(
         cols[name] = c.take(probe_idx)
     unmatched = None
     if how == "left":
-        unmatched = (match_count[probe_idx] == 1) & _is_unmatched_gather(
-            limbs, valid, p, probe_idx
-        )
+        unmatched = mm_unmatched(limbs, valid, p, probe_idx, match_count)
     for name in build_payload:
         c = build.columns[name]
         taken = c.take(build_idx)

@@ -184,6 +184,9 @@ def mesh_groupby(
 # ---------------------------------------------------------------------------
 
 
+MM_CAPACITY_FACTOR = 4  # per-device output cap = factor * local probe rows
+
+
 def mesh_join(
     mesh: Mesh,
     axis: str,
@@ -193,10 +196,14 @@ def mesh_join(
     right_on: List[str],
     how: str,
     payload: List[str],
+    unique: bool = True,
 ) -> DeviceBatch:
-    """PK join (unique build keys) over the mesh: both sides key-shuffled with
-    one all_to_all each, then the embedded engine's rank-join kernel per
-    shard (ops/join._pk_match — probe-aligned, static shapes)."""
+    """Join over the mesh: both sides key-shuffled with one all_to_all each,
+    then the embedded engine's rank-join kernels per shard.  unique=True uses
+    the probe-aligned PK kernel (_pk_match); unique=False runs the
+    many-to-many kernel with a STATIC per-device output capacity — overflow
+    is psum-counted and raises MeshUnsupported so the caller falls back to
+    the embedded engine (shapes inside shard_map cannot be data-dependent)."""
     pl = key_limbs(probe, left_on)
     bl = key_limbs(build, right_on)
     if len(pl) != len(bl):
@@ -227,9 +234,28 @@ def mesh_join(
             jnp.concatenate([a, b.astype(a.dtype)]) for a, b in zip(spl, sbl)
         )
         valid = jnp.concatenate([pv & spok.astype(bool), bv & sbok.astype(bool)])
-        build_idx, matched = join_ops._pk_match(limbs, valid, p)
+        if unique or how in ("semi", "anti"):
+            # semi/anti only need per-probe match existence: the PK kernel's
+            # matched mask is correct for duplicate build keys too
+            build_idx, matched = join_ops._pk_match(limbs, valid, p)
+            payload_g = tuple(c[build_idx] for c in sbc)
+            return spc + payload_g + (pv, matched, jnp.zeros(1, jnp.int32))
+        # many-to-many: static output capacity per device; overflow reported
+        mc, total, offsets, bps, rp = join_ops.mm_plan_for(
+            limbs, valid, p, how, probe_valid=pv
+        )
+        cap = p * MM_CAPACITY_FACTOR
+        overflow = jnp.maximum(total - cap, 0).astype(jnp.int32).reshape(1)
+        probe_idx, build_idx, out_valid = join_ops._mm_expand(
+            mc, offsets, bps, rp, jnp.minimum(total, cap), cap
+        )
+        out_pc = tuple(c[probe_idx] for c in spc)
         payload_g = tuple(c[build_idx] for c in sbc)
-        return spc + payload_g + (pv, matched)
+        if how == "left":
+            matched = ~join_ops.mm_unmatched(limbs, valid, p, probe_idx, mc)
+        else:
+            matched = jnp.ones(cap, dtype=bool)
+        return out_pc + payload_g + (out_valid, matched, overflow)
 
     fn = jax.jit(
         jax.shard_map(step, mesh=mesh, in_specs=P(axis), out_specs=P(axis),
@@ -241,7 +267,13 @@ def mesh_join(
     )
     spc = outs[:npc]
     pay = outs[npc:npc + nbc]
-    pvalid, matched = outs[-2], outs[-1]
+    pvalid, matched, overflow = outs[-3], outs[-2], outs[-1]
+    mm = not (unique or how in ("semi", "anti"))
+    if mm and int(jnp.max(overflow)) > 0:
+        raise MeshUnsupported(
+            "mm join overflowed the static per-device capacity "
+            f"({MM_CAPACITY_FACTOR}x local probe rows) — engine fallback"
+        )
     cols = {}
     for name, lo, hi in p_slices:
         cols[name] = _rebuild_col(probe.columns[name], list(spc[lo:hi]))
@@ -256,6 +288,8 @@ def mesh_join(
             col = with_nulls(col, ~matched)
         out = out.with_column(name, col)
     if how == "inner":
+        if mm:
+            return DeviceBatch(out.columns, pvalid, None, None)
         return DeviceBatch(out.columns, pvalid & matched, None, None)
     if how == "left":
         return DeviceBatch(out.columns, pvalid, None, None)
@@ -404,8 +438,7 @@ class MeshExecutor:
     def _join(self, sub, node: logical.JoinNode) -> DeviceBatch:
         probe = self._exec(sub, node.parents[0])
         build = self._exec(sub, node.parents[1])
-        if not join_ops.build_keys_unique(build, node.right_on):
-            raise MeshUnsupported("non-unique build side on mesh (todo: mm join)")
+        unique = join_ops.build_keys_unique(build, node.right_on)
         payload = [c for c in build.names if c not in set(node.right_on)]
         rename = node.rename or {
             c: c + node.suffix for c in payload if c in probe.columns
@@ -417,6 +450,7 @@ class MeshExecutor:
         out = mesh_join(
             self.mesh, self.axis, probe, build,
             list(node.left_on), list(node.right_on), node.how, payload,
+            unique=unique,
         )
         if node.how not in ("semi", "anti"):
             out = out.select([c for c in node.schema if c in out.columns])

@@ -62,5 +62,5 @@ class DataPlaneClient:
         self._rpc.close()
 
 
-def serve_cache(cache: BatchCache) -> RpcServer:
-    return RpcServer(CacheService(cache))
+def serve_cache(cache: BatchCache, host: str = "127.0.0.1") -> RpcServer:
+    return RpcServer(CacheService(cache), host=host)

@@ -80,37 +80,68 @@ def run_distributed(
     timeout: float = 600.0,
     kill_after_inputs: Optional[Tuple[int, int]] = None,
     heartbeat_timeout: Optional[float] = None,
+    external_workers: int = 0,
+    bind: str = "127.0.0.1",
 ) -> None:
     """Execute the graph over worker processes; fills blocking datasets.
     kill_after_inputs=(worker_id, n): SIGKILL that worker once n input seqs
-    exist globally — the kill -9 fault-injection path for tests."""
+    exist globally — the kill -9 fault-injection path for tests.
+
+    external_workers: additionally expect that many externally-launched
+    workers (`python -m quokka_tpu.runtime.worker --store host:port
+    --worker-id K` with K >= n_workers) — the multi-HOST deployment path.
+    They fetch the plan from the served store; liveness for them is
+    heartbeat-based (heartbeat_timeout defaults to 15s when external workers
+    are expected), and they must send a first heartbeat within ~120s.
+    bind: serve the store/data plane on this interface (0.0.0.0 for
+    cross-machine workers).  SECURITY: the RPC layer is unauthenticated
+    pickle (the same trust model as the reference's open Redis/Arrow-Flight
+    ports) — bind beyond loopback only on a trusted private network."""
     # promote the graph's embedded store (already populated by lowering) to a
     # served CoordinatorStore: rebind the same table/kv dicts
     cs = CoordinatorStore()
     cs.kv = graph.store.kv
     cs.tables = graph.store.tables
     graph.store = cs
-    server = serve_store(cs)
+    server = serve_store(cs, host=bind)
     procs: Dict[int, mp.Process] = {}
     try:
-        owned = _assign_channels(graph, n_workers)
+        total_workers = n_workers + external_workers
+        owned = _assign_channels(graph, total_workers)
         with cs.transaction():
             for w, per_actor in owned.items():
                 for aid, chs in per_actor.items():
                     for ch in chs:
                         cs.tset("CLT", (aid, ch), w)
-        cs.set("expected_workers", n_workers)
+        cs.set("expected_workers", total_workers)
         spec = pickle.dumps(_build_spec(graph))
+        # externally-launched workers fetch plan + ownership from the store
+        cs.set("spec", spec)
+        for w, per_actor in owned.items():
+            cs.set(("owned", w), per_actor)
         ctx = mp.get_context("spawn")
+        # local workers connect via loopback even when serving all interfaces
+        connect_addr = (
+            ("127.0.0.1", server.address[1])
+            if server.address[0] in ("0.0.0.0", "::") else server.address
+        )
         for w in range(n_workers):
             p = ctx.Process(
-                target=worker_main, args=(spec, server.address, w, owned[w]),
+                target=worker_main, args=(spec, connect_addr, w, owned[w]),
                 daemon=True,
             )
             p.start()
             procs[w] = p
+        external_ids = list(range(n_workers, total_workers))
+        if external_ids and heartbeat_timeout is None:
+            heartbeat_timeout = 15.0
+        if kill_after_inputs is not None and kill_after_inputs[0] >= n_workers:
+            raise ValueError(
+                "kill_after_inputs targets an external worker — only locally "
+                "spawned workers (id < n_workers) can be SIGKILLed"
+            )
         _coordinate(graph, cs, procs, owned, timeout, kill_after_inputs,
-                    heartbeat_timeout)
+                    heartbeat_timeout, external_ids)
     finally:
         cs.set("SHUTDOWN", True)
         time.sleep(0.05)
@@ -148,7 +179,8 @@ def _all_done(graph, cs) -> bool:
 
 
 def _coordinate(graph, cs, procs, owned, timeout, kill_after_inputs,
-                heartbeat_timeout) -> None:
+                heartbeat_timeout, external_ids=()) -> None:
+    all_ids = list(procs) + list(external_ids)
     stages = sorted({a.stage for a in graph.actors.values()})
     stage_idx = 0
     cs.set("STAGE", stages[0])
@@ -162,7 +194,7 @@ def _coordinate(graph, cs, procs, owned, timeout, kill_after_inputs,
         # merge newly registered worker cache addresses for peers to read
         addrs = dict(cs.get("worker_addrs") or {})
         changed = False
-        for w in procs:
+        for w in all_ids:
             a = cs.get(f"worker_addr:{w}")
             if a is not None and addrs.get(w) != tuple(a):
                 addrs[w] = tuple(a)
@@ -180,14 +212,40 @@ def _coordinate(graph, cs, procs, owned, timeout, kill_after_inputs,
             if total_inputs >= n and procs[wid].is_alive():
                 os.kill(procs[wid].pid, signal.SIGKILL)
                 kill_after_inputs = None
-        # failure detection: dead process or stale heartbeat
+        # failure detection: dead process or stale heartbeat.  External
+        # (multi-host) workers have no local PID: heartbeat staleness only.
         now = time.time()
-        for w, p in procs.items():
+        for w in all_ids:
+            p = procs.get(w)
             if w in dead:
                 continue
             err = cs.kv.get(f"worker_error:{w}")
             if err is not None:
                 raise RuntimeError(f"worker {w} crashed at startup:\n{err}")
+            if p is None:
+                hb = cs.heartbeats.get(w)
+                if hb is None:
+                    if now - t0 > 120:
+                        raise RuntimeError(
+                            f"external worker {w} never sent a heartbeat — "
+                            "was it launched with the right --store/--worker-id?"
+                        )
+                    continue
+                stale = (
+                    heartbeat_timeout is not None
+                    and (now - hb) > heartbeat_timeout
+                )
+                if stale:
+                    if graph.hbq is None:
+                        raise RuntimeError(
+                            f"external worker {w} went silent and "
+                            "fault_tolerance is not enabled"
+                        )
+                    dead.add(w)
+                    if not _recover_worker(graph, cs, w, owned, procs, dead,
+                                           all_ids):
+                        raise RuntimeError(f"worker {w} died; no survivor")
+                continue
             if not p.is_alive() and w not in started:
                 raise RuntimeError(
                     f"worker {w} exited (code {p.exitcode}) before its first "
@@ -216,7 +274,8 @@ def _coordinate(graph, cs, procs, owned, timeout, kill_after_inputs,
                         "(no HBQ spill to recover from)"
                     )
                 dead.add(w)
-                self_heal = _recover_worker(graph, cs, w, owned, procs, dead)
+                self_heal = _recover_worker(graph, cs, w, owned, procs, dead,
+                                            all_ids)
                 if not self_heal:
                     raise RuntimeError(f"worker {w} died and no survivor exists")
         if _all_done(graph, cs):
@@ -228,11 +287,16 @@ def _coordinate(graph, cs, procs, owned, timeout, kill_after_inputs,
             cs.set("STAGE", stages[stage_idx])
 
 
-def _recover_worker(graph, cs, dead_worker: int, owned, procs, dead) -> bool:
+def _recover_worker(graph, cs, dead_worker: int, owned, procs, dead,
+                    all_ids=None) -> bool:
     """Reassign the dead worker's channels to survivors and trigger adoption
     (reference: coordinator.py:219-421 recovery barrier, simplified to the
-    single-host case where HBQ spill is on shared disk)."""
-    survivors = [w for w in procs if w not in dead]
+    shared-disk case).  Survivors include live EXTERNAL workers."""
+    pool = all_ids if all_ids is not None else list(procs)
+    survivors = [
+        w for w in pool
+        if w not in dead and (procs.get(w) is None or procs[w].is_alive())
+    ]
     if not survivors:
         return False
     per_actor = owned.get(dead_worker, {})

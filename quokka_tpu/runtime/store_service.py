@@ -52,8 +52,8 @@ class CoordinatorStore(ControlStore):
             return out
 
 
-def serve_store(store: CoordinatorStore) -> RpcServer:
-    return RpcServer(store)
+def serve_store(store: CoordinatorStore, host: str = "127.0.0.1") -> RpcServer:
+    return RpcServer(store, host=host)
 
 
 class ControlStoreClient:

@@ -216,8 +216,14 @@ def worker_main(spec_bytes: bytes, store_addr, worker_id: int, owned):
     store = ControlStoreClient(tuple(store_addr))
     try:
         cache = BatchCache()
-        server = serve_cache(cache)
-        store.set(f"worker_addr:{worker_id}", server.address)
+        # advertise the address peers can actually reach: the local IP of the
+        # socket we used to reach the coordinator (loopback stays loopback;
+        # a cross-host connection yields this machine's routable IP, and the
+        # cache binds all interfaces in that case)
+        my_ip = store._rpc._sock.getsockname()[0]
+        bind = "127.0.0.1" if my_ip.startswith("127.") else "0.0.0.0"
+        server = serve_cache(cache, host=bind)
+        store.set(f"worker_addr:{worker_id}", (my_ip, server.address[1]))
         # the coordinator merges individual keys into 'worker_addrs' itself
         store.heartbeat(worker_id)
         w = Worker(spec, store, cache, worker_id, owned)
@@ -242,3 +248,44 @@ def worker_main(spec_bytes: bytes, store_addr, worker_id: int, owned):
         raise
     finally:
         store.close()
+
+
+def main(argv=None):
+    """Standalone worker for multi-host deployments: join a coordinator's
+    served store, fetch the plan + channel ownership, and run.
+
+        python -m quokka_tpu.runtime.worker --store HOST:PORT --worker-id K
+
+    The coordinator must have been started with external_workers > 0 so K's
+    channels were assigned (runtime/distributed.run_distributed)."""
+    import argparse
+
+    p = argparse.ArgumentParser(description=main.__doc__)
+    p.add_argument("--store", required=True, help="coordinator HOST:PORT")
+    p.add_argument("--worker-id", type=int, required=True)
+    args = p.parse_args(argv)
+    host, port = args.store.rsplit(":", 1)
+    store = ControlStoreClient((host, int(port)))
+    try:
+        deadline = time.time() + 120
+        spec_bytes = None
+        owned = None
+        while time.time() < deadline:
+            spec_bytes = store.get("spec")
+            owned = store.get(("owned", args.worker_id))
+            if spec_bytes is not None and owned is not None:
+                break
+            time.sleep(0.2)
+        if spec_bytes is None or owned is None:
+            raise TimeoutError(
+                f"coordinator at {args.store} never published a plan for "
+                f"worker {args.worker_id} (was it started with "
+                "external_workers > this id?)"
+            )
+    finally:
+        store.close()
+    worker_main(spec_bytes, (host, int(port)), args.worker_id, owned)
+
+
+if __name__ == "__main__":
+    main()

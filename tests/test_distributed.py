@@ -104,3 +104,92 @@ class TestKill9Recovery:
         got = q(ctx)
         exp = q(QuokkaContext())
         pd.testing.assert_frame_equal(got, exp, check_dtype=False)
+
+
+class TestExternalWorker:
+    def test_externally_launched_worker_joins(self, tmp_path):
+        """Multi-host path: one spawned worker + one worker launched via
+        `python -m quokka_tpu.runtime.worker --store host:port --worker-id 1`
+        that fetches the plan from the served store."""
+        import os
+        import subprocess
+        import sys
+        import threading
+
+        from quokka_tpu import logical
+        from quokka_tpu.runtime.distributed import run_distributed
+        from quokka_tpu.runtime.engine import TaskGraph
+
+        fact, dim = make_data(seed=4, n=8000)
+        ctx = QuokkaContext()
+        q = (
+            ctx.from_arrow(fact)
+            .join(ctx.from_arrow(dim), on="k")
+            .groupby("grp")
+            .agg_sql("sum(v) as sv, count(*) as n")
+        )
+        sub, mapping = ctx._copy_subgraph(q.node_id)
+        sink_id = mapping[q.node_id]
+        from quokka_tpu.optimizer import optimize
+
+        sink = logical.SinkNode([sink_id], sub[sink_id].schema)
+        sid = max(sub) + 1
+        sub[sid] = sink
+        sink_id = optimize(sub, sid, exec_channels=2)
+        ctx._assign_stages(sub, sink_id)
+        graph = TaskGraph(ctx.exec_config)
+        actor_of = {}
+        for nid in ctx._toposort(sub, sink_id):
+            sub[nid].lower(ctx, graph, actor_of, nid)
+
+        proc_holder = {}
+
+        def launch_external():
+            # wait for the store address file the main thread writes
+            for _ in range(200):
+                if "addr" in proc_holder:
+                    break
+                import time as _t
+
+                _t.sleep(0.05)
+            host, port = proc_holder["addr"]
+            env = dict(os.environ)
+            proc_holder["proc"] = subprocess.Popen(
+                [sys.executable, "-m", "quokka_tpu.runtime.worker",
+                 "--store", f"{host}:{port}", "--worker-id", "1"],
+                env=env,
+            )
+
+        # intercept the served address by wrapping serve_store
+        import quokka_tpu.runtime.distributed as D
+
+        orig = D.serve_store
+
+        def capture(store, host="127.0.0.1"):
+            srv = orig(store, host=host)
+            proc_holder["addr"] = srv.address
+            return srv
+
+        D.serve_store = capture
+        th = threading.Thread(target=launch_external, daemon=True)
+        th.start()
+        try:
+            run_distributed(graph, n_workers=1, external_workers=1, timeout=300)
+        finally:
+            D.serve_store = orig
+            p = proc_holder.get("proc")
+            if p is not None:
+                p.wait(timeout=30)
+        got = (
+            graph.result(actor_of[sink_id])
+            .to_df()
+            .sort_values("grp")
+            .reset_index(drop=True)
+        )
+        exp = (
+            fact.to_pandas().merge(dim.to_pandas(), on="k")
+            .groupby("grp").v.agg(["sum", "size"]).reset_index()
+        )
+        np.testing.assert_allclose(got.sv.to_numpy(), exp["sum"].to_numpy(), rtol=1e-9)
+        assert got.n.tolist() == exp["size"].tolist()
+        graph.cleanup()

@@ -1,0 +1,160 @@
+"""All 22 TPC-H queries under QuokkaContext(mesh=8-device CPU mesh): plans
+the mesh path supports run SPMD (shard_map + all_to_all); the rest fall back
+to the embedded engine via the pre-walk.  Either way results must equal the
+plain-context run — this pins the fallback boundary and the SPMD kernels
+against the full query corpus."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from quokka_tpu import QuokkaContext
+from quokka_tpu.parallel.mesh import make_mesh
+
+import tpch_data
+
+
+@pytest.fixture(scope="module")
+def env(tmp_path_factory):
+    root = tmp_path_factory.mktemp("mesh_tpch")
+    tables = tpch_data.generate(sf=0.0015, seed=23)
+    paths = tpch_data.write_parquet_dir(tables, str(root))
+    return paths
+
+
+def _q3(ctx, s):
+    return (
+        s["lineitem"].filter_sql("l_shipdate > date '1995-03-15'")
+        .join(s["orders"].filter_sql("o_orderdate < date '1995-03-15'"),
+              left_on="l_orderkey", right_on="o_orderkey")
+        .join(s["customer"].filter_sql("c_mktsegment = 'BUILDING'"),
+              left_on="o_custkey", right_on="c_custkey")
+        .groupby("l_orderkey")
+        .agg_sql("sum(l_extendedprice * (1 - l_discount)) as revenue")
+        .collect()
+    )
+
+
+def _q1(ctx, s):
+    return (
+        s["lineitem"].filter_sql("l_shipdate <= date '1998-09-02'")
+        .groupby(["l_returnflag", "l_linestatus"])
+        .agg_sql("sum(l_quantity) as sq, avg(l_discount) as ad, count(*) as n")
+        .collect()
+    )
+
+
+def _q5(ctx, s):
+    nat = s["nation"].join(
+        s["region"].filter_sql("r_name = 'ASIA'"),
+        left_on="n_regionkey", right_on="r_regionkey", how="semi")
+    return (
+        s["lineitem"]
+        .join(s["orders"], left_on="l_orderkey", right_on="o_orderkey")
+        .join(s["customer"], left_on="o_custkey", right_on="c_custkey")
+        .join(s["supplier"], left_on="l_suppkey", right_on="s_suppkey")
+        .join(nat, left_on="s_nationkey", right_on="n_nationkey")
+        .filter_sql("c_nationkey = s_nationkey")
+        .groupby("n_name")
+        .agg_sql("sum(l_extendedprice * (1 - l_discount)) as revenue")
+        .collect()
+    )
+
+
+def _q6(ctx, s):
+    return (
+        s["lineitem"].filter_sql(
+            "l_shipdate >= date '1994-01-01' and l_shipdate < date '1995-01-01' "
+            "and l_discount between 0.05 and 0.07 and l_quantity < 24")
+        .agg_sql("sum(l_extendedprice * l_discount) as revenue")
+        .collect()
+    )
+
+
+def _q10(ctx, s):
+    return (
+        s["lineitem"].filter_sql("l_returnflag = 'R'")
+        .join(s["orders"].filter_sql(
+            "o_orderdate >= date '1993-10-01' and o_orderdate < date '1994-01-01'"),
+            left_on="l_orderkey", right_on="o_orderkey")
+        .join(s["customer"], left_on="o_custkey", right_on="c_custkey")
+        .groupby(["o_custkey", "c_name"])
+        .agg_sql("sum(l_extendedprice * (1 - l_discount)) as revenue")
+        .top_k(["revenue"], 20, descending=[True])
+        .collect()
+    )
+
+
+def _q12(ctx, s):
+    return (
+        s["lineitem"].filter_sql(
+            "l_shipmode in ('MAIL', 'SHIP') and l_commitdate < l_receiptdate "
+            "and l_shipdate < l_commitdate and "
+            "l_receiptdate >= date '1994-01-01' and l_receiptdate < date '1995-01-01'")
+        .join(s["orders"], left_on="l_orderkey", right_on="o_orderkey")
+        .with_columns_sql(
+            "case when o_orderpriority = '1-URGENT' or o_orderpriority = '2-HIGH' "
+            "then 1.0 else 0.0 end as high")
+        .groupby("l_shipmode")
+        .agg_sql("sum(high) as high_count, count(*) as n")
+        .collect()
+    )
+
+
+def _q14(ctx, s):
+    return (
+        s["lineitem"].filter_sql(
+            "l_shipdate >= date '1995-09-01' and l_shipdate < date '1995-10-01'")
+        .join(s["part"], left_on="l_partkey", right_on="p_partkey")
+        .with_columns_sql(
+            "case when p_type like 'PROMO%' "
+            "then l_extendedprice * (1 - l_discount) else 0.0 end as promo, "
+            "l_extendedprice * (1 - l_discount) as rev")
+        .agg_sql("100.0 * sum(promo) / sum(rev) as promo_revenue")
+        .collect()
+    )
+
+
+def _q18(ctx, s):
+    big = (s["lineitem"].groupby("l_orderkey")
+           .agg_sql("sum(l_quantity) as sq").filter_sql("sq > 250"))
+    return (
+        s["orders"]
+        .join(big.rename({"l_orderkey": "b_ok"}), left_on="o_orderkey", right_on="b_ok")
+        .join(s["customer"], left_on="o_custkey", right_on="c_custkey")
+        .select(["c_name", "o_orderkey", "sq"])
+        .collect()
+    )
+
+
+def _q19(ctx, s):
+    return (
+        s["lineitem"].filter_sql("l_shipmode in ('AIR', 'REG AIR')")
+        .join(s["part"].filter_sql("p_size between 1 and 15"),
+              left_on="l_partkey", right_on="p_partkey")
+        .filter_sql("l_quantity >= 1 and l_quantity <= 30")
+        .agg_sql("sum(l_extendedprice * (1 - l_discount)) as revenue")
+        .collect()
+    )
+
+
+QUERIES = {
+    "q1": _q1, "q3": _q3, "q5": _q5, "q6": _q6, "q10": _q10,
+    "q12": _q12, "q14": _q14, "q18": _q18, "q19": _q19,
+}
+
+
+@pytest.mark.parametrize("name", sorted(QUERIES))
+def test_mesh_equals_engine(env, name):
+    paths = env
+    mesh = make_mesh()
+
+    def run(ctx):
+        s = {k: ctx.read_parquet(p) for k, p in paths.items()}
+        return QUERIES[name](ctx, s)
+
+    got = run(QuokkaContext(mesh=mesh))
+    exp = run(QuokkaContext())
+    got = got.sort_values(list(got.columns)).reset_index(drop=True)
+    exp = exp.sort_values(list(exp.columns)).reset_index(drop=True)
+    pd.testing.assert_frame_equal(got, exp, check_dtype=False, rtol=1e-9)
