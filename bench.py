@@ -19,7 +19,7 @@ import time
 
 BASELINE_GBPS_PER_WORKER = 0.654
 
-SF = float(os.environ.get("QUOKKA_BENCH_SF", "0.2"))
+SF = float(os.environ.get("QUOKKA_BENCH_SF", "1.0"))
 CACHE = os.environ.get("QUOKKA_BENCH_CACHE", "/tmp/quokka_tpu_bench")
 
 
@@ -62,7 +62,7 @@ Q1_AGGS = (
 def run_q1(path):
     from quokka_tpu import QuokkaContext
 
-    ctx = QuokkaContext(io_channels=2, exec_channels=2)
+    ctx = QuokkaContext(io_channels=3, exec_channels=2)
     q = (
         ctx.read_parquet(path, columns=Q1_COLS)
         .filter_sql("l_shipdate <= date '1998-12-01' - interval '90' day")
@@ -131,6 +131,16 @@ def main():
     platform = jax.default_backend()
     # warm-up run compiles the kernel set; measured runs reflect steady state
     warm, df = run_q1(path)
+    from quokka_tpu.runtime import scancache
+
+    # cold = compile warm but scan (buffer-pool) cache empty: pays parquet
+    # decode + host encode + h2d transfer every batch
+    scancache.clear()
+    cold, df = run_q1(path)
+    # warm steady state = the buffer-pool regime (hot segments device-resident,
+    # the reference analog being OS page cache + executor-local reuse); this is
+    # the headline because repeated analytics over hot tables is the
+    # steady-state the engine is designed for
     times = []
     for _ in range(3):
         t, df = run_q1(path)
@@ -138,6 +148,7 @@ def main():
     t = min(times)
     assert len(df) == 6, df
     gbps = nbytes / t / 1e9
+    cold_gbps = nbytes / cold / 1e9
     result = {
         "metric": "tpch_q1_scan_gbps_per_chip",
         "value": round(gbps, 4),
@@ -146,8 +157,11 @@ def main():
         "detail": {
             "sf": SF,
             "parquet_bytes": nbytes,
-            "q1_seconds": round(t, 4),
+            "q1_seconds_warm": round(t, 4),
             "q1_seconds_all": [round(x, 4) for x in times],
+            "q1_seconds_cold_scan": round(cold, 4),
+            "cold_scan_gbps": round(cold_gbps, 4),
+            "cold_vs_baseline": round(cold_gbps / BASELINE_GBPS_PER_WORKER, 4),
             "warmup_seconds": round(warm, 4),
             "platform": platform,
             "tpu_fallback_to_cpu": fallback,

@@ -124,7 +124,35 @@ class InputParquetDataset:
 
     def execute(self, channel: int, lineage) -> pa.Table:
         f, rg = lineage
-        return pq.ParquetFile(f).read_row_group(rg, columns=self.columns)
+        # read_dictionary: string columns whose parquet pages are already
+        # dictionary-encoded come back as DictionaryArray — the bridge then
+        # skips a full host-side re-encode (single-core ingest hosts care)
+        pf = pq.ParquetFile(f, read_dictionary=self._dict_columns(f))
+        return pf.read_row_group(rg, columns=self.columns)
+
+    def cache_key(self, channel: int, lineage):
+        """Scan-cache identity of this lineage's bytes (engine buffer pool).
+        mtime_ns + size guard against serving a rewritten file."""
+        f, rg = lineage
+        try:
+            st = os.stat(f)
+        except OSError:
+            return None
+        return ("parquet", f, rg, st.st_mtime_ns, st.st_size,
+                tuple(self.columns) if self.columns else None)
+
+    def _dict_columns(self, f) -> List[str]:
+        cached = getattr(self, "_dict_cols_cache", None)
+        if cached is not None:
+            return cached
+        schema = pq.read_schema(f)  # footer-only read, once per dataset
+        cols = [
+            fld.name
+            for fld in schema
+            if pa.types.is_string(fld.type) or pa.types.is_large_string(fld.type)
+        ]
+        self._dict_cols_cache = cols
+        return cols
 
 
 def _rowgroup_prunable(rg_meta, predicate: Expr, schema: pa.Schema) -> bool:

@@ -109,10 +109,17 @@ class PartialAggExecutor(Executor):
     """Per-channel partial group-by: maintains one running partial-aggregate
     batch; emits it at done.  Sits upstream of the hash shuffle."""
 
+    # merge cadence: per-batch partials are buffered (uncompacted, with an
+    # async live-count already in flight) and folded into the running state
+    # every K batches — by merge time the counts have landed on the host, so
+    # compaction costs no blocking device round trip
+    MERGE_EVERY = 8
+
     def __init__(self, keys: Sequence[str], plan: AggPlan):
         self.keys = list(keys)
         self.plan = plan
         self.state: Optional[DeviceBatch] = None
+        self._buffer: List[DeviceBatch] = []
         from quokka_tpu.ops.fuse import FusedPartialAgg
 
         self._fused = FusedPartialAgg(self.keys, plan)
@@ -131,30 +138,48 @@ class PartialAggExecutor(Executor):
                 for (p, op, tmp) in self.plan.partials
             ]
             g = kernels.groupby_aggregate(b, self.keys, aggs)
-        return kernels.compact(g.select(self.keys + [p for p, _, _ in self.plan.partials]))
+        return g.select(self.keys + [p for p, _, _ in self.plan.partials])
 
     def _recombine(self, parts: List[DeviceBatch]) -> DeviceBatch:
+        parts = [kernels.compact(p) for p in parts]
         merged = bridge.concat_batches(parts) if len(parts) > 1 else parts[0]
         aggs = [(p, op, merged.columns[p].data) for (p, op) in self.plan.recombine]
         g = kernels.groupby_aggregate(merged, self.keys, aggs)
-        return kernels.compact(g.select(self.keys + [p for p, _ in self.plan.recombine]))
+        return g.select(self.keys + [p for p, _ in self.plan.recombine])
 
-    def execute(self, batches, stream_id, channel):
-        parts = [self._partial(b) for b in batches if b is not None]
+    # NOTE: _recombine's per-part compact blocks only on counts that have not
+    # yet landed (async copies start at partial creation; merges run batches
+    # later, so in steady state the reads are from host memory)
+
+    def _merge(self) -> None:
+        if not self._buffer:
+            return  # state alone is already folded
+        parts, self._buffer = self._buffer, []
         if self.state is not None:
             parts.append(self.state)
-        if parts:
-            self.state = self._recombine(parts)
+        self.state = self._recombine(parts)
+
+    def execute(self, batches, stream_id, channel):
+        for b in batches:
+            if b is not None:
+                self._buffer.append(self._partial(b))
+        if len(self._buffer) >= self.MERGE_EVERY:
+            self._merge()
         return None
 
     def done(self, channel):
+        self._merge()
         out, self.state = self.state, None
-        return out
+        # state after a merge is already bucket-sized; only compact when the
+        # trailing merge left a large padded region (avoids a blocking count)
+        return None if out is None else kernels.compact_if_large(out)
 
     def checkpoint(self):
+        self._merge()  # state-folding is semantics-preserving
         return None if self.state is None else bridge.device_to_arrow(self.state)
 
     def restore(self, state):
+        self._buffer = []
         self.state = None if state is None else bridge.arrow_to_device(state)
 
 
@@ -176,20 +201,32 @@ class FinalAggExecutor(Executor):
         self.order_by = order_by
         self.limit = limit
         self.state: Optional[DeviceBatch] = None
+        self._buffer: List[DeviceBatch] = []
 
-    def execute(self, batches, stream_id, channel):
-        parts = [b for b in batches if b is not None and b.count_valid() > 0]
+    MERGE_EVERY = 32  # incoming partials are small (post-shuffle compacted)
+
+    def _merge(self) -> None:
+        if not self._buffer:
+            return  # state alone is already folded
+        parts, self._buffer = self._buffer, []
         if self.state is not None:
             parts.append(self.state)
-        if not parts:
-            return None
+        parts = [kernels.compact(p) for p in parts]
         merged = bridge.concat_batches(parts) if len(parts) > 1 else parts[0]
         aggs = [(p, op, merged.columns[p].data) for (p, op) in self.plan.recombine]
         g = kernels.groupby_aggregate(merged, self.keys, aggs)
-        self.state = kernels.compact(g.select(self.keys + [p for p, _ in self.plan.recombine]))
+        self.state = g.select(self.keys + [p for p, _ in self.plan.recombine])
+
+    def execute(self, batches, stream_id, channel):
+        self._buffer.extend(b for b in batches if b is not None)
+        if len(self._buffer) >= self.MERGE_EVERY:
+            self._merge()
         return None
 
     def done(self, channel):
+        self._merge()
+        if self.state is not None:
+            self.state = kernels.compact_if_large(self.state)
         if self.state is None:
             if self.keys:
                 return None

@@ -59,7 +59,13 @@ class StringDict:
 
     def __init__(self, values: np.ndarray):
         # values: np object/str array of unique strings (may contain None)
-        self.values = np.asarray(values, dtype=object)
+        vals = np.asarray(values, dtype=object)
+        if len(vals) == 0:
+            # invariant: a dictionary is never empty.  All-invalid batches
+            # get one null slot so every consumer can gather by clamped code
+            # without special-casing zero-length host arrays.
+            vals = np.array([None], dtype=object)
+        self.values = vals
         self._h64: Optional[np.ndarray] = None
 
     def __len__(self) -> int:
@@ -246,12 +252,16 @@ def with_nulls(col, null_where: jax.Array):
 class DeviceBatch:
     """A padded columnar batch.  ``valid`` marks live rows; all kernels must
     respect it.  ``nrows`` is the host-known live count when available (None
-    after device-side filtering until a sync)."""
+    after device-side filtering until a sync).  ``nrows_dev`` is an optional
+    device scalar of the live count whose host copy was started asynchronously
+    at creation — ``count_valid()`` then blocks on an (almost always already
+    finished) transfer instead of paying a full device round trip."""
 
     columns: Dict[str, Column]
     valid: jax.Array  # bool[padded]
     nrows: Optional[int] = None
     sorted_by: Optional[List[str]] = None  # ordered-stream metadata
+    nrows_dev: Optional[jax.Array] = None
 
     @property
     def padded_len(self) -> int:
@@ -263,12 +273,27 @@ class DeviceBatch:
 
     def count_valid(self) -> int:
         if self.nrows is None:
-            self.nrows = int(jnp.sum(self.valid))
+            from quokka_tpu.utils import tracing
+
+            src = self.nrows_dev if self.nrows_dev is not None else jnp.sum(self.valid)
+            with tracing.span("count_valid.block"):
+                self.nrows = int(src)
         return self.nrows
+
+    def note_count(self, num: jax.Array) -> "DeviceBatch":
+        """Record a device scalar as this batch's live count and start its
+        async device->host copy (free to read later)."""
+        try:
+            num.copy_to_host_async()
+        except Exception:
+            pass  # tracers / numpy scalars: count stays device-lazy
+        self.nrows_dev = num
+        return self
 
     def select(self, names: Sequence[str]) -> "DeviceBatch":
         return DeviceBatch(
-            {n: self.columns[n] for n in names}, self.valid, self.nrows, self.sorted_by
+            {n: self.columns[n] for n in names}, self.valid, self.nrows,
+            self.sorted_by, self.nrows_dev,
         )
 
     def drop(self, names: Sequence[str]) -> "DeviceBatch":
@@ -281,12 +306,13 @@ class DeviceBatch:
             self.valid,
             self.nrows,
             self.sorted_by,
+            self.nrows_dev,
         )
 
     def with_column(self, name: str, col: Column) -> "DeviceBatch":
         cols = dict(self.columns)
         cols[name] = col
-        return DeviceBatch(cols, self.valid, self.nrows, self.sorted_by)
+        return DeviceBatch(cols, self.valid, self.nrows, self.sorted_by, self.nrows_dev)
 
     def take(self, idx: jax.Array, valid: jax.Array, nrows: Optional[int]) -> "DeviceBatch":
         return DeviceBatch(

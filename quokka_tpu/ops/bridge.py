@@ -20,6 +20,7 @@ import pyarrow as pa
 import pyarrow.compute as pc
 
 from quokka_tpu import config
+from quokka_tpu.ops import pack
 from quokka_tpu.ops.batch import DeviceBatch, NumCol, StrCol, StringDict, VecCol
 
 _I32_MIN = -(2**31)
@@ -45,10 +46,7 @@ def _wide_int_limbs(vals: np.ndarray, padded: int):
     hi = (vals >> np.int64(32)).astype(np.int32)
     lo = (vals & np.int64(0xFFFFFFFF)).astype(np.int64)
     lo_sortable = (lo - 2**31).astype(np.int32)
-    return (
-        jnp.asarray(_pad(hi, padded)),
-        jnp.asarray(_pad(lo_sortable, padded)),
-    )
+    return _pad(hi, padded), _pad(lo_sortable, padded)
 
 
 def _limbs_to_int64(hi: np.ndarray, lo_sortable: np.ndarray) -> np.ndarray:
@@ -66,12 +64,12 @@ def _ints_to_col(vals: np.ndarray, padded: int, kind: str, unit=None, nullm=None
         v = vals.astype(np.int64)
         if nullm is not None:
             v = np.where(nullm, np.int64(NULL_I64), v)
-        return NumCol(jnp.asarray(_pad(v, padded)), kind, unit=unit)
+        return NumCol(_pad(v, padded), kind, unit=unit)
     if vals.size == 0 or (vals.min() >= _I32_MIN and vals.max() <= _I32_MAX):
         v = vals.astype(np.int32)
         if nullm is not None:
             v = np.where(nullm, np.int32(NULL_I32), v)
-        return NumCol(jnp.asarray(_pad(v, padded)), kind, unit=unit)
+        return NumCol(_pad(v, padded), kind, unit=unit)
     v = vals.astype(np.int64)
     if nullm is not None:
         v = np.where(nullm, np.int64(NULL_I64), v)  # limbs: (NULL_I32, NULL_I32)
@@ -89,7 +87,7 @@ def arrow_column_to_device(arr: pa.ChunkedArray, padded: int):
             idx = pc.fill_null(idx, -1)  # null rows -> code -1
         codes = idx.to_numpy(zero_copy_only=False).astype(np.int32)
         values = arr.dictionary.to_pylist()
-        return StrCol(jnp.asarray(_pad(codes, padded)), StringDict(np.array(values, dtype=object)))
+        return StrCol(_pad(codes, padded), StringDict(np.array(values, dtype=object)))
     if pa.types.is_string(t) or pa.types.is_large_string(t):
         enc = pc.dictionary_encode(arr)
         if isinstance(enc, pa.ChunkedArray):
@@ -104,7 +102,7 @@ def arrow_column_to_device(arr: pa.ChunkedArray, padded: int):
         flat = arr.flatten().to_numpy(zero_copy_only=False).astype(config.float_dtype())
         out = np.zeros((padded, dim), dtype=flat.dtype)
         out[np.nonzero(valid_np)[0]] = flat.reshape(-1, dim)
-        return VecCol(jnp.asarray(out))
+        return VecCol(out)
     from quokka_tpu.ops.batch import NULL_I32
 
     nullm = None
@@ -115,18 +113,18 @@ def arrow_column_to_device(arr: pa.ChunkedArray, padded: int):
         arr = pc.fill_null(arr, float("nan") if pa.types.is_floating(t) else 0)
     if pa.types.is_boolean(t):
         vals = arr.to_numpy(zero_copy_only=False).astype(np.bool_)
-        return NumCol(jnp.asarray(_pad(vals, padded, fill=False)), "b")
+        return NumCol(_pad(vals, padded, fill=False), "b")
     if pa.types.is_date32(t):
         vals = arr.cast(pa.int32()).to_numpy(zero_copy_only=False).astype(np.int32)
         if nullm is not None:
             vals = np.where(nullm, np.int32(NULL_I32), vals)
-        return NumCol(jnp.asarray(_pad(vals, padded)), "d")
+        return NumCol(_pad(vals, padded), "d")
     if pa.types.is_date64(t):
         vals = arr.cast(pa.timestamp("ms")).cast(pa.int64()).to_numpy(zero_copy_only=False)
         vals = (vals // 86400000).astype(np.int32)
         if nullm is not None:
             vals = np.where(nullm, np.int32(NULL_I32), vals)
-        return NumCol(jnp.asarray(_pad(vals, padded)), "d")
+        return NumCol(_pad(vals, padded), "d")
     if pa.types.is_timestamp(t):
         vals = arr.cast(pa.int64()).to_numpy(zero_copy_only=False)
         return _ints_to_col(vals, padded, "t", unit=t.unit, nullm=nullm)
@@ -135,13 +133,13 @@ def arrow_column_to_device(arr: pa.ChunkedArray, padded: int):
         vals = vals.astype(config.float_dtype())
         if nullm is not None:
             vals = np.where(nullm, np.nan, vals)
-        return NumCol(jnp.asarray(_pad(vals, padded)), "f")
+        return NumCol(_pad(vals, padded), "f")
     if pa.types.is_integer(t):
         vals = arr.to_numpy(zero_copy_only=False)
         return _ints_to_col(vals, padded, "i", nullm=nullm)
     if pa.types.is_floating(t):
         vals = arr.to_numpy(zero_copy_only=False).astype(config.float_dtype())
-        return NumCol(jnp.asarray(_pad(vals, padded)), "f")
+        return NumCol(_pad(vals, padded), "f")
     raise NotImplementedError(f"arrow type {t} not supported on device yet")
 
 
@@ -149,25 +147,77 @@ def arrow_to_device(table: pa.Table, sorted_by: Optional[List[str]] = None) -> D
     n = table.num_rows
     padded = config.bucket_size(n)
     cols = {name: arrow_column_to_device(table.column(name), padded) for name in table.column_names}
-    valid = jnp.arange(padded) < n
+    return host_cols_to_device(cols, n, padded, sorted_by)
+
+
+def host_cols_to_device(
+    cols, n: int, padded: int, sorted_by: Optional[List[str]] = None
+) -> DeviceBatch:
+    """Move numpy-backed columns to device as ONE packed transfer."""
+    leaves: List[np.ndarray] = [pack.ValidCount(padded, n)]
+    slots = []  # (col, attr)
+    for col in cols.values():
+        if isinstance(col, StrCol):
+            leaves.append(col.codes)
+            slots.append((col, "codes"))
+        elif isinstance(col, VecCol):
+            leaves.append(col.data)
+            slots.append((col, "data"))
+        else:
+            leaves.append(col.data)
+            slots.append((col, "data"))
+            if col.hi is not None:
+                leaves.append(col.hi)
+                slots.append((col, "hi"))
+    device = pack.pack_put(leaves)
+    valid = device[0]
+    for (col, attr), arr in zip(slots, device[1:]):
+        setattr(col, attr, arr)
     return DeviceBatch(cols, valid, nrows=n, sorted_by=sorted_by)
 
 
 def device_to_arrow(batch: DeviceBatch) -> pa.Table:
-    """Sync a batch to the host as a compacted Arrow table (valid rows only)."""
-    mask = np.asarray(batch.valid)
+    """Sync a batch to the host as a compacted Arrow table (valid rows only).
+    All columns + the validity mask come back in ONE device->host transfer."""
+    leaves = [batch.valid]
+    slots = []
+    for col in batch.columns.values():
+        if isinstance(col, StrCol):
+            leaves.append(col.codes)
+            slots.append(1)
+        elif isinstance(col, VecCol):
+            leaves.append(col.data)
+            slots.append(1)
+        else:
+            leaves.append(col.data)
+            if col.hi is not None:
+                leaves.append(col.hi)
+                slots.append(2)
+            else:
+                slots.append(1)
+    host = pack.get_packed(leaves)
+    mask = np.asarray(host[0])
+    host_cols = {}
+    i = 1
+    for (name, col), width in zip(batch.columns.items(), slots):
+        if width == 2:
+            host_cols[name] = (host[i], host[i + 1])
+        else:
+            host_cols[name] = (host[i], None)
+        i += width
     arrays = []
     names = []
     for name, col in batch.columns.items():
+        h_data, h_hi = host_cols[name]
         names.append(name)
         if isinstance(col, VecCol):
-            mat = np.asarray(col.data)[mask]
+            mat = h_data[mask]
             flat = pa.array(mat.reshape(-1))
             arrays.append(
                 pa.FixedSizeListArray.from_arrays(flat, col.dim)
             )
         elif isinstance(col, StrCol):
-            codes = np.asarray(col.codes)[mask]
+            codes = h_data[mask]
             vals = col.dictionary.values
             out = np.empty(len(codes), dtype=object)
             for i, c in enumerate(codes):
@@ -176,9 +226,9 @@ def device_to_arrow(batch: DeviceBatch) -> pa.Table:
         else:
             from quokka_tpu.ops.batch import NULL_I32, NULL_I64
 
-            data = np.asarray(col.data)[mask]
+            data = h_data[mask]
             if col.hi is not None:
-                hi = np.asarray(col.hi)[mask]
+                hi = h_hi[mask]
                 v64 = _limbs_to_int64(hi, data)
                 nullm = v64 == NULL_I64
                 nullm = nullm if nullm.any() else None
@@ -233,9 +283,31 @@ def merge_dicts(dicts: Sequence[StringDict]):
 
 def concat_batches(batches: Sequence[DeviceBatch]) -> DeviceBatch:
     """Concatenate same-schema batches into one padded batch (host-coordinated:
-    dictionaries merge on host, data stays on device)."""
+    dictionaries merge on host, data stays on device).
+
+    When any batch's live count is unknown host-side, the concat runs fully
+    on device with NO sync: padded regions are concatenated as-is (validity
+    masks included) instead of compacting first.  The result is looser-packed
+    but avoids a blocking device round trip per input batch."""
     if len(batches) == 1:
         return batches[0]
+    # resolve counts that are nearly free first: host-known nrows, or an
+    # async-copied device count that has normally landed by concat time
+    unresolved = 0
+    for b in batches:
+        if b.nrows is None:
+            if b.nrows_dev is not None:
+                b.count_valid()
+            else:
+                unresolved += 1
+    if unresolved:
+        if sum(b.padded_len for b in batches) > config.MAX_BUCKET:
+            # sparse concat would blow past the bucket cap on padded length
+            # alone; pay the blocking counts and compact instead
+            for b in batches:
+                b.count_valid()
+        else:
+            return _concat_batches_device(batches)
     names = batches[0].names
     total = sum(b.count_valid() for b in batches)
     padded = config.bucket_size(total)
@@ -277,6 +349,43 @@ def concat_batches(batches: Sequence[DeviceBatch]) -> DeviceBatch:
     valid = jnp.arange(padded) < total
     sorted_by = batches[0].sorted_by
     return DeviceBatch(out_cols, valid, nrows=total, sorted_by=sorted_by)
+
+
+def _concat_batches_device(batches: Sequence[DeviceBatch]) -> DeviceBatch:
+    """Sync-free concat: stack full padded regions + validity masks."""
+    names = batches[0].names
+    total_padded = config.bucket_size(sum(b.padded_len for b in batches))
+    out_cols = {}
+    for name in names:
+        cols = [b.columns[name] for b in batches]
+        if isinstance(cols[0], StrCol):
+            merged, remaps = merge_dicts([c.dictionary for c in cols])
+            code_parts = []
+            for c, remap in zip(cols, remaps):
+                codes = c.codes
+                if remap is not None:
+                    remapped = jnp.asarray(remap)[jnp.maximum(codes, 0)]
+                    codes = jnp.where(codes < 0, -1, remapped)
+                code_parts.append(codes)
+            out_cols[name] = StrCol(
+                _pad_device(jnp.concatenate(code_parts), total_padded), merged
+            )
+        elif isinstance(cols[0], VecCol):
+            data = jnp.concatenate([c.data for c in cols])
+            if data.shape[0] < total_padded:
+                data = jnp.pad(data, ((0, total_padded - data.shape[0]), (0, 0)))
+            out_cols[name] = VecCol(data[:total_padded])
+        else:
+            data = _pad_device(jnp.concatenate([c.data for c in cols]), total_padded)
+            hi = None
+            if cols[0].hi is not None:
+                hi = _pad_device(jnp.concatenate([c.hi for c in cols]), total_padded)
+            out_cols[name] = NumCol(data, cols[0].kind, hi=hi, unit=cols[0].unit)
+    valid = _pad_device(
+        jnp.concatenate([jnp.asarray(b.valid) for b in batches]), total_padded
+    )  # zero-fill: padded tail rows are invalid
+    sorted_by = batches[0].sorted_by
+    return DeviceBatch(out_cols, valid, nrows=None, sorted_by=sorted_by)
 
 
 def _pad_device(arr, padded):

@@ -223,7 +223,7 @@ class FusedPartialAgg:
                 arr, "f" if jnp.issubdtype(arr.dtype, jnp.floating) else "i"
             )
         gvalid = jnp.arange(batch.padded_len) < num
-        return DeviceBatch(cols, gvalid, None, None)
+        return DeviceBatch(cols, gvalid, None, None).note_count(num)
 
     def _build(self, pre_exprs, num_names, bound_names, n_limbs):
         plan = self.plan
@@ -312,13 +312,14 @@ class FusedPredicate:
                 for name, arr in zip(bnames, barrays):
                     cols[name] = NumCol(arr, _infer_kind(arr))
                 shim = _ShimBatch(cols, valid.shape[0], valid)
-                return valid & expr_compile.evaluate_predicate(e, shim)
+                m = valid & expr_compile.evaluate_predicate(e, shim)
+                return m, jnp.sum(m.astype(jnp.int32))
 
             fn = fused
             _FUSED_PROGRAMS[sig] = fn
-        mask = fn(
+        mask, num = fn(
             tuple(num_inputs[n].data for n in num_inputs),
             tuple(pre.bound[k] for k in sorted(pre.bound)),
             batch.valid,
         )
-        return DeviceBatch(batch.columns, mask, None, batch.sorted_by)
+        return DeviceBatch(batch.columns, mask, None, batch.sorted_by).note_count(num)

@@ -34,7 +34,14 @@ from quokka_tpu.ops.batch import DeviceBatch, NumCol, StrCol, key_limbs
 
 
 def apply_mask(batch: DeviceBatch, mask: jax.Array) -> DeviceBatch:
-    return DeviceBatch(batch.columns, batch.valid & mask, None, batch.sorted_by)
+    new_valid, num = _mask_and_count(batch.valid, mask)
+    return DeviceBatch(batch.columns, new_valid, None, batch.sorted_by).note_count(num)
+
+
+@jax.jit
+def _mask_and_count(valid, mask):
+    v = valid & mask
+    return v, jnp.sum(v.astype(jnp.int32))
 
 
 @functools.partial(jax.jit, static_argnames=("out_size",))
@@ -53,6 +60,15 @@ def compact(batch: DeviceBatch) -> DeviceBatch:
     idx = _compact_idx(batch.valid, padded)
     valid = jnp.arange(padded) < n
     return batch.take(idx, valid, n)
+
+
+def compact_if_large(batch: DeviceBatch, threshold: int = 1 << 16) -> DeviceBatch:
+    """Compact only when the padded region is big enough to matter.  Small
+    batches pass through uncompacted — their blocking live-count read (a full
+    host round trip) costs far more than the slack rows they carry."""
+    if batch.padded_len <= threshold:
+        return batch
+    return compact(batch)
 
 
 def head(batch: DeviceBatch, k: int) -> DeviceBatch:
@@ -279,7 +295,7 @@ def groupby_aggregate(
     for (name, _, _), arr in zip(aggs, outs):
         cols[name] = NumCol(arr, "f" if jnp.issubdtype(arr.dtype, jnp.floating) else "i")
     group_valid = jnp.arange(n) < num
-    return DeviceBatch(cols, group_valid, None, None)
+    return DeviceBatch(cols, group_valid, None, None).note_count(num)
 
 
 def distinct(batch: DeviceBatch, keys: Sequence[str]) -> DeviceBatch:
@@ -305,12 +321,19 @@ def sort_batch(batch: DeviceBatch, by: Sequence[str], descending=None) -> Device
     limbs = sort_limbs(batch, by, descending)
     perm = _sort_perm(tuple(limbs), batch.valid)
     out = batch.take(perm, batch.valid, batch.nrows)
-    # valid rows are now contiguous at the front
-    n = batch.count_valid()
-    out.valid = jnp.arange(batch.padded_len) < n
-    out.nrows = n
+    # valid rows are now contiguous at the front; derive the mask on device
+    # (a host count here would cost a full round trip per sort) and start the
+    # count's async host copy so a later compact/head is sync-free
+    out.valid, n = _prefix_mask(batch.valid)
+    out.nrows = batch.nrows
     out.sorted_by = list(by)
-    return out
+    return out.note_count(n)
+
+
+@jax.jit
+def _prefix_mask(valid):
+    n = jnp.sum(valid.astype(jnp.int32))
+    return jnp.arange(valid.shape[0], dtype=jnp.int32) < n, n
 
 
 def top_k(batch: DeviceBatch, by: Sequence[str], k: int, descending=None) -> DeviceBatch:
@@ -345,13 +368,34 @@ def partition_ids(batch: DeviceBatch, keys: Sequence[str], n_parts: int) -> jax.
 
 
 def split_by_partition(batch: DeviceBatch, part_ids: jax.Array, n_parts: int):
-    """Split a batch into n compacted per-partition batches (host-coordinated;
-    this runs at shuffle boundaries where the host must route data anyway)."""
+    """Split a batch into n per-partition batches.
+
+    Small batches split as masked views — zero host syncs (each part keeps
+    the parent's padded length, which is cheap at these sizes).  Large
+    batches pay ONE host sync for all partition counts (a bincount readback)
+    and compact each partition to its own bucket, so a shuffle does not
+    multiply device memory by the fan-out."""
+    if batch.padded_len <= (1 << 16):
+        out = []
+        for p in range(n_parts):
+            # apply_mask ANDs with batch.valid itself
+            out.append(apply_mask(batch, part_ids == p))
+        return out
+    counts = np.asarray(_partition_counts(part_ids, batch.valid, n_parts))
     out = []
     for p in range(n_parts):
-        sub = apply_mask(batch, part_ids == p)
-        out.append(compact(sub))
+        n = int(counts[p])
+        padded = config.bucket_size(n)
+        mask = (part_ids == p) & batch.valid
+        idx = _compact_idx(mask, padded)
+        valid = jnp.arange(padded) < n
+        out.append(batch.take(idx, valid, n))
     return out
+
+
+@functools.partial(jax.jit, static_argnames=("n_parts",))
+def _partition_counts(part_ids, valid, n_parts):
+    return jnp.bincount(jnp.where(valid, part_ids, n_parts), length=n_parts + 1)[:n_parts]
 
 
 # ---------------------------------------------------------------------------

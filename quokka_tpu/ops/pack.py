@@ -1,0 +1,268 @@
+"""Coalesced host<->device transfers.
+
+Every batch crosses the host/device boundary as ONE buffer in each direction.
+Per-buffer transfer cost on TPU runtimes is dominated by round-trip latency
+(and on tunneled dev runtimes it is milliseconds per call), so the bridge
+never moves columns individually: all column arrays of a batch are packed
+into a single uint8 buffer host-side, shipped with one ``jax.device_put``,
+and sliced back into typed arrays by one jitted unpack program (bitcasts are
+free on device).  The reverse direction symmetrically packs all columns (plus
+the validity mask) into one uint8 array on device and issues one
+device->host read.
+
+Wire narrowing: integer columns whose value range fits 8/16 bits travel as
+offset-encoded uint8/uint16 and are widened back on device (the bias rides
+in the packed buffer, so the unpack program is reused across batches); float
+columns with few distinct values (TPC-H's 2-decimal discounts/taxes, rates,
+flags) travel as uint8/uint16 codes plus a small value table and are
+re-gathered on device.  This typically halves the wire bytes — which matters
+because host->device bandwidth, not device compute, is the scan bottleneck
+(SURVEY.md §7 hard part 4: host<->device transfer amortization).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+_ALIGN = 8
+# below this many elements a min/max or distinct scan costs more than it saves
+_NARROW_MIN_ELEMS = 4096
+# float columns: sample-distinct cutoff before paying for a full unique()
+_FLOAT_DICT_SAMPLE_DISTINCT = 200
+_FLOAT_DICT_MAX = 65535
+
+
+def _align(n: int) -> int:
+    return (n + _ALIGN - 1) & ~(_ALIGN - 1)
+
+
+def _int_narrow_plan(arr: np.ndarray):
+    """(wire_dtype, bias) for an integer array, or (arr.dtype, None)."""
+    mn = int(arr.min())
+    mx = int(arr.max())
+    width = mx - mn
+    if width <= 0xFF:
+        return np.dtype(np.uint8), mn
+    if width <= 0xFFFF:
+        return np.dtype(np.uint16), mn
+    return arr.dtype, None
+
+
+def _float_dict_plan(flat: np.ndarray):
+    """(codes, value_table) when the column is low-cardinality, else None.
+    Detection is a cheap host sample; the encode itself runs in Arrow C++
+    (~10ms/1M rows) — host CPU is precious (single-core ingest hosts)."""
+    stride = max(1, flat.size // 4096)
+    sample = flat[::stride][:4096]
+    # equal_nan collapses NaNs into one entry (numpy >= 1.24 default True)
+    if np.unique(sample).size > _FLOAT_DICT_SAMPLE_DISTINCT:
+        return None
+    import pyarrow as pa
+    import pyarrow.compute as pc
+
+    enc = pc.dictionary_encode(pa.array(flat))
+    uniq = enc.dictionary.to_numpy(zero_copy_only=False).astype(flat.dtype)
+    if uniq.size > _FLOAT_DICT_MAX or uniq.size == 0:
+        return None
+    wdt = np.uint8 if uniq.size <= 0xFF else np.uint16
+    codes = enc.indices.to_numpy(zero_copy_only=False).astype(wdt)
+    # pad the table to a power-of-two length so the unpack program's layout
+    # (part of its compile key) is stable across batches with slightly
+    # different distinct counts
+    tlen = max(16, 1 << (int(uniq.size - 1).bit_length()))
+    if tlen > uniq.size:
+        uniq = np.concatenate([uniq, np.full(tlen - uniq.size, uniq[-1], uniq.dtype)])
+    return codes, uniq
+
+
+# ---------------------------------------------------------------------------
+# host -> device
+# ---------------------------------------------------------------------------
+
+# layout entry: (offset, n_elems, wire_dtype_str, target_dtype_str,
+#                aux_offset_or_None, trailing_dims, aux_len)
+# aux is a bias scalar (ints), a gather table (floats), or the live-row
+# count (the "__valid__" pseudo-leaf).
+_UNPACK_PROGRAMS: Dict[Tuple, object] = {}
+
+
+def _build_unpack(layout: Tuple, total: int):
+    @jax.jit
+    def unpack(buf):
+        outs = []
+        for (off, n, wire, target, aux_off, trailing, aux_len) in layout:
+            if wire == "__valid__":
+                # validity mask materialized on device from the live-row
+                # count embedded in the buffer: 4 bytes on the wire instead
+                # of one byte per row
+                braw = lax.slice(buf, (aux_off,), (aux_off + 4,))
+                cnt = lax.bitcast_convert_type(braw.reshape(1, 4), jnp.int32)[0]
+                outs.append(jnp.arange(n, dtype=jnp.int32) < cnt)
+                continue
+            wdt = jnp.dtype(wire)
+            tdt = jnp.dtype(target) if target != "bool" else jnp.dtype(jnp.bool_)
+            isz = wdt.itemsize
+            raw = lax.slice(buf, (off,), (off + n * isz,))
+            if isz == 1:
+                arr = lax.bitcast_convert_type(raw, wdt)
+            else:
+                arr = lax.bitcast_convert_type(raw.reshape(n, isz), wdt)
+            if target == "bool":
+                arr = arr != 0
+            elif aux_off is not None and jnp.issubdtype(tdt, jnp.floating):
+                # low-cardinality float: codes -> gather from the value table
+                tsz = tdt.itemsize
+                traw = lax.slice(buf, (aux_off,), (aux_off + aux_len * tsz,))
+                table = lax.bitcast_convert_type(traw.reshape(aux_len, tsz), tdt)
+                arr = table[arr.astype(jnp.int32)]
+            elif wire != target:
+                arr = arr.astype(tdt)
+                if aux_off is not None:
+                    bsz = tdt.itemsize
+                    braw = lax.slice(buf, (aux_off,), (aux_off + bsz,))
+                    bias = lax.bitcast_convert_type(braw.reshape(1, bsz), tdt)[0]
+                    arr = arr + bias
+            if trailing:
+                arr = arr.reshape((n // int(np.prod(trailing)),) + trailing)
+            outs.append(arr)
+        return tuple(outs)
+
+    return unpack
+
+
+class ValidCount:
+    """Marker leaf for pack_put: becomes a bool[padded] validity mask computed
+    on device as ``arange(padded) < nrows`` (only the count crosses the wire)."""
+
+    def __init__(self, padded: int, nrows: int):
+        self.padded = padded
+        self.nrows = nrows
+
+
+def pack_put(leaves: Sequence) -> List[jax.Array]:
+    """Transfer numpy arrays to device as one buffer; returns device arrays
+    with the original dtypes/shapes (bools stay bool, narrowed ints/floats
+    widened back).  ``ValidCount`` leaves come back as device bool masks."""
+    if not leaves:
+        return []
+    offset = 0
+    layout = []
+    auxes = []  # (layout_index, aux_numpy_array)
+    views = []
+    for arr in leaves:
+        if isinstance(arr, ValidCount):
+            layout.append([0, arr.padded, "__valid__", "bool", None, (), 0])
+            auxes.append((len(layout) - 1, np.array([arr.nrows], dtype=np.int32)))
+            continue
+        arr = np.ascontiguousarray(arr)
+        trailing = tuple(arr.shape[1:])
+        flat = arr.reshape(-1)
+        n = flat.size
+        target = "bool" if arr.dtype == np.bool_ else str(arr.dtype)
+        aux = None
+        if arr.dtype == np.bool_:
+            wire_arr = flat.view(np.uint8)
+            wire = "uint8"
+        elif arr.dtype in (np.int32, np.int64) and n >= _NARROW_MIN_ELEMS:
+            wdt, bias = _int_narrow_plan(flat)
+            if bias is not None:
+                wire_arr = (flat - bias).astype(wdt)
+                aux = np.array([bias], dtype=arr.dtype)
+            else:
+                wire_arr = flat
+            wire = str(wdt)
+        elif arr.dtype in (np.float32, np.float64) and n >= _NARROW_MIN_ELEMS:
+            plan = _float_dict_plan(flat)
+            if plan is not None:
+                wire_arr, aux = plan
+                wire = str(wire_arr.dtype)
+            else:
+                wire_arr = flat
+                wire = target
+        else:
+            wire_arr = flat
+            wire = target
+        off = offset
+        offset = _align(off + wire_arr.nbytes)
+        views.append((off, wire_arr))
+        layout.append([off, n, wire, target, None, trailing,
+                       0 if aux is None else len(aux)])
+        if aux is not None:
+            auxes.append((len(layout) - 1, aux))
+    for idx, aval in auxes:
+        off = offset
+        offset = _align(off + aval.nbytes)
+        views.append((off, aval.view(np.uint8)))
+        layout[idx][4] = off
+    total = offset if offset else _ALIGN
+    buf = np.zeros(total, dtype=np.uint8)
+    for off, v in views:
+        buf[off : off + v.nbytes] = v.view(np.uint8)
+    key = (tuple(tuple(e) for e in layout), total)
+    prog = _UNPACK_PROGRAMS.get(key)
+    if prog is None:
+        prog = _build_unpack(key[0], total)
+        _UNPACK_PROGRAMS[key] = prog
+    dbuf = jax.device_put(buf)
+    return list(prog(dbuf))
+
+
+# ---------------------------------------------------------------------------
+# device -> host
+# ---------------------------------------------------------------------------
+
+_PACK_PROGRAMS: Dict[Tuple, object] = {}
+
+
+def _build_pack(sig: Tuple):
+    @jax.jit
+    def pack(arrays):
+        parts = []
+        for a in arrays:
+            if a.dtype == jnp.bool_:
+                a = a.astype(jnp.uint8)
+            flat = a.reshape(-1)
+            if flat.dtype.itemsize == 1:
+                raw = lax.bitcast_convert_type(flat, jnp.uint8)
+            else:
+                raw = lax.bitcast_convert_type(flat, jnp.uint8).reshape(-1)
+            parts.append(raw)
+        return jnp.concatenate(parts) if parts else jnp.zeros(0, jnp.uint8)
+
+    return pack
+
+
+def get_packed(arrays: Sequence[jax.Array]) -> List[np.ndarray]:
+    """Read device arrays back to host as one transfer; returns numpy arrays
+    with the original dtypes/shapes."""
+    if not arrays:
+        return []
+    # pure-numpy arrays (already host) pass through
+    if all(isinstance(a, np.ndarray) for a in arrays):
+        return [np.asarray(a) for a in arrays]
+    sig = tuple((str(a.dtype), tuple(a.shape)) for a in arrays)
+    prog = _PACK_PROGRAMS.get(sig)
+    if prog is None:
+        prog = _build_pack(sig)
+        _PACK_PROGRAMS[sig] = prog
+    buf = np.asarray(prog(tuple(jnp.asarray(a) for a in arrays)))
+    outs = []
+    off = 0
+    for dt, shape in sig:
+        npdt = np.dtype(np.bool_) if dt == "bool" else np.dtype(dt)
+        n = int(np.prod(shape)) if shape else 1
+        nbytes = n * (1 if dt == "bool" else npdt.itemsize)
+        raw = buf[off : off + nbytes]
+        if dt == "bool":
+            arr = raw.view(np.uint8).astype(np.bool_)
+        else:
+            arr = np.frombuffer(raw.tobytes(), dtype=npdt, count=n)
+        outs.append(arr.reshape(shape))
+        off += nbytes
+    return outs

@@ -347,38 +347,80 @@ class Engine:
     # strictly sequential).  reader.execute is pure per lineage, so the
     # prefetched table is byte-identical to a synchronous read — replay
     # determinism is unaffected.
-    def _take_prefetched(self, info, task, seq):
-        pf = getattr(self, "_prefetch", None)
-        if pf is None:
-            pf = self._prefetch = {}
+    def _read_and_bridge(self, info, channel: int, lineage) -> DeviceBatch:
+        """Read one lineage and land it on device: decode -> (projection) ->
+        dictionary-encode/pack -> one device_put.  Runs on the prefetch
+        threads so host decode + the h2d transfer overlap device compute
+        (reader.execute is pure per lineage, so a prefetched batch is
+        byte-identical to a synchronous read — replay determinism holds).
+
+        Hot segments come from the device scan cache (buffer-pool role,
+        runtime/scancache.py): a warm re-scan of an unchanged file skips
+        decode, encode and the h2d transfer entirely."""
+        from quokka_tpu.runtime import scancache
+
+        ckey = None
+        key_fn = getattr(info.reader, "cache_key", None)
+        if key_fn is not None and scancache.GLOBAL.enabled:
+            base = key_fn(channel, lineage)
+            if base is not None:
+                ckey = (
+                    base,
+                    tuple(info.projection or ()),
+                    tuple(info.sorted_by or ()),
+                    config.x64_enabled(),  # dtype regime changes device layout
+                )
+                cached = scancache.GLOBAL.get(ckey)
+                if cached is not None:
+                    return cached
+        with tracing.span("reader.execute"):
+            table = info.reader.execute(channel, lineage)
+        if info.projection is not None:
+            keep = [c for c in info.projection if c in table.column_names]
+            table = table.select(keep)
+        with tracing.span("bridge.to_device"):
+            batch = bridge.arrow_to_device(table, sorted_by=info.sorted_by)
+        if ckey is not None:
+            scancache.GLOBAL.put(ckey, batch)
+        return batch
+
+    def _ensure_prefetch_pool(self):
+        if getattr(self, "_prefetch", None) is None:
             import concurrent.futures
 
+            self._prefetch = {}
             self._prefetch_pool = concurrent.futures.ThreadPoolExecutor(
-                max_workers=2, thread_name_prefix="quokka-io"
+                max_workers=self._io_threads(), thread_name_prefix="quokka-io"
             )
+        return self._prefetch
+
+    def _take_prefetched(self, info, task, seq):
+        pf = self._ensure_prefetch_pool()
         key = (task.actor, task.channel)
         fut = pf.pop(key, None)
-        table = None
+        batch = None
         if fut is not None:
             want, f = fut
             if want == seq:
-                table = f.result()
+                with tracing.span("prefetch.wait"):
+                    batch = f.result()
             else:
                 f.cancel()
-        if table is None:
+        if batch is None:
             lineage = self.store.tget("LT", (task.actor, task.channel, seq))
-            with tracing.span("reader.execute"):
-                table = info.reader.execute(task.channel, lineage)
-        # schedule the next seq while this batch bridges + computes
+            batch = self._read_and_bridge(info, task.channel, lineage)
+        # schedule the next seq while this batch computes
         nxt = task.peek_next_seq() if hasattr(task, "peek_next_seq") else None
         if nxt is not None:
             lineage_n = self.store.tget("LT", (task.actor, task.channel, nxt))
             if lineage_n is not None:
                 pf[key] = (
                     nxt,
-                    self._prefetch_pool.submit(info.reader.execute, task.channel, lineage_n),
+                    self._prefetch_pool.submit(
+                        self._read_and_bridge, info, task.channel, lineage_n
+                    ),
                 )
-        return table
+        return batch
 
     def handle_input_task(self, task: TapedInputTask) -> bool:
         info = self.g.actors[task.actor]
@@ -389,12 +431,7 @@ class Engine:
         if self._throttled(info, task.channel, seq):
             self.store.ntt_push(task.actor, task)
             return False
-        table = self._take_prefetched(info, task, seq)
-        if info.projection is not None:
-            keep = [c for c in info.projection if c in table.column_names]
-            table = table.select(keep)
-        with tracing.span("bridge.to_device"):
-            batch = bridge.arrow_to_device(table, sorted_by=info.sorted_by)
+        batch = self._take_prefetched(info, task, seq)
         if info.predicate is not None:
             with tracing.span("source.predicate"):
                 batch = info.predicate(batch)
@@ -448,16 +485,19 @@ class Engine:
             if not chans:
                 del task.input_reqs[src]
                 extra = executor.source_done(info.source_streams[src], task.channel)
-                emitted = extra is not None and extra.count_valid() > 0
+                # emit decisions never inspect device data (a live-row count is
+                # a full host round trip); empty batches flow and are harmless
+                emitted = extra is not None
                 if emitted:
                     self._emit(info, task.channel, out_seq, extra)
-                    self._metric(task.actor, task.channel, extra.count_valid(), 0)
+                    self._metric(task.actor, task.channel, self._rows_of(extra), 0)
                     out_seq += 1
                 self._tape(task.actor, task.channel,
                            ("srcdone", info.source_streams[src], emitted))
         task.out_seq = out_seq
         if not task.input_reqs:
-            out = executor.done(task.channel)
+            with tracing.span(f"done.{type(executor).__name__}"):
+                out = executor.done(task.channel)
             # spill-tier executors (external sort, grace join) emit their
             # result as a lazy SEQUENCE of bounded batches — a generator keeps
             # only one merged batch on device at a time
@@ -466,9 +506,9 @@ class Engine:
             else:
                 outs = out  # list or generator
             for o in outs:
-                if o is not None and o.count_valid() > 0:
+                if o is not None:
                     self._emit(info, task.channel, out_seq, o)
-                    self._metric(task.actor, task.channel, o.count_valid(), 0)
+                    self._metric(task.actor, task.channel, self._rows_of(o), 0)
                     out_seq += 1
             with self.store.transaction():
                 self.store.tset("LIT", (task.actor, task.channel), out_seq - 1)
@@ -493,12 +533,12 @@ class Engine:
         with tracing.span(f"exec.{type(executor).__name__}"):
             out = executor.execute(batches, stream_id, task.channel)
         out_seq = task.out_seq
-        emitted = out is not None and out.count_valid() > 0
+        emitted = out is not None
         if emitted:
             with tracing.span("push.exec"):
                 self._emit(info, task.channel, out_seq, out)
             out_seq += 1
-        self._metric(task.actor, task.channel, 0 if out is None else out.count_valid(), 0)
+        self._metric(task.actor, task.channel, self._rows_of(out), 0)
         self._tape(task.actor, task.channel, ("exec", src_actor, tuple(names), emitted))
         consumed: Dict[int, Dict[int, int]] = {src_actor: {}}
         for (sa, sch, seq, *_rest) in names:
@@ -517,25 +557,47 @@ class Engine:
     # -- metrics --------------------------------------------------------------
     _METRICS_FLUSH_EVERY = 64
 
-    def _metric(self, actor: int, channel: int, rows: int, nbytes: int) -> None:
+    def _metric(self, actor: int, channel: int, rows, nbytes: int) -> None:
+        """rows: an int, or a device count scalar (resolved lazily at flush
+        time, when its async host copy has long landed — emit paths must not
+        block on a device round trip for a counter)."""
         m = getattr(self, "_metrics", None)
         if m is None:
             m = self._metrics = {}
             self._metrics_dirty = 0
+            self._metrics_pending = []
         key = (actor, channel)
         e = m.get(key)
         if e is None:
             e = m[key] = {"tasks": 0, "rows": 0, "bytes": 0}
         e["tasks"] += 1
-        e["rows"] += rows
+        if isinstance(rows, int):
+            e["rows"] += rows
+        elif rows is not None:
+            self._metrics_pending.append((key, rows))
         e["bytes"] += nbytes
         self._metrics_dirty += 1
         if self._metrics_dirty >= self._METRICS_FLUSH_EVERY:
             self._flush_metrics()
 
+    def _rows_of(self, batch):
+        """Host count if known, else the batch's async device count (for
+        deferred metric resolution), else None."""
+        if batch is None:
+            return 0
+        if batch.nrows is not None:
+            return batch.nrows
+        return batch.nrows_dev
+
     def _flush_metrics(self) -> None:
         m = getattr(self, "_metrics", None)
         if m:
+            for key, dev in getattr(self, "_metrics_pending", []):
+                try:
+                    m[key]["rows"] += int(dev)
+                except Exception:
+                    pass  # a dead device buffer must not sink the flush
+            self._metrics_pending = []
             wid = getattr(self, "worker_id", "embedded")
             self.store.set(("metrics", wid), {k: dict(v) for k, v in m.items()})
             self._metrics_dirty = 0
@@ -677,7 +739,7 @@ class Engine:
                         b = bridge.arrow_to_device(table)
                     batches.append(b)
                 out = executor.execute(batches, info.source_streams[src_actor], ch)
-                re_emitted = out is not None and out.count_valid() > 0
+                re_emitted = out is not None
                 assert re_emitted == emitted, "non-deterministic replay"
                 if re_emitted:
                     self._emit(info, ch, out_seq, out)
@@ -691,7 +753,7 @@ class Engine:
                 # re-drops them (executors guard repeated source_done calls)
                 _, stream_id, emitted = ev
                 extra = executor.source_done(stream_id, ch)
-                re_emitted = extra is not None and extra.count_valid() > 0
+                re_emitted = extra is not None
                 assert re_emitted == emitted, "non-deterministic replay"
                 if re_emitted:
                     self._emit(info, ch, out_seq, extra)
@@ -712,7 +774,9 @@ class Engine:
 
     def _emit(self, info: ActorInfo, channel: int, seq: int, out: DeviceBatch) -> None:
         if getattr(info, "blocking", False) or info.blocking_dataset is not None:
-            self._result_append(info, channel, seq, bridge.device_to_arrow(out))
+            with tracing.span("emit.result_d2h"):
+                table = bridge.device_to_arrow(out)
+            self._result_append(info, channel, seq, table)
         else:
             self.push(info.id, channel, seq, out)
 
@@ -738,10 +802,40 @@ class Engine:
                 pass  # a dead store must not block thread shutdown below
             self._shutdown_prefetch()
 
+    def _io_threads(self) -> int:
+        n = sum(a.channels for a in self.g.actors.values() if a.kind == "input")
+        return max(2, min(4, n))
+
+    def _warm_prefetch(self, actors) -> None:
+        """Kick off the first read of every stage-0 input channel before the
+        task loop starts, so initial decode+h2d runs in parallel across
+        channels instead of serially on first touch."""
+        if getattr(self, "_warmed", False):
+            return  # re-entrant run(): finished channels must not re-read
+        self._warmed = True
+        self._ensure_prefetch_pool()
+        for info in actors:
+            if info.kind != "input" or info.stage != 0:
+                continue
+            for ch in range(info.channels):
+                key = (info.id, ch)
+                if key in self._prefetch or self.store.scontains(
+                    "DST", (info.id, ch), "done"
+                ):
+                    continue
+                lineage = self.store.tget("LT", (info.id, ch, 0))
+                if lineage is None:
+                    continue
+                self._prefetch[key] = (
+                    0,
+                    self._prefetch_pool.submit(self._read_and_bridge, info, ch, lineage),
+                )
+
     def _run(self, max_batches: Optional[int], timeout: float) -> None:
         if max_batches is not None:
             self.max_batches = max_batches
         actors = sorted(self.g.actors.values(), key=lambda a: (a.stage, a.id))
+        self._warm_prefetch(actors)
         stages = sorted({a.stage for a in actors})
         stage_idx = 0
         t0 = time.time()

@@ -1,0 +1,114 @@
+"""Device-resident scan (buffer-pool) cache.
+
+The role a buffer pool / page cache plays in a CPU database: hot table
+segments stay resident so repeated scans skip IO.  Here the cached unit is
+the POST-BRIDGE DeviceBatch — decoded, dictionary-encoded, packed and already
+living in device HBM — so a warm re-scan skips parquet decode, host encode
+AND the host->device transfer (the two dominant costs of a scan on a
+single-core ingest host behind a thin accelerator link).
+
+Correctness: entries are keyed by the reader-provided identity of the
+underlying bytes (path, mtime_ns, size, row-group, projection), so a
+rewritten file never serves stale data.  DeviceBatch columns are immutable
+jax arrays; the cache hands out a shallow copy so callers can attach their
+own nrows/sorted_by metadata.
+
+Scope: readers opt in by exposing ``cache_key(channel, lineage)``; lineages
+whose bytes are not reproducible (REST pages, ray objects) return None and
+bypass the cache.  Capped by bytes with LRU eviction
+(QUOKKA_SCAN_CACHE_BYTES, 0 disables).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+from typing import Optional, Tuple
+
+from quokka_tpu.ops.batch import DeviceBatch
+
+def _default_bytes() -> int:
+    env = os.environ.get("QUOKKA_SCAN_CACHE_BYTES")
+    if env is not None:
+        return int(env)
+    try:
+        import jax
+
+        backend = jax.default_backend()
+    except Exception:
+        backend = "cpu"
+    # TPU HBM is >= 16 GB; host-memory (CPU) runs get a modest default so
+    # tests and small boxes are not pinned by cached scans
+    return (2 << 30) if backend not in ("cpu",) else (256 << 20)
+
+
+def _batch_nbytes(batch: DeviceBatch) -> int:
+    from quokka_tpu.runtime.cache import _batch_nbytes as nb
+
+    return nb(batch)
+
+
+class ScanCache:
+    def __init__(self, cap_bytes: Optional[int] = None):
+        self.cap = _default_bytes() if cap_bytes is None else cap_bytes
+        self._lock = threading.Lock()
+        self._data: "OrderedDict[Tuple, Tuple[DeviceBatch, int]]" = OrderedDict()
+        self._bytes = 0
+        self.hits = 0
+        self.misses = 0
+
+    @property
+    def enabled(self) -> bool:
+        return self.cap > 0
+
+    def get(self, key: Tuple) -> Optional[DeviceBatch]:
+        with self._lock:
+            ent = self._data.get(key)
+            if ent is None:
+                self.misses += 1
+                return None
+            self._data.move_to_end(key)
+            self.hits += 1
+            b, _ = ent
+        return DeviceBatch(dict(b.columns), b.valid, b.nrows, b.sorted_by, b.nrows_dev)
+
+    def put(self, key: Tuple, batch: DeviceBatch) -> None:
+        if not self.enabled:
+            return
+        nb = _batch_nbytes(batch)
+        if nb > self.cap:
+            return
+        snap = DeviceBatch(
+            dict(batch.columns), batch.valid, batch.nrows, batch.sorted_by, batch.nrows_dev
+        )
+        with self._lock:
+            old = self._data.pop(key, None)
+            if old is not None:
+                self._bytes -= old[1]
+            self._data[key] = (snap, nb)
+            self._bytes += nb
+            while self._bytes > self.cap and self._data:
+                _, (_, oldnb) = self._data.popitem(last=False)
+                self._bytes -= oldnb
+
+    def clear(self) -> None:
+        with self._lock:
+            self._data.clear()
+            self._bytes = 0
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "entries": len(self._data),
+                "bytes": self._bytes,
+                "hits": self.hits,
+                "misses": self.misses,
+            }
+
+
+GLOBAL = ScanCache()
+
+
+def clear() -> None:
+    GLOBAL.clear()

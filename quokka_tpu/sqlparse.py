@@ -38,7 +38,7 @@ from quokka_tpu.expression import (
 _TOKEN_RE = re.compile(
     r"""
     (?P<ws>\s+)
-  | (?P<num>\d+\.\d*|\.\d+|\d+)
+  | (?P<num>(?:\d+\.\d*|\.\d+|\d+)(?:[eE][+-]?\d+)?)
   | (?P<str>'(?:[^']|'')*')
   | (?P<ident>[A-Za-z_][A-Za-z_0-9]*(?:\.[A-Za-z_][A-Za-z_0-9]*)*)
   | (?P<op><=|>=|<>|!=|\|\||==|[(),*+\-/%=<>])
@@ -393,7 +393,7 @@ def _unquote(s: str) -> str:
 
 
 def _num(s: str):
-    return float(s) if ("." in s) else int(s)
+    return float(s) if ("." in s or "e" in s or "E" in s) else int(s)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,72 @@
+"""Device scan (buffer-pool) cache: warm hits, file-rewrite invalidation."""
+
+import os
+import time
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from quokka_tpu import QuokkaContext
+from quokka_tpu.runtime import scancache
+
+
+@pytest.fixture(autouse=True)
+def fresh_cache():
+    scancache.clear()
+    yield
+    scancache.clear()
+
+
+def _write(path, seed):
+    rng = np.random.default_rng(seed)
+    df = pd.DataFrame({"k": rng.choice(["a", "b"], 1000), "v": rng.uniform(0, 1, 1000)})
+    pq.write_table(pa.Table.from_pandas(df, preserve_index=False), path)
+    return df
+
+
+def _q(path):
+    ctx = QuokkaContext(io_channels=1, exec_channels=1)
+    return (
+        ctx.read_parquet(path).groupby("k").agg_sql("sum(v) as sv").collect()
+        .sort_values("k").reset_index(drop=True)
+    )
+
+
+def test_warm_hit_and_rewrite_invalidation(tmp_path):
+    p = str(tmp_path / "t.parquet")
+    df = _write(p, 1)
+    out1 = _q(p)
+    stats = scancache.GLOBAL.stats()
+    assert stats["entries"] >= 1 and stats["misses"] >= 1
+    out2 = _q(p)
+    stats2 = scancache.GLOBAL.stats()
+    assert stats2["hits"] >= 1, stats2
+    pd.testing.assert_frame_equal(out1, out2)
+    want = df.groupby("k").agg(sv=("v", "sum")).reset_index()
+    assert np.allclose(out2["sv"].to_numpy(), want["sv"].to_numpy())
+
+    # rewrite the file: cache must not serve stale rows
+    time.sleep(0.01)
+    df3 = _write(p, 2)
+    out3 = _q(p)
+    want3 = df3.groupby("k").agg(sv=("v", "sum")).reset_index()
+    assert np.allclose(out3["sv"].to_numpy(), want3["sv"].to_numpy())
+
+
+def test_cap_and_disable(tmp_path):
+    p = str(tmp_path / "t.parquet")
+    _write(p, 3)
+    small = scancache.ScanCache(cap_bytes=1)  # nothing fits
+    old = scancache.GLOBAL
+    scancache.GLOBAL = small
+    try:
+        _q(p)
+        assert small.stats()["entries"] == 0
+    finally:
+        scancache.GLOBAL = old
+
+    disabled = scancache.ScanCache(cap_bytes=0)
+    assert not disabled.enabled
