@@ -11,4 +11,11 @@ __version__ = "0.1.0"
 from quokka_tpu.context import QuokkaContext
 from quokka_tpu.datastream import DataStream, GroupedDataStream, OrderedStream
 from quokka_tpu.expression import col, date, interval, lit, when
+from quokka_tpu.runtime.placement import (
+    CustomChannelsStrategy,
+    DatasetStrategy,
+    PlacementStrategy,
+    SingleChannelStrategy,
+    TaggedCustomChannelsStrategy,
+)
 

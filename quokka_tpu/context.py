@@ -56,6 +56,17 @@ class QuokkaContext:
         self._next_node = 0
         self.latest_graph = None  # last executed TaskGraph (introspection)
 
+    @property
+    def cluster_workers(self) -> int:
+        """Worker-process count placement strategies resolve against (1 for
+        the embedded engine)."""
+        n = getattr(self.cluster, "n_workers", 0) if self.cluster else 0
+        return max(1, n)
+
+    @property
+    def worker_tags(self):
+        return getattr(self.cluster, "worker_tags", None) if self.cluster else None
+
     def set_config(self, key, value):
         self.exec_config[key] = value
 
@@ -274,6 +285,10 @@ class QuokkaContext:
         actor_of: Dict[int, int] = {}
         for nid in self._toposort(sub, sink_id):
             sub[nid].lower(self, graph, actor_of, nid)
+        for nid, aid in actor_of.items():
+            pl = getattr(sub.get(nid), "placement", None)
+            if pl is not None:
+                graph.actors[aid].placement = pl
         self.latest_graph = graph
         n_workers = getattr(self.cluster, "n_workers", 0) if self.cluster else 0
         if n_workers:
@@ -285,6 +300,7 @@ class QuokkaContext:
                     n_workers=n_workers,
                     kill_after_inputs=self.exec_config.get("inject_kill_worker"),
                     heartbeat_timeout=self.exec_config.get("heartbeat_timeout"),
+                    worker_tags=self.worker_tags,
                 )
             finally:
                 graph.cleanup()

@@ -145,22 +145,32 @@ class DataStream:
         return self._child(logical.MapNode([self.node_id], new_schema, wrapped))
 
     def stateful_transform(self, executor, new_schema: List[str],
-                           required_columns=None, by=None) -> "DataStream":
+                           required_columns=None, by=None,
+                           placement=None) -> "DataStream":
         """Run a user Executor over the stream, optionally key-partitioned
-        (datastream.py:1312)."""
+        (datastream.py:1312).  placement: a runtime/placement.py strategy
+        (e.g. SingleChannelStrategy for unsharded state, or
+        TaggedCustomChannelsStrategy to pin channels to tagged workers) —
+        reference placement_strategy kwarg, datastream.py:1312."""
         from quokka_tpu.target_info import HashPartitioner, PassThroughPartitioner
 
         part = HashPartitioner(list(by)) if by else PassThroughPartitioner()
         import copy as _copy
 
-        return self._child(
-            logical.StatefulNode(
-                [self.node_id],
-                new_schema,
-                functools.partial(_copy.deepcopy, executor),
-                partitioners={0: part},
-            )
+        node = logical.StatefulNode(
+            [self.node_id],
+            new_schema,
+            functools.partial(_copy.deepcopy, executor),
+            partitioners={0: part},
         )
+        if placement is not None:
+            node.placement = placement
+            node.channels = placement.num_channels(
+                getattr(self.ctx, "cluster_workers", 1),
+                self.ctx.exec_channels,
+                getattr(self.ctx, "worker_tags", None),
+            )
+        return self._child(node)
 
     def cogroup(self, right: "DataStream", fn, new_schema, on=None,
                 left_on=None, right_on=None) -> "DataStream":

@@ -36,6 +36,9 @@ class Node:
         # before this node's streaming side runs (join build sides)
         self.build_parents: List[int] = []
         self.sorted_by: Optional[List[str]] = None
+        # runtime/placement.py strategy: fixes the channel count at lowering
+        # and pins channels to workers in the distributed runtime
+        self.placement = None
 
     def lower(self, ctx, graph, actor_of: Dict[int, int], node_id: int) -> None:
         raise NotImplementedError

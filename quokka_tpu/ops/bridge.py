@@ -338,6 +338,7 @@ def concat_batches(batches: Sequence[DeviceBatch]) -> DeviceBatch:
                 data = jnp.pad(data, ((0, padded - data.shape[0]), (0, 0)))
             out_cols[name] = VecCol(data[:padded])
         else:
+            cols = _align_limbs(cols)
             data = jnp.concatenate([c.data[:cnt] for c, cnt in zip(cols, counts)])
             data = _pad_device(data, padded)
             hi = None
@@ -376,6 +377,7 @@ def _concat_batches_device(batches: Sequence[DeviceBatch]) -> DeviceBatch:
                 data = jnp.pad(data, ((0, total_padded - data.shape[0]), (0, 0)))
             out_cols[name] = VecCol(data[:total_padded])
         else:
+            cols = _align_limbs(cols)
             data = _pad_device(jnp.concatenate([c.data for c in cols]), total_padded)
             hi = None
             if cols[0].hi is not None:
@@ -386,6 +388,32 @@ def _concat_batches_device(batches: Sequence[DeviceBatch]) -> DeviceBatch:
     )  # zero-fill: padded tail rows are invalid
     sorted_by = batches[0].sorted_by
     return DeviceBatch(out_cols, valid, nrows=None, sorted_by=sorted_by)
+
+
+def _align_limbs(cols: Sequence[NumCol]) -> Sequence[NumCol]:
+    """Promote plain-int32 columns to the two-limb representation when ANY
+    sibling batch carries limbs.  _ints_to_col picks int32 vs limbs per batch
+    from that batch's value range, so a stream can legitimately mix the two —
+    concatenating a biased lo_sortable limb with plain values (and dropping
+    hi) would silently corrupt every wide row."""
+    if all(c.hi is None for c in cols) or all(c.hi is not None for c in cols):
+        return cols
+    from quokka_tpu.ops.batch import NULL_I32
+    from quokka_tpu.ops.timewide import widen_limbs
+
+    out = []
+    for c in cols:
+        if c.hi is not None:
+            out.append(c)
+            continue
+        hi, lo = widen_limbs(c)
+        # the plain-int32 null sentinel must become the wide null sentinel
+        # (hi, lo) == (NULL_I32, NULL_I32), not the numeric value -2**31
+        isnull = c.data == NULL_I32
+        hi = jnp.where(isnull, jnp.int32(NULL_I32), hi)
+        lo = jnp.where(isnull, jnp.int32(NULL_I32), lo)
+        out.append(NumCol(lo, c.kind, hi=hi, unit=c.unit))
+    return out
 
 
 def _pad_device(arr, padded):

@@ -1,139 +1,61 @@
-"""Coalesced host<->device transfers.
+"""Coalesced, compile-stable host<->device transfers.
 
-Every batch crosses the host/device boundary as ONE buffer in each direction.
-Per-buffer transfer cost on TPU runtimes is dominated by round-trip latency
-(and on tunneled dev runtimes it is milliseconds per call), so the bridge
-never moves columns individually: all column arrays of a batch are packed
-into a single uint8 buffer host-side, shipped with one ``jax.device_put``,
-and sliced back into typed arrays by one jitted unpack program (bitcasts are
-free on device).  The reverse direction symmetrically packs all columns (plus
-the validity mask) into one uint8 array on device and issues one
-device->host read.
+Every batch crosses the host/device boundary with ONE runtime call in each
+direction: ``jax.device_put`` of the whole list of (narrowed) column arrays,
+and ``jax.device_get`` of the whole list coming back.  Decoding back to the
+logical dtypes happens in one small jitted elementwise program per *layout*
+(astype + bias add, table gathers, ``arange < count`` for validity).
 
-Wire narrowing: integer columns whose value range fits 8/16 bits travel as
-offset-encoded uint8/uint16 and are widened back on device (the bias rides
-in the packed buffer, so the unpack program is reused across batches); float
-columns with few distinct values (TPC-H's 2-decimal discounts/taxes, rates,
-flags) travel as uint8/uint16 codes plus a small value table and are
-re-gathered on device.  This typically halves the wire bytes — which matters
-because host->device bandwidth, not device compute, is the scan bottleneck
-(SURVEY.md §7 hard part 4: host<->device transfer amortization).
+Design note — why a list of typed arrays and not one byte buffer: the first
+cut of this module packed all columns into a single uint8 buffer and sliced/
+bitcast it apart on device.  That unpack program is compile-hostile on TPU
+(uint8 reshapes + bitcasts across lane tiling): a single 7-column/1M-row
+layout took ~400 s of XLA compile over the dev tunnel, and because the
+layout (offsets, widths) changed whenever a batch's value ranges changed,
+queries recompiled it repeatedly.  A pytree ``device_put`` costs the same
+single RPC, and the decode program here is plain elementwise/gather code
+that compiles in ~1 s.
+
+Wire narrowing (kept from the first cut): integer columns whose value range
+fits 8/16 bits travel as offset-encoded uint8/uint16 and are widened back on
+device (the bias rides as a tiny data array, NOT in the compile key); float
+columns with few distinct values (TPC-H's 2-decimal discounts/taxes, rates)
+travel as uint8/uint16 codes plus a small value table and are re-gathered on
+device.  This typically halves wire bytes — host->device bandwidth, not
+device compute, is the scan bottleneck (SURVEY.md §7 hard part 4).
+
+Narrowing decisions are STICKY per batch-signature (dtypes + shapes): the
+first batch picks each column's wire format and later batches conform,
+widening the plan monotonically (at most two recompiles per column ever)
+when a batch's range no longer fits.  This keeps the decode program's
+compile key stable across batches — the property whose absence caused the
+pathological recompiles above.
 """
 
 from __future__ import annotations
 
-import functools
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import lax
 
-_ALIGN = 8
 # below this many elements a min/max or distinct scan costs more than it saves
 _NARROW_MIN_ELEMS = 4096
 # float columns: sample-distinct cutoff before paying for a full unique()
 _FLOAT_DICT_SAMPLE_DISTINCT = 200
 _FLOAT_DICT_MAX = 65535
 
-
-def _align(n: int) -> int:
-    return (n + _ALIGN - 1) & ~(_ALIGN - 1)
+_WIDTH = {"uint8": 0, "uint16": 1}  # narrowing lattice; full width = 2
 
 
-def _int_narrow_plan(arr: np.ndarray):
-    """(wire_dtype, bias) for an integer array, or (arr.dtype, None)."""
-    mn = int(arr.min())
-    mx = int(arr.max())
+def _int_wire_needed(mn: int, mx: int) -> str:
     width = mx - mn
     if width <= 0xFF:
-        return np.dtype(np.uint8), mn
+        return "uint8"
     if width <= 0xFFFF:
-        return np.dtype(np.uint16), mn
-    return arr.dtype, None
-
-
-def _float_dict_plan(flat: np.ndarray):
-    """(codes, value_table) when the column is low-cardinality, else None.
-    Detection is a cheap host sample; the encode itself runs in Arrow C++
-    (~10ms/1M rows) — host CPU is precious (single-core ingest hosts)."""
-    stride = max(1, flat.size // 4096)
-    sample = flat[::stride][:4096]
-    # equal_nan collapses NaNs into one entry (numpy >= 1.24 default True)
-    if np.unique(sample).size > _FLOAT_DICT_SAMPLE_DISTINCT:
-        return None
-    import pyarrow as pa
-    import pyarrow.compute as pc
-
-    enc = pc.dictionary_encode(pa.array(flat))
-    uniq = enc.dictionary.to_numpy(zero_copy_only=False).astype(flat.dtype)
-    if uniq.size > _FLOAT_DICT_MAX or uniq.size == 0:
-        return None
-    wdt = np.uint8 if uniq.size <= 0xFF else np.uint16
-    codes = enc.indices.to_numpy(zero_copy_only=False).astype(wdt)
-    # pad the table to a power-of-two length so the unpack program's layout
-    # (part of its compile key) is stable across batches with slightly
-    # different distinct counts
-    tlen = max(16, 1 << (int(uniq.size - 1).bit_length()))
-    if tlen > uniq.size:
-        uniq = np.concatenate([uniq, np.full(tlen - uniq.size, uniq[-1], uniq.dtype)])
-    return codes, uniq
-
-
-# ---------------------------------------------------------------------------
-# host -> device
-# ---------------------------------------------------------------------------
-
-# layout entry: (offset, n_elems, wire_dtype_str, target_dtype_str,
-#                aux_offset_or_None, trailing_dims, aux_len)
-# aux is a bias scalar (ints), a gather table (floats), or the live-row
-# count (the "__valid__" pseudo-leaf).
-_UNPACK_PROGRAMS: Dict[Tuple, object] = {}
-
-
-def _build_unpack(layout: Tuple, total: int):
-    @jax.jit
-    def unpack(buf):
-        outs = []
-        for (off, n, wire, target, aux_off, trailing, aux_len) in layout:
-            if wire == "__valid__":
-                # validity mask materialized on device from the live-row
-                # count embedded in the buffer: 4 bytes on the wire instead
-                # of one byte per row
-                braw = lax.slice(buf, (aux_off,), (aux_off + 4,))
-                cnt = lax.bitcast_convert_type(braw.reshape(1, 4), jnp.int32)[0]
-                outs.append(jnp.arange(n, dtype=jnp.int32) < cnt)
-                continue
-            wdt = jnp.dtype(wire)
-            tdt = jnp.dtype(target) if target != "bool" else jnp.dtype(jnp.bool_)
-            isz = wdt.itemsize
-            raw = lax.slice(buf, (off,), (off + n * isz,))
-            if isz == 1:
-                arr = lax.bitcast_convert_type(raw, wdt)
-            else:
-                arr = lax.bitcast_convert_type(raw.reshape(n, isz), wdt)
-            if target == "bool":
-                arr = arr != 0
-            elif aux_off is not None and jnp.issubdtype(tdt, jnp.floating):
-                # low-cardinality float: codes -> gather from the value table
-                tsz = tdt.itemsize
-                traw = lax.slice(buf, (aux_off,), (aux_off + aux_len * tsz,))
-                table = lax.bitcast_convert_type(traw.reshape(aux_len, tsz), tdt)
-                arr = table[arr.astype(jnp.int32)]
-            elif wire != target:
-                arr = arr.astype(tdt)
-                if aux_off is not None:
-                    bsz = tdt.itemsize
-                    braw = lax.slice(buf, (aux_off,), (aux_off + bsz,))
-                    bias = lax.bitcast_convert_type(braw.reshape(1, bsz), tdt)[0]
-                    arr = arr + bias
-            if trailing:
-                arr = arr.reshape((n // int(np.prod(trailing)),) + trailing)
-            outs.append(arr)
-        return tuple(outs)
-
-    return unpack
+        return "uint16"
+    return "full"
 
 
 class ValidCount:
@@ -145,124 +67,195 @@ class ValidCount:
         self.nrows = nrows
 
 
+class _IntPlan:
+    __slots__ = ("wire",)
+
+    def __init__(self, wire: str):
+        self.wire = wire  # "uint8" | "uint16" | "full"
+
+
+class _FloatPlan:
+    __slots__ = ("mode", "tlen")
+
+    def __init__(self, mode: str, tlen: int = 0):
+        self.mode = mode  # "dict" | "full"
+        self.tlen = tlen  # power-of-two table length when mode == "dict"
+
+
+# batch signature -> per-leaf sticky plans
+_PLANS: Dict[Tuple, List] = {}
+# decode layout -> jitted program
+_DECODE_PROGRAMS: Dict[Tuple, object] = {}
+
+
+def _float_dict_encode(flat: np.ndarray, plan: Optional[_FloatPlan]):
+    """Dictionary-encode a float column per the (possibly new) sticky plan.
+    Returns (codes, table, plan) or (None, None, full_plan)."""
+    if plan is not None and plan.mode == "full":
+        return None, None, plan
+    if plan is None:
+        # cheap host sample decides whether to pay for a full encode at all
+        stride = max(1, flat.size // 4096)
+        sample = flat[::stride][:4096]
+        if np.unique(sample).size > _FLOAT_DICT_SAMPLE_DISTINCT:
+            return None, None, _FloatPlan("full")
+    import pyarrow as pa
+    import pyarrow.compute as pc
+
+    enc = pc.dictionary_encode(pa.array(flat))
+    uniq = enc.dictionary.to_numpy(zero_copy_only=False).astype(flat.dtype)
+    if uniq.size > _FLOAT_DICT_MAX or uniq.size == 0:
+        return None, None, _FloatPlan("full")
+    tlen = max(16, 1 << (int(uniq.size - 1).bit_length()))
+    if plan is None:
+        plan = _FloatPlan("dict", tlen)
+    elif tlen > plan.tlen:
+        plan = _FloatPlan("dict", tlen)  # grow monotonically (recompile once)
+    wdt = np.uint8 if plan.tlen <= 256 else np.uint16
+    codes = enc.indices.to_numpy(zero_copy_only=False).astype(wdt)
+    if plan.tlen > uniq.size:
+        uniq = np.concatenate(
+            [uniq, np.full(plan.tlen - uniq.size, uniq[-1], uniq.dtype)]
+        )
+    if codes.nbytes + uniq.nbytes >= flat.nbytes:
+        # a stream that STARTED low-cardinality can drift high-cardinality;
+        # once codes+table stop saving wire bytes, stop paying the encode on
+        # every future batch too (sticky degrade, one recompile)
+        return None, None, _FloatPlan("full")
+    return codes, uniq, plan
+
+
+def _build_decode(layout: Tuple):
+    """One jitted program decoding the whole wire list back to logical arrays.
+    Elementwise widen/bias, small table gathers, and arange<count masks only —
+    nothing layout-hostile; compile cost is ~1 s and the key (``layout``) is
+    stable across batches thanks to sticky plans."""
+
+    @jax.jit
+    def decode(wires):
+        outs = []
+        i = 0
+        for spec in layout:
+            kind = spec[0]
+            if kind == "valid":
+                _, padded = spec
+                cnt = wires[i][0]
+                outs.append(jnp.arange(padded, dtype=jnp.int32) < cnt)
+                i += 1
+            elif kind == "bool":
+                _, shape = spec
+                outs.append((wires[i] != 0).reshape(shape))
+                i += 1
+            elif kind == "widen":
+                _, target, shape = spec
+                arr = wires[i].astype(jnp.dtype(target)) + wires[i + 1][0]
+                outs.append(arr.reshape(shape))
+                i += 2
+            elif kind == "dict":
+                _, shape = spec
+                codes, table = wires[i], wires[i + 1]
+                outs.append(table[codes.astype(jnp.int32)].reshape(shape))
+                i += 2
+            else:  # pass
+                outs.append(wires[i])
+                i += 1
+        return tuple(outs)
+
+    return decode
+
+
 def pack_put(leaves: Sequence) -> List[jax.Array]:
-    """Transfer numpy arrays to device as one buffer; returns device arrays
-    with the original dtypes/shapes (bools stay bool, narrowed ints/floats
-    widened back).  ``ValidCount`` leaves come back as device bool masks."""
+    """Transfer numpy arrays to device with one ``device_put``; returns device
+    arrays with the original dtypes/shapes (bools stay bool, narrowed
+    ints/floats widened back).  ``ValidCount`` leaves come back as device bool
+    masks."""
     if not leaves:
         return []
-    offset = 0
-    layout = []
-    auxes = []  # (layout_index, aux_numpy_array)
-    views = []
+    items = []
+    sig = []
     for arr in leaves:
         if isinstance(arr, ValidCount):
-            layout.append([0, arr.padded, "__valid__", "bool", None, (), 0])
-            auxes.append((len(layout) - 1, np.array([arr.nrows], dtype=np.int32)))
+            sig.append(("__valid__", arr.padded))
+            items.append(arr)
+        else:
+            arr = np.ascontiguousarray(arr)
+            sig.append((str(arr.dtype), arr.shape))
+            items.append(arr)
+    sig = tuple(sig)
+    plans = _PLANS.setdefault(sig, [None] * len(items))
+
+    wires: List[np.ndarray] = []
+    layout: List[Tuple] = []
+    for idx, arr in enumerate(items):
+        if isinstance(arr, ValidCount):
+            wires.append(np.array([arr.nrows], dtype=np.int32))
+            layout.append(("valid", arr.padded))
             continue
-        arr = np.ascontiguousarray(arr)
-        trailing = tuple(arr.shape[1:])
+        shape = arr.shape
         flat = arr.reshape(-1)
         n = flat.size
-        target = "bool" if arr.dtype == np.bool_ else str(arr.dtype)
-        aux = None
         if arr.dtype == np.bool_:
-            wire_arr = flat.view(np.uint8)
-            wire = "uint8"
-        elif arr.dtype in (np.int32, np.int64) and n >= _NARROW_MIN_ELEMS:
-            wdt, bias = _int_narrow_plan(flat)
-            if bias is not None:
-                wire_arr = (flat - bias).astype(wdt)
-                aux = np.array([bias], dtype=arr.dtype)
-            else:
-                wire_arr = flat
-            wire = str(wdt)
-        elif arr.dtype in (np.float32, np.float64) and n >= _NARROW_MIN_ELEMS:
-            plan = _float_dict_plan(flat)
-            if plan is not None:
-                wire_arr, aux = plan
-                wire = str(wire_arr.dtype)
-            else:
-                wire_arr = flat
-                wire = target
-        else:
-            wire_arr = flat
-            wire = target
-        off = offset
-        offset = _align(off + wire_arr.nbytes)
-        views.append((off, wire_arr))
-        layout.append([off, n, wire, target, None, trailing,
-                       0 if aux is None else len(aux)])
-        if aux is not None:
-            auxes.append((len(layout) - 1, aux))
-    for idx, aval in auxes:
-        off = offset
-        offset = _align(off + aval.nbytes)
-        views.append((off, aval.view(np.uint8)))
-        layout[idx][4] = off
-    total = offset if offset else _ALIGN
-    buf = np.zeros(total, dtype=np.uint8)
-    for off, v in views:
-        buf[off : off + v.nbytes] = v.view(np.uint8)
-    key = (tuple(tuple(e) for e in layout), total)
-    prog = _UNPACK_PROGRAMS.get(key)
+            wires.append(flat.view(np.uint8))
+            layout.append(("bool", shape))
+            continue
+        if arr.dtype in (np.int32, np.int64) and n >= _NARROW_MIN_ELEMS:
+            plan: Optional[_IntPlan] = plans[idx]
+            mn = int(flat.min())
+            mx = int(flat.max())
+            needed = _int_wire_needed(mn, mx)
+            if plan is None:
+                plan = _IntPlan(needed)
+            elif needed == "full" or (
+                plan.wire != "full" and _WIDTH[needed] > _WIDTH[plan.wire]
+            ):
+                plan = _IntPlan(needed)  # widen monotonically
+            plans[idx] = plan
+            if plan.wire != "full":
+                wdt = np.dtype(plan.wire)
+                wires.append((flat - mn).astype(wdt))
+                wires.append(np.array([mn], dtype=arr.dtype))
+                layout.append(("widen", str(arr.dtype), shape))
+                continue
+            wires.append(arr)
+            layout.append(("pass", str(arr.dtype), shape))
+            continue
+        if arr.dtype in (np.float32, np.float64) and n >= _NARROW_MIN_ELEMS:
+            codes, table, plan = _float_dict_encode(flat, plans[idx])
+            plans[idx] = plan
+            if codes is not None:
+                wires.append(codes)
+                wires.append(table)
+                layout.append(("dict", shape))
+                continue
+            wires.append(arr)
+            layout.append(("pass", str(arr.dtype), shape))
+            continue
+        wires.append(arr)
+        layout.append(("pass", str(arr.dtype), shape))
+
+    # keyed by layout alone: the program is a function of the layout, and
+    # jax.jit re-traces per input dtype/shape signature under one wrapper
+    key = tuple(layout)
+    prog = _DECODE_PROGRAMS.get(key)
     if prog is None:
-        prog = _build_unpack(key[0], total)
-        _UNPACK_PROGRAMS[key] = prog
-    dbuf = jax.device_put(buf)
-    return list(prog(dbuf))
+        prog = _build_decode(key)
+        _DECODE_PROGRAMS[key] = prog
+    dwires = jax.device_put(wires)
+    return list(prog(dwires))
 
 
-# ---------------------------------------------------------------------------
-# device -> host
-# ---------------------------------------------------------------------------
-
-_PACK_PROGRAMS: Dict[Tuple, object] = {}
-
-
-def _build_pack(sig: Tuple):
-    @jax.jit
-    def pack(arrays):
-        parts = []
-        for a in arrays:
-            if a.dtype == jnp.bool_:
-                a = a.astype(jnp.uint8)
-            flat = a.reshape(-1)
-            if flat.dtype.itemsize == 1:
-                raw = lax.bitcast_convert_type(flat, jnp.uint8)
-            else:
-                raw = lax.bitcast_convert_type(flat, jnp.uint8).reshape(-1)
-            parts.append(raw)
-        return jnp.concatenate(parts) if parts else jnp.zeros(0, jnp.uint8)
-
-    return pack
-
-
-def get_packed(arrays: Sequence[jax.Array]) -> List[np.ndarray]:
-    """Read device arrays back to host as one transfer; returns numpy arrays
-    with the original dtypes/shapes."""
+def get_packed(arrays: Sequence) -> List[np.ndarray]:
+    """Read device arrays back to host in one ``device_get`` (transfers are
+    started async first so the runtime can pipeline them); returns numpy
+    arrays with the original dtypes/shapes.  No device program is involved —
+    the d2h direction must never pay a compile."""
     if not arrays:
         return []
-    # pure-numpy arrays (already host) pass through
     if all(isinstance(a, np.ndarray) for a in arrays):
         return [np.asarray(a) for a in arrays]
-    sig = tuple((str(a.dtype), tuple(a.shape)) for a in arrays)
-    prog = _PACK_PROGRAMS.get(sig)
-    if prog is None:
-        prog = _build_pack(sig)
-        _PACK_PROGRAMS[sig] = prog
-    buf = np.asarray(prog(tuple(jnp.asarray(a) for a in arrays)))
-    outs = []
-    off = 0
-    for dt, shape in sig:
-        npdt = np.dtype(np.bool_) if dt == "bool" else np.dtype(dt)
-        n = int(np.prod(shape)) if shape else 1
-        nbytes = n * (1 if dt == "bool" else npdt.itemsize)
-        raw = buf[off : off + nbytes]
-        if dt == "bool":
-            arr = raw.view(np.uint8).astype(np.bool_)
-        else:
-            arr = np.frombuffer(raw.tobytes(), dtype=npdt, count=n)
-        outs.append(arr.reshape(shape))
-        off += nbytes
-    return outs
+    for a in arrays:
+        try:
+            a.copy_to_host_async()
+        except AttributeError:
+            pass
+    return [np.asarray(a) for a in jax.device_get(list(arrays))]

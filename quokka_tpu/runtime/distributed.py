@@ -47,6 +47,7 @@ def _build_spec(graph) -> Dict:
             "projection": info.projection,
             "blocking": info.blocking_dataset is not None,
             "channel_major": getattr(info, "channel_major", False),
+            "placement": getattr(info, "placement", None),
         }
     from quokka_tpu import config as qconfig
 
@@ -61,17 +62,12 @@ def _build_spec(graph) -> Dict:
     }
 
 
-def _assign_channels(graph, n_workers: int) -> Dict[int, Dict[int, List[int]]]:
-    """Round-robin (actor, channel) -> worker.  Returns worker -> owned map."""
-    owned: Dict[int, Dict[int, List[int]]] = {w: {} for w in range(n_workers)}
-    i = 0
-    for aid in sorted(graph.actors):
-        info = graph.actors[aid]
-        for ch in range(info.channels):
-            w = i % n_workers
-            owned[w].setdefault(aid, []).append(ch)
-            i += 1
-    return owned
+def _assign_channels(graph, n_workers: int, worker_tags=None):
+    """(actor, channel) -> worker, honoring per-actor placement strategies
+    (runtime/placement.py); unplaced actors round-robin."""
+    from quokka_tpu.runtime.placement import assign_channels
+
+    return assign_channels(graph.actors, n_workers, worker_tags)
 
 
 def run_distributed(
@@ -82,6 +78,7 @@ def run_distributed(
     heartbeat_timeout: Optional[float] = None,
     external_workers: int = 0,
     bind: str = "127.0.0.1",
+    worker_tags=None,
 ) -> None:
     """Execute the graph over worker processes; fills blocking datasets.
     kill_after_inputs=(worker_id, n): SIGKILL that worker once n input seqs
@@ -107,7 +104,7 @@ def run_distributed(
     procs: Dict[int, mp.Process] = {}
     try:
         total_workers = n_workers + external_workers
-        owned = _assign_channels(graph, total_workers)
+        owned = _assign_channels(graph, total_workers, worker_tags)
         with cs.transaction():
             for w, per_actor in owned.items():
                 for aid, chs in per_actor.items():

@@ -36,7 +36,12 @@ from quokka_tpu.ops.expr_compile import evaluate_predicate
 from quokka_tpu.runtime.cache import BatchCache
 from quokka_tpu.runtime.dataset import ResultDataset
 from quokka_tpu.runtime.tables import ControlStore
-from quokka_tpu.runtime.task import ExecutorTask, TapedInputTask
+from quokka_tpu.runtime.task import (
+    ExecutorTask,
+    ReplayTask,
+    TapedExecutorTask,
+    TapedInputTask,
+)
 from quokka_tpu.utils import tracing
 from quokka_tpu.target_info import (
     BroadcastPartitioner,
@@ -65,6 +70,9 @@ class ActorInfo:
         self.sorted_by: Optional[List[str]] = None
         self.predicate = None  # pushed-down source filter (device mask post-read)
         self.projection: Optional[List[str]] = None
+        # runtime/placement.py strategy pinning channels to workers (None ->
+        # round-robin spread, the reference default)
+        self.placement = None
 
 
 class TaskGraph:
@@ -679,9 +687,11 @@ class Engine:
             self._recover_channel(a, ch)
 
     def _recover_channel(self, a: int, ch: int) -> None:
-        """Rebuild one lost channel: recreate its executor/input task, restore
-        the latest checkpoint, replay the lineage tape, and refill the cache
-        from the HBQ spill.  Shared by the embedded failure simulation and the
+        """Rebuild one lost channel by QUEUEING recovery tasks into NTT (the
+        reference pushes TapedInputTask/TapedExecutorTask/ReplayTask from the
+        coordinator, pyquokka/coordinator.py:424-552): whichever worker owns
+        the channel after reassignment pops and executes them through its
+        normal task loop.  Shared by the embedded failure simulation and the
         distributed worker's channel adoption (runtime/worker.py)."""
         info = self.g.actors[a]
         self.store.tdel("DST", (a, ch))
@@ -696,29 +706,88 @@ class Engine:
             else:
                 self.store.sadd("DST", (a, ch), "done")
             return
-        self.execs[(a, ch)] = info.executor_factory()
         lct = self.store.tget("LCT", (a, ch))
         if lct is not None:
             state_seq, out_seq, tape_pos = lct
-            with open(self._ckpt_file(a, ch, state_seq), "rb") as f:
-                self.execs[(a, ch)].restore(pickle.load(f))
-            reqs = {
-                s: dict(c)
-                for s, c in self.store.tget("IRT", (a, ch, state_seq)).items()
-            }
         else:
             state_seq, out_seq, tape_pos = 0, 0, 0
-            reqs = {
-                s: dict(c) for s, c in self.store.tget("IRT", (a, ch, 0)).items()
-            }
-        tape = self.store.tape_slice(a, ch, tape_pos)
+        reqs = {
+            s: dict(c)
+            for s, c in self.store.tget("IRT", (a, ch, state_seq)).items()
+        }
+        n_exec_events = sum(
+            1 for ev in self.store.tape_slice(a, ch, tape_pos) if ev[0] == "exec"
+        )
+        self.store.ntt_push(
+            a,
+            TapedExecutorTask(
+                a, ch, state_seq, out_seq, state_seq + n_exec_events, reqs,
+                tape_pos,
+            ),
+        )
+
+    def handle_exectape_task(self, task: TapedExecutorTask) -> bool:
+        """Run a queued tape replay: recreate the executor, restore the
+        checkpoint named by task.state_seq, re-run the recorded event history,
+        then requeue the channel as a live ExecutorTask plus a ReplayTask that
+        refills its input cache from the HBQ spill."""
+        a, ch = task.actor, task.channel
+        self.execs[(a, ch)] = self.g.actors[a].executor_factory()
+        path = self._ckpt_file(a, ch, task.state_seq)
+        if os.path.exists(path):
+            with open(path, "rb") as f:
+                self.execs[(a, ch)].restore(pickle.load(f))
+        elif task.state_seq > 0:
+            raise FileNotFoundError(
+                f"checkpoint {path} named by LCT is missing — cannot rebuild "
+                f"channel ({a},{ch}) at state {task.state_seq}"
+            )
+        reqs = {s: dict(c) for s, c in task.input_reqs.items()}
+        tape = self.store.tape_slice(a, ch, task.tape_pos)
         state_seq, out_seq = self._replay_tape(
-            a, ch, tape, reqs, state_seq, out_seq
+            a, ch, tape, reqs, task.state_seq, task.out_seq
+        )
+        # replay-complete check: the tape must advance the state exactly to
+        # where the coordinator said the channel was when it queued this task
+        assert state_seq == task.last_state_seq, (
+            f"tape replay of ({a},{ch}) reached state {state_seq}, "
+            f"expected {task.last_state_seq} — lineage tape diverged"
         )
         with self.store.transaction():
             self.store.tset("EST", (a, ch), state_seq)
+        if self.g.hbq is not None:
+            hbq_names = self.g.hbq.names_for_target(a, ch)
+            specs = [
+                name
+                for name in hbq_names
+                if name[0] in reqs
+                and name[1] in reqs[name[0]]
+                and name[2] >= reqs[name[0]][name[1]]
+            ]
+            if specs:
+                self.store.ntt_push(a, ReplayTask(a, ch, sorted(specs)))
         self.store.ntt_push(a, ExecutorTask(a, ch, state_seq, out_seq, reqs))
-        self._replay_from_hbq(a, ch, reqs)
+        return True
+
+    def dispatch_task(self, task) -> bool:
+        """Route a popped NTT task to its handler by task kind."""
+        if task.name == "input":
+            return self.handle_input_task(task)
+        if task.name == "exec":
+            return self.handle_exec_task(task)
+        if task.name == "exectape":
+            return self.handle_exectape_task(task)
+        return self.handle_replay_task(task)
+
+    def handle_replay_task(self, task: ReplayTask) -> bool:
+        """Re-push spilled post-partition objects to the (rebuilt) consumer's
+        cache — the reference's ReplayTask (pyquokka/core.py:967-1025), except
+        the objects come off the shared spill dir rather than a peer's HBQ."""
+        for name in task.replay_specs:
+            table = self.g.hbq.get(name)
+            if table is not None:
+                self._cache_put(name, bridge.arrow_to_device(table))
+        return True
 
     def _replay_tape(self, actor: int, ch: int, events, reqs,
                      state_seq: int, out_seq: int):
@@ -759,18 +828,6 @@ class Engine:
                     self._emit(info, ch, out_seq, extra)
                     out_seq += 1
         return state_seq, out_seq
-
-    def _replay_from_hbq(self, actor: int, ch: int, reqs) -> None:
-        for src, chans in reqs.items():
-            for sch, need in chans.items():
-                seq = need
-                while True:
-                    name = (src, sch, seq, actor, src, ch)
-                    table = self.g.hbq.get(name)
-                    if table is None:
-                        break
-                    self.cache.put(name, bridge.arrow_to_device(table))
-                    seq += 1
 
     def _emit(self, info: ActorInfo, channel: int, seq: int, out: DeviceBatch) -> None:
         if getattr(info, "blocking", False) or info.blocking_dataset is not None:
@@ -855,10 +912,7 @@ class Engine:
                 task = self.store.ntt_pop(info.id)
                 if task is None:
                     continue
-                if task.name == "input":
-                    ok = self.handle_input_task(task)
-                else:
-                    ok = self.handle_exec_task(task)
+                ok = self.dispatch_task(task)
                 progress |= ok
                 if ok:
                     handled += 1

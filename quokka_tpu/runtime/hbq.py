@@ -42,6 +42,21 @@ class HBQ:
     def contains(self, name: Tuple) -> bool:
         return os.path.exists(os.path.join(self.path, _fname(name)))
 
+    def names_for_target(self, tgt_actor: int, tgt_ch: int):
+        """Spilled object names destined to one consumer channel — the
+        enumeration a ReplayTask re-pushes after that consumer is rebuilt."""
+        out = []
+        for f in os.listdir(self.path):
+            if not (f.startswith("hbq-") and f.endswith(".arrow")):
+                continue
+            parts = f[4:-6].split("-")
+            if len(parts) != 6:
+                continue
+            sa, sch, seq, ta, pfn, tch = (int(x) for x in parts)
+            if ta == tgt_actor and tch == tgt_ch:
+                out.append((sa, sch, seq, ta, pfn, tch))
+        return sorted(out)
+
     def gc(self, names: Sequence[Tuple]) -> None:
         for name in names:
             p = os.path.join(self.path, _fname(name))

@@ -1,0 +1,167 @@
+"""Channel placement strategies.
+
+Reference parity: pyquokka/placement_strategy.py:8-36 — the reference decides
+how many channels an actor gets and which cluster nodes host them
+(SingleChannelStrategy / CustomChannelsStrategy / TaggedCustomChannelsStrategy
+/ DatasetStrategy, consumed at quokka_runtime.py:314-368).  Here the same
+objects resolve an actor's channel count at plan lowering and pin channels to
+worker processes in the distributed runtime's channel-location table
+(runtime/distributed._assign_channels); the embedded engine ignores pinning
+(one process) but honors the channel counts.
+
+Workers may carry string tags (run_distributed(worker_tags=...), e.g.
+{"tpu"} for chip-bearing hosts vs {"io"} for ingest hosts) and
+TaggedCustomChannelsStrategy restricts an actor to tagged workers — the
+TPU-pod shape where only some hosts should run device-heavy exec channels.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Set
+
+
+class PlacementStrategy:
+    """Base: subclasses define the channel count for a cluster size and the
+    channel -> worker pinning."""
+
+    def num_channels(
+        self, n_workers: int, default_channels: int, worker_tags=None
+    ) -> int:
+        raise NotImplementedError
+
+    def assign(
+        self,
+        n_channels: int,
+        n_workers: int,
+        worker_tags: Optional[Dict[int, Set[str]]] = None,
+    ) -> Dict[int, int]:
+        """channel -> worker id map."""
+        raise NotImplementedError
+
+    def __repr__(self):
+        return type(self).__name__
+
+
+class SingleChannelStrategy(PlacementStrategy):
+    """One channel on one worker — final aggregations, global top-k, any
+    operator whose state must not shard (reference placement_strategy.py:8)."""
+
+    def num_channels(self, n_workers, default_channels, worker_tags=None):
+        return 1
+
+    def assign(self, n_channels, n_workers, worker_tags=None):
+        return {0: 0}
+
+
+class CustomChannelsStrategy(PlacementStrategy):
+    """channels_per_worker channels on EVERY worker (reference
+    placement_strategy.py:15)."""
+
+    def __init__(self, channels_per_worker: int = 1):
+        if channels_per_worker < 1:
+            raise ValueError("channels_per_worker must be >= 1")
+        self.channels_per_worker = channels_per_worker
+
+    def num_channels(self, n_workers, default_channels, worker_tags=None):
+        return self.channels_per_worker * max(1, n_workers)
+
+    def assign(self, n_channels, n_workers, worker_tags=None):
+        return {ch: (ch // self.channels_per_worker) % n_workers
+                for ch in range(n_channels)}
+
+    def __repr__(self):
+        return f"CustomChannelsStrategy({self.channels_per_worker})"
+
+
+class TaggedCustomChannelsStrategy(CustomChannelsStrategy):
+    """channels_per_worker channels on every worker carrying ``tag``
+    (reference placement_strategy.py:32): pin device-heavy actors to
+    chip-bearing hosts, ingest actors to IO hosts."""
+
+    def __init__(self, channels_per_worker: int = 1, tag: str = "default"):
+        super().__init__(channels_per_worker)
+        self.tag = tag
+
+    def _tagged(self, n_workers: int, worker_tags) -> List[int]:
+        """Workers carrying the tag.  worker_tags=None (no tag declarations
+        anywhere, e.g. the embedded engine) treats every worker as tagged —
+        consistently in BOTH num_channels and assign, so a plan that lowers
+        also places.  A declared tag map with no match is a configuration
+        error and raises at both plan and assign time."""
+        if worker_tags is None:
+            return list(range(n_workers))
+        tagged = [
+            w for w in range(n_workers) if self.tag in worker_tags.get(w, ())
+        ]
+        if not tagged:
+            raise ValueError(
+                f"no worker carries tag {self.tag!r} "
+                f"(tags={worker_tags}); cannot place"
+            )
+        return tagged
+
+    def num_channels(self, n_workers, default_channels, worker_tags=None):
+        return self.channels_per_worker * len(
+            self._tagged(max(1, n_workers), worker_tags)
+        )
+
+    def assign(self, n_channels, n_workers, worker_tags=None):
+        tagged = self._tagged(n_workers, worker_tags)
+        return {
+            ch: tagged[(ch // self.channels_per_worker) % len(tagged)]
+            for ch in range(n_channels)
+        }
+
+    def __repr__(self):
+        return (
+            f"TaggedCustomChannelsStrategy({self.channels_per_worker}, "
+            f"tag={self.tag!r})"
+        )
+
+
+class DatasetStrategy(PlacementStrategy):
+    """One channel per worker — blocking-output collection actors (reference
+    placement_strategy.py:24): results materialize on every host, the client
+    drains them all."""
+
+    def num_channels(self, n_workers, default_channels, worker_tags=None):
+        return max(1, n_workers)
+
+    def assign(self, n_channels, n_workers, worker_tags=None):
+        return {ch: ch % n_workers for ch in range(n_channels)}
+
+
+def assign_channels(
+    actors: Dict[int, object],
+    n_workers: int,
+    worker_tags: Optional[Dict[int, Set[str]]] = None,
+) -> Dict[int, Dict[int, List[int]]]:
+    """worker -> {actor: [channels]} honoring per-actor placement strategies;
+    actors without one round-robin across all workers (the reference's default
+    channel spread, quokka_runtime.py:314-368)."""
+    owned: Dict[int, Dict[int, List[int]]] = {w: {} for w in range(n_workers)}
+    i = 0
+    for aid in sorted(actors):
+        info = actors[aid]
+        strategy = getattr(info, "placement", None)
+        if strategy is not None:
+            expected = strategy.num_channels(n_workers, info.channels, worker_tags)
+            if info.channels != expected:
+                # channel counts were fixed at plan lowering against the
+                # cluster the context knew about; running against a different
+                # worker count (e.g. external_workers added later) would
+                # silently break the per-worker placement contract
+                raise ValueError(
+                    f"actor {aid} was lowered with {info.channels} channels "
+                    f"but {strategy!r} wants {expected} for {n_workers} "
+                    "workers — build the plan with a QuokkaContext whose "
+                    "cluster matches the worker count it will run on"
+                )
+            pins = strategy.assign(info.channels, n_workers, worker_tags)
+            for ch in range(info.channels):
+                owned[pins[ch]].setdefault(aid, []).append(ch)
+            continue
+        for ch in range(info.channels):
+            owned[i % n_workers].setdefault(aid, []).append(ch)
+            i += 1
+    return owned

@@ -1,0 +1,93 @@
+"""PersistentStateVariable — a spill-backed append-only batch list.
+
+Reference parity: pyquokka/state.py:6 — operators that accumulate unbounded
+batch state (join builds, custom stateful executors) append to this list; past
+a memory cap the tail spills to disk as Arrow IPC files and is streamed back
+on iteration.  The device analog of "memory" here is HOST memory: device
+batches must be synced down before they count as persistent state (executors
+with device-resident state use the spill tier in executors/sql_execs.py
+instead — this class serves host-side custom executors, the role the
+reference's PersistentStateVariable plays for its "old operators").
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Iterator, List, Optional
+
+import pyarrow as pa
+import pyarrow.ipc as ipc
+
+from quokka_tpu import config
+
+
+class PersistentStateVariable:
+    def __init__(self, mem_limit_bytes: int = 1 << 28,
+                 spill_dir: Optional[str] = None):
+        self.mem_limit = mem_limit_bytes
+        self._mem: List[pa.Table] = []
+        self._mem_bytes = 0
+        self._spill_files: List[str] = []
+        self._spilled_rows = 0
+        self._dir = spill_dir or config.SPILL_DIR
+        self._tmp: Optional[str] = None
+
+    def __len__(self) -> int:
+        return len(self._mem) + len(self._spill_files)
+
+    def num_rows(self) -> int:
+        return self._spilled_rows + sum(t.num_rows for t in self._mem)
+
+    def append(self, table: pa.Table) -> None:
+        nbytes = table.nbytes
+        if self._mem_bytes + nbytes > self.mem_limit and self._mem:
+            self._spill_all()
+        if nbytes > self.mem_limit:
+            self._spill_table(table)
+            return
+        self._mem.append(table)
+        self._mem_bytes += nbytes
+
+    def _ensure_dir(self) -> str:
+        if self._tmp is None:
+            os.makedirs(self._dir, exist_ok=True)
+            self._tmp = tempfile.mkdtemp(prefix="psv-", dir=self._dir)
+        return self._tmp
+
+    def _spill_table(self, table: pa.Table) -> None:
+        d = self._ensure_dir()
+        p = os.path.join(d, f"part-{len(self._spill_files):06d}.arrow")
+        with ipc.new_file(p, table.schema) as w:
+            w.write_table(table)
+        self._spill_files.append(p)
+        self._spilled_rows += table.num_rows
+
+    def _spill_all(self) -> None:
+        for t in self._mem:
+            self._spill_table(t)
+        self._mem = []
+        self._mem_bytes = 0
+
+    def __iter__(self) -> Iterator[pa.Table]:
+        for p in self._spill_files:
+            with ipc.open_file(p) as r:
+                yield r.read_all()
+        yield from self._mem
+
+    def to_table(self) -> Optional[pa.Table]:
+        tables = list(self)
+        if not tables:
+            return None
+        return pa.concat_tables(tables, promote_options="default")
+
+    def clear(self) -> None:
+        import shutil
+
+        self._mem = []
+        self._mem_bytes = 0
+        self._spill_files = []
+        self._spilled_rows = 0
+        if self._tmp is not None:
+            shutil.rmtree(self._tmp, ignore_errors=True)
+            self._tmp = None

@@ -59,15 +59,20 @@ class ExecutorTask:
 
 @dataclasses.dataclass
 class TapedExecutorTask:
-    """Replay variant: re-run an executor channel following a recorded input
-    tape up to last_state_seq, then convert back to a live ExecutorTask."""
+    """Replay variant: re-run an executor channel following its recorded
+    lineage tape (LT events from tape_pos on) up to last_state_seq, then
+    convert back to a live ExecutorTask.  Queued into NTT by recovery
+    (engine._recover_channel) and executed by whichever worker owns the
+    channel after reassignment — the reference's exectape path
+    (pyquokka/core.py:702-821)."""
 
     actor: int
     channel: int
-    state_seq: int
+    state_seq: int  # restored checkpoint state
     out_seq: int
-    last_state_seq: int
+    last_state_seq: int  # state after the full tape replays
     input_reqs: Dict[int, Dict[int, int]]
+    tape_pos: int = 0  # LT offset the replay starts from (checkpoint trim point)
     name = "exectape"
 
 

@@ -50,6 +50,7 @@ def _actors_from_spec(spec: Dict) -> Dict[int, ActorInfo]:
         info.projection = d["projection"]
         info.blocking = d["blocking"]
         info.channel_major = d.get("channel_major", False)
+        info.placement = d.get("placement")
         info.blocking_dataset = None
         actors[aid] = info
     return actors
@@ -187,10 +188,7 @@ class Worker(Engine):
                 task = self.store.ntt_pop(info.id, list(chans))
                 if task is None:
                     continue
-                if task.name == "input":
-                    progress |= self.handle_input_task(task)
-                else:
-                    progress |= self.handle_exec_task(task)
+                progress |= self.dispatch_task(task)
             if not progress:
                 time.sleep(0.01)
 

@@ -23,10 +23,13 @@ class LocalCluster:
     one machine (pyquokka/utils.py:96 LocalCluster + core.py TaskManagers)."""
 
     def __init__(self, io_per_node: int = 2, exec_per_node: int = 2,
-                 n_workers: int = 0):
+                 n_workers: int = 0, worker_tags=None):
         self.io_per_node = io_per_node
         self.exec_per_node = exec_per_node
         self.n_workers = n_workers
+        # worker id -> set of string tags, consumed by
+        # TaggedCustomChannelsStrategy (runtime/placement.py)
+        self.worker_tags = worker_tags
         self.leader_ip = "127.0.0.1"
 
     @property

@@ -84,6 +84,50 @@ class TestLimbEncoding:
         np.testing.assert_array_equal(got[: len(vals)], exp)
 
 
+class TestMixedLimbConcat:
+    """A stream legitimately mixes plain-int32 and two-limb batches for the
+    same int64 column (_ints_to_col decides per batch from the value range);
+    concat must promote, not drop limbs (r2 code review)."""
+
+    def _batches(self):
+        small = pa.table({"x": np.array([5, -3, 7, 0], dtype=np.int64)})
+        wide = pa.table({"x": straddling_values(n=256)})
+        bs = bridge.arrow_to_device(small)
+        bw = bridge.arrow_to_device(wide)
+        assert bs.columns["x"].hi is None and bw.columns["x"].hi is not None
+        exp = np.concatenate(
+            [small.column("x").to_numpy(), wide.column("x").to_numpy()]
+        )
+        return bs, bw, exp
+
+    def test_compacting_concat_promotes(self, no_x64):
+        bs, bw, exp = self._batches()
+        out = bridge.concat_batches([bs, bw])
+        got = bridge.device_to_arrow(out).column("x").to_numpy()
+        np.testing.assert_array_equal(got, exp)
+
+    def test_device_concat_promotes(self, no_x64):
+        bs, bw, exp = self._batches()
+        # unknown nrows routes through the sync-free device concat
+        bs.nrows = None
+        bs.nrows_dev = None
+        bw.nrows = None
+        bw.nrows_dev = None
+        out = bridge._concat_batches_device([bs, bw])
+        got = bridge.device_to_arrow(out).column("x").to_numpy()
+        np.testing.assert_array_equal(got, exp)
+
+    def test_null_sentinel_survives_promotion(self, no_x64):
+        small = pa.table({"x": pa.array([5, None, 7], type=pa.int64())})
+        wide = pa.table({"x": straddling_values(n=256)})
+        bs = bridge.arrow_to_device(small)
+        bw = bridge.arrow_to_device(wide)
+        out = bridge.concat_batches([bs, bw])
+        got = bridge.device_to_arrow(out).column("x")
+        assert got.null_count == 1
+        assert got.to_pylist()[1] is None
+
+
 class TestWideQueries:
     def test_filter_and_sort_query(self, no_x64):
         vals = straddling_values(seed=23)
