@@ -10,10 +10,19 @@ lineitem as Parquet is ~25 GB, so the reference sustains ~25 / (9.56 * 4)
 ~= 0.654 GB/s of Parquet per worker node.  Our metric is the same quantity per
 TPU chip: lineitem Parquet bytes / Q1 wall-seconds (steady-state run, compile
 cached).
+
+Robustness: the tunneled dev TPU runtime can WEDGE mid-RPC (a blocked
+tcp_recvmsg that never returns), which would hang this process forever.  All
+device work therefore runs in a SUPERVISED CHILD process with a hard timeout:
+probe -> measure on TPU; on wedge/timeout the child is killed and the
+measurement retries once, then falls back to CPU -- loudly (platform +
+tpu_fallback_to_cpu fields; the value still parses but cannot be mistaken for
+a TPU number).
 """
 
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -21,6 +30,9 @@ BASELINE_GBPS_PER_WORKER = 0.654
 
 SF = float(os.environ.get("QUOKKA_BENCH_SF", "1.0"))
 CACHE = os.environ.get("QUOKKA_BENCH_CACHE", "/tmp/quokka_tpu_bench")
+# generous: first compile of the full kernel set over the remote-compile
+# tunnel is minutes; a healthy steady-state run is seconds
+MEASURE_TIMEOUT = int(os.environ.get("QUOKKA_BENCH_TIMEOUT", "1500"))
 
 
 def ensure_data():
@@ -74,61 +86,13 @@ def run_q1(path):
     return time.time() - t0, df
 
 
-def probe_tpu(attempts: int = 2, timeout: int = 150, backoff: int = 20) -> bool:
-    """Check the TPU backend from a SUBPROCESS so a wedged tunnel (which hangs
-    jax.devices() indefinitely) can't hang the bench itself.  Bounded retries
-    with backoff; False means the tunnel is down after all attempts."""
-    import subprocess
-
-    probe = (
-        "import jax, jax.numpy as jnp;"
-        "d = jax.devices();"
-        "(jnp.ones((64, 64)) @ jnp.ones((64, 64))).block_until_ready();"
-        "print('ok', d[0].platform)"
-    )
-    for i in range(attempts):
-        try:
-            r = subprocess.run(
-                [sys.executable, "-c", probe],
-                timeout=timeout, capture_output=True, text=True,
-            )
-            if r.returncode == 0 and "ok" in r.stdout:
-                platform = r.stdout.strip().split()[-1].lower()
-                if platform not in ("cpu",):
-                    return True
-                # JAX silently picked CPU (plugin missing): that is NOT a TPU
-                sys.stderr.write(
-                    f"bench: probe initialized platform {platform!r}, not TPU\n"
-                )
-                return False
-            sys.stderr.write(
-                f"bench: TPU probe {i + 1}/{attempts} failed rc={r.returncode}: "
-                f"{(r.stderr or r.stdout)[-200:]}\n"
-            )
-        except subprocess.TimeoutExpired:
-            sys.stderr.write(f"bench: TPU probe {i + 1}/{attempts} timed out\n")
-        if i < attempts - 1:
-            time.sleep(backoff)
-    return False
-
-
-def main():
-    path = ensure_data()
-    nbytes = os.path.getsize(path)
-    tpu_ok = probe_tpu()
+def measure(path):
+    """The full measurement (runs inside the supervised child).  Emits one
+    JSON line on fd 1 and exits 0."""
     import jax
 
-    fallback = False
-    if not tpu_ok:
-        # LOUD CPU fallback: the result still parses, but the platform field
-        # and fallback flag make it unmistakable that this is not a TPU number
-        sys.stderr.write("bench: TPU unavailable after retries; CPU fallback\n")
-        try:
-            jax.config.update("jax_platforms", "cpu")
-        except Exception:
-            pass
-        fallback = True
     platform = jax.default_backend()
+    nbytes = os.path.getsize(path)
     # warm-up run compiles the kernel set; measured runs reflect steady state
     warm, df = run_q1(path)
     from quokka_tpu.runtime import scancache
@@ -164,11 +128,103 @@ def main():
             "cold_vs_baseline": round(cold_gbps / BASELINE_GBPS_PER_WORKER, 4),
             "warmup_seconds": round(warm, 4),
             "platform": platform,
-            "tpu_fallback_to_cpu": fallback,
+            "tpu_fallback_to_cpu": platform == "cpu",
         },
     }
     print(json.dumps(result))
 
 
+def probe_tpu(attempts: int = 2, timeout: int = 150, backoff: int = 20) -> bool:
+    """Check the TPU backend from a SUBPROCESS so a wedged tunnel (which hangs
+    jax.devices() indefinitely) can't hang the bench itself.  Bounded retries
+    with backoff; False means the tunnel is down after all attempts."""
+    probe = (
+        "import jax, jax.numpy as jnp;"
+        "d = jax.devices();"
+        "(jnp.ones((64, 64)) @ jnp.ones((64, 64))).block_until_ready();"
+        "print('ok', d[0].platform)"
+    )
+    for i in range(attempts):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", probe],
+                timeout=timeout, capture_output=True, text=True,
+            )
+            if r.returncode == 0 and "ok" in r.stdout:
+                platform = r.stdout.strip().split()[-1].lower()
+                if platform not in ("cpu",):
+                    return True
+                # JAX silently picked CPU (plugin missing): that is NOT a TPU
+                sys.stderr.write(
+                    f"bench: probe initialized platform {platform!r}, not TPU\n"
+                )
+                return False
+            sys.stderr.write(
+                f"bench: TPU probe {i + 1}/{attempts} failed rc={r.returncode}: "
+                f"{(r.stderr or r.stdout)[-200:]}\n"
+            )
+        except subprocess.TimeoutExpired:
+            sys.stderr.write(f"bench: TPU probe {i + 1}/{attempts} timed out\n")
+        if i < attempts - 1:
+            time.sleep(backoff)
+    return False
+
+
+def _run_child(path: str, platform: str, timeout: int):
+    """Run measure() in a child; returns the JSON line or None on wedge."""
+    env = dict(os.environ)
+    if platform == "cpu":
+        env["QUOKKA_BENCH_FORCE_CPU"] = "1"
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--measure", path],
+            timeout=timeout, capture_output=True, text=True, env=env,
+        )
+    except subprocess.TimeoutExpired:
+        sys.stderr.write(
+            f"bench: measurement child exceeded {timeout}s (wedged tunnel?)\n"
+        )
+        return None
+    if r.returncode != 0:
+        sys.stderr.write(f"bench: measurement child rc={r.returncode}:\n"
+                         f"{r.stderr[-2000:]}\n")
+        return None
+    for line in reversed(r.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            return line
+    sys.stderr.write(f"bench: child produced no JSON: {r.stdout[-500:]}\n")
+    return None
+
+
+def main():
+    path = ensure_data()
+    attempts = []
+    if probe_tpu():
+        attempts = ["tpu", "tpu"]  # one retry on a mid-run wedge
+    else:
+        sys.stderr.write("bench: TPU unavailable after probe retries\n")
+    attempts.append("cpu")  # LOUD fallback, flagged in the JSON
+    for platform in attempts:
+        if platform == "cpu":
+            sys.stderr.write("bench: falling back to CPU — NOT a TPU number\n")
+        line = _run_child(path, platform, MEASURE_TIMEOUT)
+        if line is not None:
+            print(line)
+            return
+    sys.stderr.write("bench: all measurement attempts failed\n")
+    sys.exit(1)
+
+
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 1 and sys.argv[1] == "--measure":
+        if os.environ.get("QUOKKA_BENCH_FORCE_CPU"):
+            import jax
+
+            try:
+                jax.config.update("jax_platforms", "cpu")
+            except Exception:
+                pass
+        measure(sys.argv[2])
+    else:
+        main()

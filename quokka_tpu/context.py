@@ -59,8 +59,10 @@ class QuokkaContext:
     @property
     def cluster_workers(self) -> int:
         """Worker-process count placement strategies resolve against (1 for
-        the embedded engine)."""
+        the embedded engine); externally-launched daemons (TPUPodCluster
+        hosts) count as workers."""
         n = getattr(self.cluster, "n_workers", 0) if self.cluster else 0
+        n += getattr(self.cluster, "external_workers", 0) if self.cluster else 0
         return max(1, n)
 
     @property
@@ -91,6 +93,25 @@ class QuokkaContext:
             reader = InputObjectParquetDataset(path, columns=columns)
         else:
             reader = InputParquetDataset(path, columns=columns)
+        schema = [f for f in reader.schema.names]
+        if columns:
+            schema = list(columns)
+        return self.new_stream(logical.SourceNode(reader, schema))
+
+    def read_iceberg(self, table_dir, snapshot_id=None, columns=None) -> DataStream:
+        """Scan an Iceberg table directory (current snapshot, or any retained
+        snapshot via snapshot_id for time travel).  The metadata walk
+        (version json -> manifest-list avro -> manifest avro -> data files)
+        runs in-repo (dataset/iceberg.py, dataset/avro.py — reference
+        df.py:802 does this through pyiceberg); the resulting parquet list
+        scans through the standard reader with row-group channels, stats
+        pruning and the scan cache."""
+        from quokka_tpu.dataset.iceberg import IcebergTable
+
+        files = IcebergTable(str(table_dir)).data_files(snapshot_id)
+        if not files:
+            raise ValueError(f"iceberg snapshot of {table_dir} has no data files")
+        reader = InputParquetDataset(files, columns=columns)
         schema = [f for f in reader.schema.names]
         if columns:
             schema = list(columns)
@@ -291,7 +312,8 @@ class QuokkaContext:
                 graph.actors[aid].placement = pl
         self.latest_graph = graph
         n_workers = getattr(self.cluster, "n_workers", 0) if self.cluster else 0
-        if n_workers:
+        ext = getattr(self.cluster, "external_workers", 0) if self.cluster else 0
+        if n_workers or ext:
             from quokka_tpu.runtime.distributed import run_distributed
 
             try:
@@ -301,6 +323,11 @@ class QuokkaContext:
                     kill_after_inputs=self.exec_config.get("inject_kill_worker"),
                     heartbeat_timeout=self.exec_config.get("heartbeat_timeout"),
                     worker_tags=self.worker_tags,
+                    external_workers=ext,
+                    # external daemons (TPUPodCluster hosts) reach the store
+                    # across the network; local-only runs stay on loopback
+                    bind="0.0.0.0" if ext else "127.0.0.1",
+                    store_port=getattr(self.cluster, "store_port", 0),
                 )
             finally:
                 graph.cleanup()

@@ -1,0 +1,214 @@
+"""Minimal Avro Object Container File reader.
+
+Iceberg's table metadata tier stores manifest lists and manifests as Avro
+files (the reference reads them through pyiceberg, df.py:802); neither
+pyiceberg nor fastavro is available in this image, so this module implements
+the small subset of the Avro 1.11 spec those files need, from the public
+format definition:
+
+- container framing: magic ``Obj\\x01``, file-metadata map (schema JSON +
+  codec), 16-byte sync marker, then (count, byte-size, payload, sync) blocks
+- codecs: ``null`` and ``deflate`` (raw zlib, no header)
+- decoding: records, unions, arrays, maps, and all primitives; enums decode
+  to their symbol string, fixed to bytes.  Logical types are returned as
+  their raw representation (Iceberg's readers interpret them downstream).
+
+Writing is NOT implemented — the engine only consumes Iceberg metadata
+(tests carry their own tiny spec-following encoder plus golden-byte
+fixtures, so the reader is not validated against itself alone).
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import zlib
+from typing import Any, Dict, List, Tuple
+
+MAGIC = b"Obj\x01"
+
+
+class AvroError(ValueError):
+    pass
+
+
+class _Reader:
+    def __init__(self, buf: bytes):
+        self.buf = buf
+        self.pos = 0
+
+    def read(self, n: int) -> bytes:
+        if self.pos + n > len(self.buf):
+            raise AvroError("truncated avro data")
+        out = self.buf[self.pos : self.pos + n]
+        self.pos += n
+        return out
+
+    def at_end(self) -> bool:
+        return self.pos >= len(self.buf)
+
+    # -- primitives ---------------------------------------------------------
+    def long(self) -> int:
+        """zigzag varint (int and long share the encoding)."""
+        shift = 0
+        acc = 0
+        while True:
+            b = self.read(1)[0]
+            acc |= (b & 0x7F) << shift
+            if not b & 0x80:
+                break
+            shift += 7
+            if shift > 70:
+                raise AvroError("varint too long")
+        return (acc >> 1) ^ -(acc & 1)
+
+    def bytes_(self) -> bytes:
+        n = self.long()
+        if n < 0:
+            raise AvroError("negative bytes length")
+        return self.read(n)
+
+    def string(self) -> str:
+        return self.bytes_().decode("utf-8")
+
+    def float_(self) -> float:
+        return struct.unpack("<f", self.read(4))[0]
+
+    def double(self) -> float:
+        return struct.unpack("<d", self.read(8))[0]
+
+    def boolean(self) -> bool:
+        return self.read(1) != b"\x00"
+
+
+def _decode(r: _Reader, schema) -> Any:
+    """Decode one datum per the (parsed-JSON) schema."""
+    if isinstance(schema, list):  # union: branch index, then value
+        idx = r.long()
+        if not 0 <= idx < len(schema):
+            raise AvroError(f"union branch {idx} out of range")
+        return _decode(r, schema[idx])
+    if isinstance(schema, str):
+        t = schema
+    else:
+        t = schema["type"]
+    if t == "null":
+        return None
+    if t == "boolean":
+        return r.boolean()
+    if t in ("int", "long"):
+        return r.long()
+    if t == "float":
+        return r.float_()
+    if t == "double":
+        return r.double()
+    if t == "bytes":
+        return r.bytes_()
+    if t == "string":
+        return r.string()
+    if t == "record":
+        out = {}
+        for f in schema["fields"]:
+            out[f["name"]] = _decode(r, f["type"])
+        return out
+    if t == "array":
+        items = schema["items"]
+        out_l: List[Any] = []
+        while True:
+            n = r.long()
+            if n == 0:
+                break
+            if n < 0:  # block with explicit byte size (skippable form)
+                n = -n
+                r.long()  # byte size, unused
+            for _ in range(n):
+                out_l.append(_decode(r, items))
+        return out_l
+    if t == "map":
+        values = schema["values"]
+        out_m: Dict[str, Any] = {}
+        while True:
+            n = r.long()
+            if n == 0:
+                break
+            if n < 0:
+                n = -n
+                r.long()
+            for _ in range(n):
+                # key MUST be read before the value (RHS of a subscript
+                # assignment evaluates first)
+                k = r.string()
+                out_m[k] = _decode(r, values)
+        return out_m
+    if t == "enum":
+        idx = r.long()
+        symbols = schema["symbols"]
+        if not 0 <= idx < len(symbols):
+            raise AvroError(f"enum index {idx} out of range")
+        return symbols[idx]
+    if t == "fixed":
+        return r.read(int(schema["size"]))
+    raise AvroError(f"unsupported avro type {t!r}")
+
+
+def _resolve_named(schema, names: Dict[str, Any]):
+    """Register and resolve named-type references (a schema may reference an
+    earlier record/enum/fixed by name)."""
+    if isinstance(schema, list):
+        return [_resolve_named(s, names) for s in schema]
+    if isinstance(schema, str):
+        return names.get(schema, schema)
+    t = schema.get("type")
+    if t in ("record", "enum", "fixed"):
+        name = schema.get("name")
+        if name is not None:
+            names[name] = schema
+            full = schema.get("namespace")
+            if full:
+                names[f"{full}.{name}"] = schema
+        if t == "record":
+            schema = dict(schema)
+            schema["fields"] = [
+                {**f, "type": _resolve_named(f["type"], names)}
+                for f in schema["fields"]
+            ]
+            names[schema["name"]] = schema
+        return schema
+    if t == "array":
+        return {**schema, "items": _resolve_named(schema["items"], names)}
+    if t == "map":
+        return {**schema, "values": _resolve_named(schema["values"], names)}
+    return schema
+
+
+def read_file(data: bytes) -> Tuple[List[dict], dict]:
+    """Decode a whole container file -> (records, file_metadata)."""
+    r = _Reader(data)
+    if r.read(4) != MAGIC:
+        raise AvroError("not an avro object container file")
+    meta_schema = {"type": "map", "values": "bytes"}
+    meta = _decode(r, meta_schema)  # str keys (avro map), bytes values
+    sync = r.read(16)
+    schema = json.loads(meta["avro.schema"].decode("utf-8"))
+    schema = _resolve_named(schema, {})
+    codec = meta.get("avro.codec", b"null").decode()
+    records: List[dict] = []
+    while not r.at_end():
+        count = r.long()
+        size = r.long()
+        payload = r.read(size)
+        if codec == "deflate":
+            payload = zlib.decompress(payload, -15)
+        elif codec != "null":
+            raise AvroError(f"unsupported codec {codec!r}")
+        br = _Reader(payload)
+        for _ in range(count):
+            records.append(_decode(br, schema))
+        if r.read(16) != sync:
+            raise AvroError("sync marker mismatch")
+    return records, meta
+
+
+def read_path(path: str) -> Tuple[List[dict], dict]:
+    with open(path, "rb") as f:
+        return read_file(f.read())

@@ -1,0 +1,138 @@
+"""Iceberg table reader (metadata tier).
+
+Reference parity: QuokkaContext.read_iceberg (pyquokka/df.py:802), which
+walks an Iceberg table's metadata through pyiceberg and scans the resulting
+parquet file list.  pyiceberg is not in this image, so the walk is
+implemented directly against the public table spec with the in-repo Avro
+reader (dataset/avro.py):
+
+    table_dir/metadata/version-hint.text     -> current metadata version
+    table_dir/metadata/vN.metadata.json      -> snapshots, schemas, specs
+    snapshot["manifest-list"]  (avro)        -> manifest file paths   (v2)
+    snapshot["manifests"]                    -> same, inline          (v1)
+    manifest (avro) entries                  -> data files + status
+
+Data files with status DELETED(2) are dropped; the survivors feed the
+existing local parquet reader (row-group channels, stats pruning, scan
+cache), so predicate/projection pushdown and ANN pruning all apply
+unchanged.  ``snapshot_id`` gives time travel to any retained snapshot.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+from quokka_tpu.dataset import avro
+
+STATUS_DELETED = 2
+
+
+class IcebergError(ValueError):
+    pass
+
+
+def _local_path(uri: str, table_dir: str, location: Optional[str]) -> str:
+    """Map a metadata-recorded URI to a local filesystem path.  Tables are
+    commonly relocated after writing; paths under the recorded table
+    ``location`` are re-rooted onto table_dir."""
+    p = uri
+    if p.startswith("file://"):
+        p = p[len("file://"):]
+    if location:
+        loc = location
+        if loc.startswith("file://"):
+            loc = loc[len("file://"):]
+        if p.startswith(loc.rstrip("/") + "/"):
+            p = os.path.join(table_dir, p[len(loc.rstrip("/")) + 1:])
+    if not os.path.isabs(p):
+        p = os.path.join(table_dir, p)
+    return p
+
+
+class IcebergTable:
+    def __init__(self, table_dir: str):
+        self.table_dir = table_dir
+        meta_dir = os.path.join(table_dir, "metadata")
+        if not os.path.isdir(meta_dir):
+            raise IcebergError(f"{table_dir} has no metadata/ directory")
+        self.metadata = self._load_metadata(meta_dir)
+        self.location = self.metadata.get("location")
+
+    @staticmethod
+    def _load_metadata(meta_dir: str) -> Dict:
+        hint = os.path.join(meta_dir, "version-hint.text")
+        path = None
+        if os.path.exists(hint):
+            with open(hint) as f:
+                v = f.read().strip()
+            cand = os.path.join(meta_dir, f"v{v}.metadata.json")
+            if os.path.exists(cand):
+                path = cand
+        if path is None:
+            versions = sorted(
+                f for f in os.listdir(meta_dir) if f.endswith(".metadata.json")
+            )
+            if not versions:
+                raise IcebergError(f"no *.metadata.json under {meta_dir}")
+            path = os.path.join(meta_dir, versions[-1])
+        with open(path) as f:
+            return json.load(f)
+
+    @property
+    def snapshots(self) -> List[Dict]:
+        return self.metadata.get("snapshots", [])
+
+    @property
+    def current_snapshot_id(self) -> Optional[int]:
+        return self.metadata.get("current-snapshot-id")
+
+    def snapshot(self, snapshot_id: Optional[int] = None) -> Dict:
+        sid = snapshot_id if snapshot_id is not None else self.current_snapshot_id
+        if sid is None or sid == -1:
+            raise IcebergError("table has no current snapshot")
+        for s in self.snapshots:
+            if s.get("snapshot-id") == sid:
+                return s
+        raise IcebergError(
+            f"snapshot {sid} not found (have "
+            f"{[s.get('snapshot-id') for s in self.snapshots]})"
+        )
+
+    def _manifest_paths(self, snap: Dict) -> List[str]:
+        if "manifest-list" in snap:  # v2 (and most v1 writers)
+            mlist = _local_path(snap["manifest-list"], self.table_dir, self.location)
+            records, _ = avro.read_path(mlist)
+            return [
+                _local_path(r["manifest_path"], self.table_dir, self.location)
+                for r in records
+            ]
+        if "manifests" in snap:  # v1 inline form
+            return [
+                _local_path(p, self.table_dir, self.location)
+                for p in snap["manifests"]
+            ]
+        raise IcebergError("snapshot carries neither manifest-list nor manifests")
+
+    def data_files(self, snapshot_id: Optional[int] = None) -> List[str]:
+        """Live parquet data files of a snapshot, metadata order."""
+        snap = self.snapshot(snapshot_id)
+        out: List[str] = []
+        for mpath in self._manifest_paths(snap):
+            entries, _ = avro.read_path(mpath)
+            for e in entries:
+                if e.get("status") == STATUS_DELETED:
+                    continue
+                df = e.get("data_file") or {}
+                fmt = str(df.get("file_format", "PARQUET")).upper()
+                if fmt != "PARQUET":
+                    raise IcebergError(f"unsupported data file format {fmt}")
+                out.append(
+                    _local_path(df["file_path"], self.table_dir, self.location)
+                )
+        return out
+
+
+def data_files(table_dir: str, snapshot_id: Optional[int] = None) -> List[str]:
+    return IcebergTable(table_dir).data_files(snapshot_id)

@@ -44,6 +44,9 @@ from quokka_tpu.expression import (
     UnaryOp,
     _rebuild,
 )
+import numpy as np
+
+from quokka_tpu import config
 from quokka_tpu.ops import expr_compile, kernels
 from quokka_tpu.ops.batch import DeviceBatch, NumCol, StrCol
 
@@ -156,13 +159,49 @@ def _signature(batch: DeviceBatch, names: Sequence[str]) -> Tuple:
 _FUSED_PROGRAMS: Dict[Tuple, object] = {}
 
 
+# Small-key group-by: the one-hot operand the MXU matmul contracts over is
+# materialized n x (B+1); bound its footprint so a big batch can't blow HBM.
+_SMALL_GROUPBY_MAX_BUCKETS = 256
+_SMALL_GROUPBY_MAX_BYTES = 512 << 20
+
+
 class FusedPartialAgg:
-    """One-jit partial group-by-aggregate: pre-expressions + dense-rank +
-    segment reduces, compiled per (batch signature)."""
+    """One-jit partial group-by-aggregate, compiled per batch signature.
+
+    Two strategies inside the jit:
+    - SMALL-KEY FAST PATH: when every group key is a dictionary-encoded string
+      and the product of dictionary sizes is tiny (TPC-H Q1's
+      returnflag x linestatus = a dozen groups), the group id is computed
+      directly from the codes and float sums/counts reduce via ONE
+      one-hot matmul on the MXU — no sort, and the output batch is a
+      256-row bucket instead of the input's padded length (so everything
+      downstream — shuffle, concat, recombine — shrinks by ~4000x).
+    - GENERAL PATH: multi-operand lax.sort on key limbs + contiguous segment
+      reduces (random-order scatter-adds serialize badly on TPU)."""
 
     def __init__(self, keys: List[str], plan):
         self.keys = keys
         self.plan = plan
+
+    def _small_dims(self, batch: DeviceBatch):
+        """Per-key bucket counts (dict size + a null slot) when the small-key
+        path applies, else None."""
+        if not self.keys:
+            return None
+        if not all(isinstance(batch.columns[k], StrCol) for k in self.keys):
+            return None
+        if not all(op in ("sum", "count") for _, op, _ in self.plan.partials):
+            return None
+        dims = tuple(
+            len(batch.columns[k].dictionary.values) + 1 for k in self.keys
+        )
+        n_buckets = int(np.prod(dims)) + 1  # + the invalid-row dump bucket
+        itemsize = 8 if config.x64_enabled() else 4
+        if n_buckets > _SMALL_GROUPBY_MAX_BUCKETS:
+            return None
+        if batch.padded_len * n_buckets * itemsize > _SMALL_GROUPBY_MAX_BYTES:
+            return None
+        return dims
 
     def __call__(self, batch: DeviceBatch) -> DeviceBatch:
         pre = Prepass(batch)
@@ -178,6 +217,9 @@ class FusedPartialAgg:
                 continue  # bound column
             assert isinstance(c, NumCol), n
             num_inputs[n] = c
+        dims = self._small_dims(batch)
+        if dims is not None:
+            return self._call_small(batch, pre, pre_exprs, num_inputs, dims)
         key_limbs: List[jnp.ndarray] = []
         for k in self.keys:
             c = batch.columns[k]
@@ -204,14 +246,22 @@ class FusedPartialAgg:
         if fn is None:
             fn = self._build(pre_exprs, list(num_inputs), sorted(pre.bound), len(key_limbs))
             _FUSED_PROGRAMS[sig] = fn
+        return self._invoke(
+            fn, batch, pre, num_inputs, tuple(key_limbs), batch.padded_len
+        )
+
+    def _invoke(self, fn, batch, pre, num_inputs, key_arrays, out_pad):
+        """Shared dispatch tail: run the fused program and assemble the
+        partial-aggregate output batch (used by both strategies)."""
         hi_arrays = tuple(
-            c.hi if c.hi is not None else jnp.zeros(0, jnp.int32) for c in num_inputs.values()
+            c.hi if c.hi is not None else jnp.zeros(0, jnp.int32)
+            for c in num_inputs.values()
         )
         outs = fn(
             tuple(c.data for c in num_inputs.values()),
             hi_arrays,
             tuple(pre.bound[k] for k in sorted(pre.bound)),
-            tuple(key_limbs),
+            key_arrays,
             batch.valid,
         )
         *agg_arrays, rep, num = outs
@@ -222,7 +272,7 @@ class FusedPartialAgg:
             cols[pname] = NumCol(
                 arr, "f" if jnp.issubdtype(arr.dtype, jnp.floating) else "i"
             )
-        gvalid = jnp.arange(batch.padded_len) < num
+        gvalid = jnp.arange(out_pad) < num
         return DeviceBatch(cols, gvalid, None, None).note_count(num)
 
     def _build(self, pre_exprs, num_names, bound_names, n_limbs):
@@ -257,6 +307,117 @@ class FusedPartialAgg:
             return (*outs, rep, num)
 
         return fused
+
+    def _call_small(self, batch, pre, pre_exprs, num_inputs, dims):
+        codes = tuple(batch.columns[k].codes for k in self.keys)
+        out_pad = config.bucket_size(int(np.prod(dims)))
+        sig = (
+            "partial_agg_small",
+            _signature(batch, list(num_inputs)),
+            tuple(sorted(pre.bound)),
+            dims,
+            tuple((n, e.sql()) for n, e in pre_exprs),
+            tuple((p, op, tmp) for p, op, tmp in self.plan.partials),
+        )
+        fn = _FUSED_PROGRAMS.get(sig)
+        if fn is None:
+            fn = self._build_small(
+                pre_exprs, list(num_inputs), sorted(pre.bound), dims, out_pad
+            )
+            _FUSED_PROGRAMS[sig] = fn
+        return self._invoke(fn, batch, pre, num_inputs, codes, out_pad)
+
+    def _build_small(self, pre_exprs, num_names, bound_names, dims, out_pad):
+        plan = self.plan
+        n_groups = int(np.prod(dims))
+        strides = []
+        s = 1
+        for d in reversed(dims):
+            strides.append(s)
+            s *= d
+        strides = tuple(reversed(strides))
+
+        @jax.jit
+        def fused(num_arrays, hi_arrays, bound_arrays, codes, valid):
+            n = valid.shape[0]
+            cols = {}
+            for name, arr, hi in zip(num_names, num_arrays, hi_arrays):
+                cols[name] = NumCol(
+                    arr, _infer_kind(arr), hi=hi if hi.shape[0] else None
+                )
+            for name, arr in zip(bound_names, bound_arrays):
+                cols[name] = NumCol(arr, _infer_kind(arr))
+            shim = _ShimBatch(cols, n, valid)
+            pre_cols = {}
+            for name, e in pre_exprs:
+                pre_cols[name] = expr_compile.evaluate_to_column(e, shim)
+            gid = jnp.zeros(n, dtype=jnp.int32)
+            for c, st in zip(codes, strides):
+                # code -1 = null -> slot 0 of that key (SQL: nulls form one group)
+                gid = gid + (c.astype(jnp.int32) + 1) * jnp.int32(st)
+            gid = jnp.where(valid, gid, jnp.int32(n_groups))  # dump bucket
+            fdt = config.float_dtype()
+            onehot = gid[:, None] == jnp.arange(n_groups + 1, dtype=jnp.int32)[None, :]
+            mat_cols = []  # columns reduced by the one matmul
+            seg_results = {}  # partial idx -> bucket array (integer sums)
+            for j, (pname, op, tmp) in enumerate(plan.partials):
+                if op == "count":
+                    mat_cols.append((j, valid.astype(fdt)))
+                    continue
+                v = pre_cols[tmp].data
+                if jnp.issubdtype(v.dtype, jnp.floating):
+                    # invalid (padded) rows may hold NaN garbage, which would
+                    # poison the whole bucket column through NaN * 0
+                    mat_cols.append(
+                        (j, jnp.where(valid, v, jnp.zeros((), v.dtype)))
+                    )
+                else:
+                    # integer sums stay exact via a (rare) segment reduce
+                    x = jnp.where(valid, v, jnp.zeros((), v.dtype))
+                    seg = jax.ops.segment_sum(x, gid, num_segments=n_groups + 1)
+                    seg_results[j] = seg[:n_groups]
+            sums = None
+            if mat_cols:
+                stacked = jnp.stack([c for _, c in mat_cols], axis=1)
+                # HIGHEST: the TPU MXU's default f32 matmul truncates operands
+                # to bf16 (~8 mantissa bits) — sums must keep f32 precision to
+                # match the segment-reduce path
+                sums = jnp.matmul(
+                    onehot.astype(fdt).T, stacked,
+                    precision=jax.lax.Precision.HIGHEST,
+                )[:n_groups]
+            iota = jnp.arange(n, dtype=jnp.int32)
+            rep_b = jnp.min(
+                jnp.where(onehot[:, :n_groups], iota[:, None], jnp.int32(n)),
+                axis=0,
+            )
+            live = rep_b < n
+            num = jnp.sum(live.astype(jnp.int32))
+            bidx = jnp.arange(n_groups, dtype=jnp.int32)
+            order = jnp.argsort(jnp.where(live, bidx, jnp.int32(n_groups) + bidx))
+            outs = []
+            k = 0
+            for j, (pname, op, tmp) in enumerate(plan.partials):
+                if j in seg_results:
+                    arr = seg_results[j]
+                else:
+                    arr = sums[:, k]
+                    k += 1
+                    if op == "count":
+                        # counts <= n <= 2**24 are exact in float32
+                        arr = arr.astype(jnp.int32)
+                arr = arr[order]
+                outs.append(_pad_tail(arr, out_pad))
+            rep_d = jnp.minimum(rep_b[order], jnp.int32(n - 1))
+            return (*outs, _pad_tail(rep_d, out_pad), num)
+
+        return fused
+
+
+def _pad_tail(arr, padded):
+    from quokka_tpu.ops.bridge import _pad_device
+
+    return _pad_device(arr, padded)
 
 
 def _infer_kind(arr):

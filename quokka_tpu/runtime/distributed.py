@@ -79,6 +79,7 @@ def run_distributed(
     external_workers: int = 0,
     bind: str = "127.0.0.1",
     worker_tags=None,
+    store_port: int = 0,
 ) -> None:
     """Execute the graph over worker processes; fills blocking datasets.
     kill_after_inputs=(worker_id, n): SIGKILL that worker once n input seqs
@@ -100,7 +101,7 @@ def run_distributed(
     cs.kv = graph.store.kv
     cs.tables = graph.store.tables
     graph.store = cs
-    server = serve_store(cs, host=bind)
+    server = serve_store(cs, host=bind, port=store_port)
     procs: Dict[int, mp.Process] = {}
     try:
         total_workers = n_workers + external_workers

@@ -52,8 +52,12 @@ class CoordinatorStore(ControlStore):
             return out
 
 
-def serve_store(store: CoordinatorStore, host: str = "127.0.0.1") -> RpcServer:
-    return RpcServer(store, host=host)
+def serve_store(
+    store: CoordinatorStore, host: str = "127.0.0.1", port: int = 0
+) -> RpcServer:
+    """port=0 picks an ephemeral port; multi-host deployments pass a fixed
+    port so worker daemons can be launched with a known address."""
+    return RpcServer(store, host=host, port=port)
 
 
 class ControlStoreClient:

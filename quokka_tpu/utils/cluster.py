@@ -38,21 +38,48 @@ class LocalCluster:
 
 
 class TPUPodCluster:
-    """Description of a multi-host TPU deployment: `hosts` run one worker
-    daemon each; device-resident shuffles ride ICI collectives inside the
-    slice; host-mediated shuffles cross DCN.  Constructing a QuokkaContext
-    against this requires the served control store (multi-host runtime tier —
-    see README roadmap)."""
+    """Multi-host deployment: `hosts` each run one worker daemon;
+    device-resident shuffles ride ICI collectives inside the slice,
+    host-mediated shuffles cross DCN through the socket data plane.
+
+    A QuokkaContext built against this serves its control store on
+    0.0.0.0:store_port and waits for len(hosts) externally-launched workers
+    (runtime/distributed.run_distributed(external_workers=...)); launch each
+    daemon with the commands from worker_commands() — the role the
+    reference's QuokkaClusterManager.copy_and_launch_flight plays over ssh
+    (pyquokka/utils.py:316), minus the ssh (bring your own scheduler:
+    GKE/slurm/tmux).
+
+    SECURITY: the store/data-plane RPC is unauthenticated pickle (the
+    reference's open Redis/Flight trust model) — private networks only."""
 
     def __init__(self, hosts: List[str], chips_per_host: int = 4,
-                 coordinator: Optional[str] = None):
+                 coordinator: Optional[str] = None, store_port: int = 7997,
+                 worker_tags=None):
         self.hosts = hosts
         self.chips_per_host = chips_per_host
         self.coordinator = coordinator or (hosts[0] if hosts else "127.0.0.1")
+        self.store_port = store_port
+        self.worker_tags = worker_tags
+        # consumed by context.execute_node -> run_distributed: 0 local
+        # workers, every channel on an external daemon
+        self.n_workers = 0
 
     @property
     def num_nodes(self) -> int:
         return len(self.hosts)
+
+    @property
+    def external_workers(self) -> int:
+        return len(self.hosts)
+
+    def worker_commands(self) -> List[str]:
+        """One launch command per host, in worker-id order."""
+        return [
+            f"python -m quokka_tpu.runtime.worker "
+            f"--store {self.coordinator}:{self.store_port} --worker-id {k}"
+            for k in range(len(self.hosts))
+        ]
 
 
 class QuokkaClusterManager:

@@ -106,6 +106,54 @@ class TestKill9Recovery:
         pd.testing.assert_frame_equal(got, exp, check_dtype=False)
 
 
+class TestTPUPodCluster:
+    def test_pod_cluster_runs_via_external_daemon(self):
+        """TPUPodCluster drives the multi-host path end-to-end: the context
+        serves its store on the cluster's fixed port, and a worker daemon
+        launched with cluster.worker_commands() picks up every channel."""
+        import shlex
+        import socket
+        import subprocess
+        import sys
+        import threading
+
+        with socket.socket() as s:  # pick a free fixed port for the store
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        from quokka_tpu.utils.cluster import TPUPodCluster
+
+        cluster = TPUPodCluster(["127.0.0.1"], coordinator="127.0.0.1",
+                                store_port=port)
+        cmds = cluster.worker_commands()
+        assert len(cmds) == 1 and f"127.0.0.1:{port}" in cmds[0]
+
+        fact, dim = make_data(seed=5, n=6000)
+        holder = {}
+
+        def launch():
+            import time as _t
+
+            _t.sleep(1.0)  # let the coordinator bind the store first
+            holder["proc"] = subprocess.Popen(
+                [sys.executable] + shlex.split(cmds[0])[1:],
+            )
+
+        th = threading.Thread(target=launch, daemon=True)
+        th.start()
+        try:
+            ctx = QuokkaContext(cluster=cluster)
+            got = q3_shape(ctx, fact, dim)
+        finally:
+            p = holder.get("proc")
+            if p is not None:
+                try:
+                    p.wait(timeout=30)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+        exp = q3_shape(QuokkaContext(), fact, dim)
+        pd.testing.assert_frame_equal(got, exp, check_dtype=False)
+
+
 class TestExternalWorker:
     def test_externally_launched_worker_joins(self, tmp_path):
         """Multi-host path: one spawned worker + one worker launched via
@@ -165,8 +213,8 @@ class TestExternalWorker:
 
         orig = D.serve_store
 
-        def capture(store, host="127.0.0.1"):
-            srv = orig(store, host=host)
+        def capture(store, host="127.0.0.1", port=0):
+            srv = orig(store, host=host, port=port)
             proc_holder["addr"] = srv.address
             return srv
 
