@@ -196,6 +196,10 @@ def read_file(data: bytes) -> Tuple[List[dict], dict]:
     while not r.at_end():
         count = r.long()
         size = r.long()
+        if count < 0 or size < 0:
+            raise AvroError(
+                f"corrupt block header: count={count} size={size}"
+            )
         payload = r.read(size)
         if codec == "deflate":
             payload = zlib.decompress(payload, -15)

@@ -104,10 +104,21 @@ class IcebergTable:
         if "manifest-list" in snap:  # v2 (and most v1 writers)
             mlist = _local_path(snap["manifest-list"], self.table_dir, self.location)
             records, _ = avro.read_path(mlist)
-            return [
-                _local_path(r["manifest_path"], self.table_dir, self.location)
-                for r in records
-            ]
+            paths = []
+            for r in records:
+                # Iceberg v2 manifest-list `content`: 0 = data manifests,
+                # 1 = delete manifests (position/equality deletes).  Applying
+                # row-level deletes is unsupported; failing loudly beats
+                # scanning delete files as data.
+                if int(r.get("content", 0) or 0) != 0:
+                    raise IcebergError(
+                        "table has row-level delete manifests (Iceberg v2 "
+                        "merge-on-read); delete files are not supported"
+                    )
+                paths.append(
+                    _local_path(r["manifest_path"], self.table_dir, self.location)
+                )
+            return paths
         if "manifests" in snap:  # v1 inline form
             return [
                 _local_path(p, self.table_dir, self.location)
@@ -125,6 +136,13 @@ class IcebergTable:
                 if e.get("status") == STATUS_DELETED:
                     continue
                 df = e.get("data_file") or {}
+                # data_file `content`: 0 = data, 1 = position deletes,
+                # 2 = equality deletes
+                if int(df.get("content", 0) or 0) != 0:
+                    raise IcebergError(
+                        "snapshot contains row-level delete files; "
+                        "delete files are not supported"
+                    )
                 fmt = str(df.get("file_format", "PARQUET")).upper()
                 if fmt != "PARQUET":
                     raise IcebergError(f"unsupported data file format {fmt}")

@@ -201,6 +201,10 @@ class FusedPartialAgg:
             return None
         if batch.padded_len * n_buckets * itemsize > _SMALL_GROUPBY_MAX_BYTES:
             return None
+        # float32 matmul accumulation is exact only up to 2^24: beyond that,
+        # counts (and integer-valued sums) can silently lose units
+        if not config.x64_enabled() and batch.padded_len > (1 << 24):
+            return None
         return dims
 
     def __call__(self, batch: DeviceBatch) -> DeviceBatch:

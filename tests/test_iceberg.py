@@ -179,6 +179,17 @@ class TestAvro:
         with pytest.raises(avro.AvroError, match="container"):
             avro.read_file(b"NOPE" + b"\x00" * 40)
 
+    def test_negative_block_header_raises(self):
+        """A corrupt/crafted negative block size must fail loudly instead of
+        rewinding the reader and misaligning decoding."""
+        good = golden_file()
+        # Splice a negative block count (-1 zigzag = 0x01) where the block
+        # header starts (right after the 16-byte sync following metadata).
+        idx = good.index(SYNC) + 16
+        bad = good[:idx] + zz(-1) + good[idx + 1:]
+        with pytest.raises(avro.AvroError, match="corrupt block header"):
+            avro.read_file(bad)
+
 
 # --- Iceberg fixture ---------------------------------------------------------
 
@@ -324,3 +335,56 @@ class TestIceberg:
         build_iceberg_table(root)
         with pytest.raises(ValueError, match="snapshot 99"):
             IcebergTable(root).data_files(snapshot_id=99)
+
+    def test_delete_manifest_rejected(self, tmp_path):
+        """A v2 manifest-list entry with content=1 (delete manifest) must
+        raise, not be scanned as data."""
+        root = str(tmp_path / "tbl")
+        build_iceberg_table(root)
+        loc = f"file://{root}"
+        schema = {
+            "type": "record", "name": "manifest_file", "fields": [
+                {"name": "manifest_path", "type": "string"},
+                {"name": "manifest_length", "type": "long"},
+                {"name": "partition_spec_id", "type": "int"},
+                {"name": "content", "type": "int"},
+            ],
+        }
+        write_container(
+            os.path.join(root, "metadata", "snap-2.avro"), schema,
+            [{"manifest_path": f"{loc}/metadata/m2.avro",
+              "manifest_length": 1, "partition_spec_id": 0, "content": 1}],
+        )
+        with pytest.raises(ValueError, match="delete"):
+            IcebergTable(root).data_files()
+
+    def test_delete_data_file_rejected(self, tmp_path):
+        """A data_file struct with content!=0 (position/equality deletes)
+        must raise, not be appended to the scan list."""
+        root = str(tmp_path / "tbl")
+        build_iceberg_table(root)
+        loc = f"file://{root}"
+        schema = {
+            "type": "record", "name": "manifest_entry", "fields": [
+                {"name": "status", "type": "int"},
+                {"name": "snapshot_id", "type": ["null", "long"]},
+                {"name": "data_file", "type": {
+                    "type": "record", "name": "r2", "fields": [
+                        {"name": "content", "type": "int"},
+                        {"name": "file_path", "type": "string"},
+                        {"name": "file_format", "type": "string"},
+                        {"name": "record_count", "type": "long"},
+                        {"name": "file_size_in_bytes", "type": "long"},
+                    ]}},
+            ],
+        }
+        write_container(
+            os.path.join(root, "metadata", "m2.avro"), schema,
+            [{"status": 1, "snapshot_id": 2,
+              "data_file": {"content": 1,
+                            "file_path": f"{loc}/data/f1-deletes.parquet",
+                            "file_format": "PARQUET",
+                            "record_count": 0, "file_size_in_bytes": 0}}],
+        )
+        with pytest.raises(ValueError, match="delete files"):
+            IcebergTable(root).data_files()
