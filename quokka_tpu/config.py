@@ -28,7 +28,10 @@ if _cache_dir and _cache_dir != "0":
     try:
         os.makedirs(_cache_dir, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", _cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+        # 0.5s skips trivial programs on TPU; the test suite sets 0 so its
+        # thousands of small CPU compiles amortize across runs
+        _min_secs = float(os.environ.get("QUOKKA_JAX_CACHE_MIN_SECS", "0.5"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", _min_secs)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
     except Exception:
         pass

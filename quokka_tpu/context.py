@@ -325,8 +325,12 @@ class QuokkaContext:
                     worker_tags=self.worker_tags,
                     external_workers=ext,
                     # external daemons (TPUPodCluster hosts) reach the store
-                    # across the network; local-only runs stay on loopback
-                    bind="0.0.0.0" if ext else "127.0.0.1",
+                    # across the network: serve on the cluster's declared bind
+                    # interface (default = the coordinator's own address, NOT
+                    # 0.0.0.0); local-only runs stay on loopback
+                    bind=(getattr(self.cluster, "bind", None)
+                          or getattr(self.cluster, "coordinator", "127.0.0.1"))
+                    if ext else "127.0.0.1",
                     store_port=getattr(self.cluster, "store_port", 0),
                 )
             finally:

@@ -91,17 +91,32 @@ def run_distributed(
     They fetch the plan from the served store; liveness for them is
     heartbeat-based (heartbeat_timeout defaults to 15s when external workers
     are expected), and they must send a first heartbeat within ~120s.
-    bind: serve the store/data plane on this interface (0.0.0.0 for
-    cross-machine workers).  SECURITY: the RPC layer is unauthenticated
-    pickle (the same trust model as the reference's open Redis/Arrow-Flight
-    ports) — bind beyond loopback only on a trusted private network."""
+    bind: serve the store/data plane on this interface (the coordinator's
+    routable address for cross-machine workers).  Every connection is
+    HMAC-authenticated against the cluster token (runtime/rpc.py); external
+    daemons must be launched with the same QUOKKA_RPC_TOKEN (carried by
+    TPUPodCluster.worker_commands())."""
+    from quokka_tpu.runtime.rpc import default_token
+
+    # resolve (or mint) the cluster token BEFORE spawning workers so children
+    # inherit it through the environment
+    default_token()
     # promote the graph's embedded store (already populated by lowering) to a
     # served CoordinatorStore: rebind the same table/kv dicts
     cs = CoordinatorStore()
     cs.kv = graph.store.kv
     cs.tables = graph.store.tables
     graph.store = cs
-    server = serve_store(cs, host=bind, port=store_port)
+    try:
+        server = serve_store(cs, host=bind, port=store_port)
+    except OSError:
+        if bind in ("127.0.0.1", "0.0.0.0", "::"):
+            raise
+        # the declared coordinator address may be NAT'd (workers dial a
+        # public IP that is not on any local interface): serve all
+        # interfaces instead — connections are HMAC-authenticated, so this
+        # is exposure of the handshake only
+        server = serve_store(cs, host="0.0.0.0", port=store_port)
     procs: Dict[int, mp.Process] = {}
     try:
         total_workers = n_workers + external_workers
@@ -112,6 +127,12 @@ def run_distributed(
                     for ch in chs:
                         cs.tset("CLT", (aid, ch), w)
         cs.set("expected_workers", total_workers)
+        # unique per query session: persistent daemons join each session at
+        # most once (a daemon that crashed out of a session must not rejoin
+        # it after its channels were adopted by survivors)
+        import uuid
+
+        cs.set("session_id", uuid.uuid4().hex)
         spec = pickle.dumps(_build_spec(graph))
         # externally-launched workers fetch plan + ownership from the store
         cs.set("spec", spec)

@@ -1,4 +1,4 @@
-"""Minimal length-prefixed pickle RPC over TCP.
+"""Minimal length-prefixed pickle RPC over TCP with an HMAC handshake.
 
 The multi-worker runtime needs two services the reference gets from Redis and
 Arrow Flight (pyquokka/tables.py, flight.py): a served control store and a
@@ -6,21 +6,112 @@ per-worker batch data plane.  Both are method-call shaped, so one tiny RPC
 layer serves them: each request is (method_name, args) pickled with a 4-byte
 length prefix; each response is (ok, value_or_exception).
 
-Single-host localhost trust model (same as the reference's unauthenticated
-Redis/Flight inside a cluster).  Threaded server: one thread per connection,
-so a blocking call from one worker never stalls another's.
+Pickle deserialization is arbitrary code execution, so every connection is
+mutually authenticated before the first pickle byte is read: the server sends
+a nonce, the client proves knowledge of the cluster token with
+HMAC-SHA256(token, "C" + server_nonce + client_nonce), and the server proves
+itself back with the "S"-prefixed HMAC over the same nonces.  The token comes
+from QUOKKA_RPC_TOKEN; a coordinator that finds none generates one and
+publishes it into its own environ so spawned workers inherit it, and
+TPUPodCluster.worker_commands() carries it to external daemons.  (This is a
+deliberate improvement over the reference's open Redis/Flight ports.)
+
+Threaded server: one thread per connection, so a blocking call from one
+worker never stalls another's.
 """
 
 from __future__ import annotations
 
+import hmac
+import hashlib
+import os
 import pickle
+import secrets
 import socket
 import socketserver
 import struct
 import threading
-from typing import Any, Tuple
+from typing import Any, Optional, Tuple
 
 _LEN = struct.Struct(">I")
+_MAGIC = b"QRPC1"
+_NONCE = 16
+
+
+class RpcAuthError(ConnectionError):
+    """Peer failed the HMAC handshake (wrong or missing cluster token)."""
+
+
+def _token_file() -> str:
+    return os.path.join(
+        os.path.expanduser("~"), ".config", "quokka_tpu", "cluster_token"
+    )
+
+
+def default_token() -> str:
+    """The cluster-wide shared secret.  Resolution order: QUOKKA_RPC_TOKEN
+    env var; then a per-user token file (so `worker_commands()` printed from
+    one process authenticates against a coordinator started in another); else
+    mint one, persist it to the file (0600), and publish it into this
+    process's environ so mp-spawned children inherit it."""
+    tok = os.environ.get("QUOKKA_RPC_TOKEN")
+    if tok:
+        return tok
+    path = _token_file()
+    try:
+        with open(path) as f:
+            tok = f.read().strip()
+    except OSError:
+        tok = ""
+    if not tok:
+        tok = secrets.token_hex(16)
+        try:
+            os.makedirs(os.path.dirname(path), mode=0o700, exist_ok=True)
+            fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+            with os.fdopen(fd, "w") as f:
+                f.write(tok)
+        except OSError:
+            pass  # no writable home: token lives in this process tree only
+    os.environ["QUOKKA_RPC_TOKEN"] = tok
+    return tok
+
+
+def _mac(token: str, tag: bytes, nonce_s: bytes, nonce_c: bytes) -> bytes:
+    return hmac.new(
+        token.encode(), tag + nonce_s + nonce_c, hashlib.sha256
+    ).digest()
+
+
+def _server_handshake(sock: socket.socket, token: str) -> bool:
+    nonce_s = secrets.token_bytes(_NONCE)
+    sock.sendall(_MAGIC + nonce_s)
+    try:
+        reply = _recv_exact(sock, _NONCE + 32)
+    except ConnectionError:
+        return False
+    nonce_c, client_mac = reply[:_NONCE], reply[_NONCE:]
+    if not hmac.compare_digest(client_mac, _mac(token, b"C", nonce_s, nonce_c)):
+        return False
+    sock.sendall(_mac(token, b"S", nonce_s, nonce_c))
+    return True
+
+
+def _client_handshake(sock: socket.socket, token: str) -> None:
+    head = _recv_exact(sock, len(_MAGIC) + _NONCE)
+    if head[: len(_MAGIC)] != _MAGIC:
+        raise RpcAuthError("peer is not a quokka RPC server")
+    nonce_s = head[len(_MAGIC):]
+    nonce_c = secrets.token_bytes(_NONCE)
+    sock.sendall(nonce_c + _mac(token, b"C", nonce_s, nonce_c))
+    try:
+        server_mac = _recv_exact(sock, 32)
+    except ConnectionError:
+        raise RpcAuthError(
+            "server closed the connection during the auth handshake — "
+            "QUOKKA_RPC_TOKEN mismatch?"
+        ) from None
+    if not hmac.compare_digest(server_mac, _mac(token, b"S", nonce_s, nonce_c)):
+        raise RpcAuthError("server failed to prove the cluster token")
 
 
 def _send_msg(sock: socket.socket, obj: Any) -> None:
@@ -46,6 +137,12 @@ def _recv_msg(sock: socket.socket) -> Any:
 class _Handler(socketserver.BaseRequestHandler):
     def handle(self):
         target = self.server.target  # type: ignore[attr-defined]
+        token = self.server.token  # type: ignore[attr-defined]
+        try:
+            if not _server_handshake(self.request, token):
+                return  # unauthenticated peer: no pickle is ever read
+        except (ConnectionError, OSError):
+            return
         while True:
             try:
                 method, args = _recv_msg(self.request)
@@ -70,13 +167,15 @@ class RpcServer:
     """Serve an object's methods.  The object must expose a `_lock` (RLock)
     for `__multi__` atomic batches."""
 
-    def __init__(self, target: Any, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, target: Any, host: str = "127.0.0.1", port: int = 0,
+                 token: Optional[str] = None):
         class _Srv(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
             daemon_threads = True
 
         self._srv = _Srv((host, port), _Handler)
         self._srv.target = target  # type: ignore[attr-defined]
+        self._srv.token = token or default_token()  # type: ignore[attr-defined]
         self.address: Tuple[str, int] = self._srv.server_address
         self._thread = threading.Thread(target=self._srv.serve_forever, daemon=True)
         self._thread.start()
@@ -89,10 +188,12 @@ class RpcServer:
 class RpcClient:
     """One persistent connection; thread-safe via a per-client lock."""
 
-    def __init__(self, address: Tuple[str, int], timeout: float = 120.0):
+    def __init__(self, address: Tuple[str, int], timeout: float = 120.0,
+                 token: Optional[str] = None):
         self.address = tuple(address)
         self._sock = socket.create_connection(self.address, timeout=timeout)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        _client_handshake(self._sock, token or default_token())
         self._lock = threading.Lock()
 
     def call(self, method: str, *args):

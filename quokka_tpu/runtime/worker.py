@@ -248,41 +248,118 @@ def worker_main(spec_bytes: bytes, store_addr, worker_id: int, owned):
         store.close()
 
 
+def _connect_store(addr, deadline: Optional[float]):
+    """Connect with retry: the coordinator's store may not be serving yet
+    (daemons can be launched before the first query), or may be between
+    query sessions in --persist mode.  deadline=None retries forever.
+    A token mismatch is deterministic and fails fast, never retried."""
+    from quokka_tpu.runtime.rpc import RpcAuthError
+
+    while True:
+        try:
+            return ControlStoreClient(addr)
+        except RpcAuthError:
+            raise
+        except (ConnectionRefusedError, ConnectionError, OSError, TimeoutError):
+            if deadline is not None and time.time() > deadline:
+                raise
+            time.sleep(0.5)
+
+
+def _serve_one_session(addr, worker_id: int, join_timeout: float,
+                       served=None) -> bool:
+    """Join the store at addr, fetch plan + ownership, run until SHUTDOWN.
+    Returns False when no plan appeared within join_timeout (nothing ran).
+
+    `served` (persist mode): set of session ids this daemon has already
+    joined.  A session is joined AT MOST ONCE — if the daemon crashed out of
+    it, the coordinator has declared it dead and adopted its channels on a
+    survivor; rejoining with the original ownership map would split-brain
+    (two workers taping the same channels)."""
+    store = _connect_store(addr, time.time() + join_timeout)
+    try:
+        deadline = time.time() + join_timeout
+        spec_bytes = None
+        owned = None
+        sid = None
+        while time.time() < deadline:
+            if store.get("SHUTDOWN"):
+                return False  # tail of an already-finished session
+            sid = store.get("session_id")
+            if served is not None and sid is not None and sid in served:
+                return False  # already joined (and possibly crashed out of)
+            spec_bytes = store.get("spec")
+            owned = store.get(("owned", worker_id))
+            if spec_bytes is not None and owned is not None:
+                break
+            time.sleep(0.2)
+    finally:
+        store.close()
+    if spec_bytes is None or owned is None:
+        return False
+    if served is not None and sid is not None:
+        served.add(sid)
+    worker_main(spec_bytes, addr, worker_id, owned)
+    return True
+
+
 def main(argv=None):
     """Standalone worker for multi-host deployments: join a coordinator's
     served store, fetch the plan + channel ownership, and run.
 
-        python -m quokka_tpu.runtime.worker --store HOST:PORT --worker-id K
+        python -m quokka_tpu.runtime.worker --store HOST:PORT --worker-id K \
+            [--persist]
 
-    The coordinator must have been started with external_workers > 0 so K's
-    channels were assigned (runtime/distributed.run_distributed)."""
+    The coordinator must be started with external_workers > K so K's channels
+    get assigned (runtime/distributed.run_distributed).  --persist keeps the
+    daemon alive across queries: each QuokkaContext query serves a fresh
+    store session on the same port; the daemon reconnects and serves each in
+    turn until killed (the deployment mode QuokkaClusterManager.start_cluster
+    launches).  The daemon authenticates with QUOKKA_RPC_TOKEN
+    (runtime/rpc.py)."""
     import argparse
 
     p = argparse.ArgumentParser(description=main.__doc__)
     p.add_argument("--store", required=True, help="coordinator HOST:PORT")
     p.add_argument("--worker-id", type=int, required=True)
+    p.add_argument("--persist", action="store_true",
+                   help="serve query sessions forever (daemon mode)")
     args = p.parse_args(argv)
     host, port = args.store.rsplit(":", 1)
-    store = ControlStoreClient((host, int(port)))
-    try:
-        deadline = time.time() + 120
-        spec_bytes = None
-        owned = None
-        while time.time() < deadline:
-            spec_bytes = store.get("spec")
-            owned = store.get(("owned", args.worker_id))
-            if spec_bytes is not None and owned is not None:
-                break
-            time.sleep(0.2)
-        if spec_bytes is None or owned is None:
+    addr = (host, int(port))
+    if not args.persist:
+        if not _serve_one_session(addr, args.worker_id, join_timeout=120):
             raise TimeoutError(
                 f"coordinator at {args.store} never published a plan for "
                 f"worker {args.worker_id} (was it started with "
                 "external_workers > this id?)"
             )
-    finally:
-        store.close()
-    worker_main(spec_bytes, (host, int(port)), args.worker_id, owned)
+        return
+    from quokka_tpu.runtime.rpc import RpcAuthError
+
+    served: set = set()
+    auth_failures = 0
+    while True:
+        try:
+            if _serve_one_session(addr, args.worker_id, join_timeout=10,
+                                  served=served):
+                auth_failures = 0
+        except RpcAuthError:
+            # A server that closes mid-handshake is indistinguishable from a
+            # token rejection (the server deliberately reveals nothing), and
+            # a coordinator tearing down a finished session produces exactly
+            # that close.  Retry a couple of times; a real token mismatch is
+            # deterministic and still dies loudly.
+            auth_failures += 1
+            if auth_failures >= 3:
+                raise
+        except (ConnectionError, OSError, TimeoutError, EOFError):
+            pass  # session ended mid-flight (coordinator closed); rejoin
+        except Exception:
+            import traceback
+
+            traceback.print_exc()  # session crashed; daemon stays up
+        time.sleep(0.3)
 
 
 if __name__ == "__main__":

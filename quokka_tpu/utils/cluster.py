@@ -1,18 +1,24 @@
-"""Cluster descriptions.
+"""Cluster descriptions and bring-up.
 
 Reference parity: pyquokka/utils.py — LocalCluster (utils.py:96), EC2Cluster
-(utils.py:25), QuokkaClusterManager (utils.py:191).  The embedded runtime
-executes everything in-process, so LocalCluster is a description object; the
-TPU-pod deployment path (one worker per host, chips addressed through
-jax.distributed + the collective shuffle plane in quokka_tpu.parallel) is
-specified here so multi-host contexts can be constructed uniformly, while
-cloud provisioning (the reference shells out to boto3/ssh) is deliberately out
-of scope for the embedded build and raises with guidance.
+(utils.py:25), QuokkaClusterManager (utils.py:191, create/start/stop clusters,
+copy_and_launch_flight 316).  The embedded runtime executes everything
+in-process, so LocalCluster is a description object; TPUPodCluster describes a
+multi-host deployment (one worker daemon per host), and QuokkaClusterManager
+actually launches those daemons — over ssh for remote hosts, as local
+subprocesses for loopback hosts — the role the reference's
+copy_and_launch_flight plays.  Cloud *provisioning* (creating VMs: the
+reference shells out to boto3) still raises with guidance; bring-up on
+existing hosts is fully automated.
 """
 
 from __future__ import annotations
 
-from typing import List, Optional
+import os
+import shlex
+import subprocess
+import sys
+from typing import Dict, List, Optional
 
 
 class LocalCluster:
@@ -43,24 +49,32 @@ class TPUPodCluster:
     host-mediated shuffles cross DCN through the socket data plane.
 
     A QuokkaContext built against this serves its control store on
-    0.0.0.0:store_port and waits for len(hosts) externally-launched workers
-    (runtime/distributed.run_distributed(external_workers=...)); launch each
-    daemon with the commands from worker_commands() — the role the
-    reference's QuokkaClusterManager.copy_and_launch_flight plays over ssh
-    (pyquokka/utils.py:316), minus the ssh (bring your own scheduler:
-    GKE/slurm/tmux).
+    `bind` (default: the coordinator's own address — not 0.0.0.0) at
+    store_port and waits for len(hosts) externally-launched workers
+    (runtime/distributed.run_distributed(external_workers=...)).  Launch the
+    daemons yourself with worker_commands(), or let
+    QuokkaClusterManager.start_cluster() execute them (ssh for remote hosts,
+    subprocess for loopback) — the reference's
+    QuokkaClusterManager.copy_and_launch_flight over ssh
+    (pyquokka/utils.py:316).
 
-    SECURITY: the store/data-plane RPC is unauthenticated pickle (the
-    reference's open Redis/Flight trust model) — private networks only."""
+    Every store/data-plane connection is HMAC-authenticated against the
+    cluster token (runtime/rpc.py); worker_commands() embeds it."""
 
     def __init__(self, hosts: List[str], chips_per_host: int = 4,
                  coordinator: Optional[str] = None, store_port: int = 7997,
-                 worker_tags=None):
+                 worker_tags=None, bind: Optional[str] = None,
+                 remote_python: str = "python3"):
         self.hosts = hosts
         self.chips_per_host = chips_per_host
         self.coordinator = coordinator or (hosts[0] if hosts else "127.0.0.1")
         self.store_port = store_port
         self.worker_tags = worker_tags
+        # interface the coordinator serves on; None = its own address
+        self.bind = bind
+        # interpreter on the pod hosts (the coordinator's sys.executable path
+        # rarely exists remotely)
+        self.remote_python = remote_python
         # consumed by context.execute_node -> run_distributed: 0 local
         # workers, every channel on an external daemon
         self.n_workers = 0
@@ -73,30 +87,146 @@ class TPUPodCluster:
     def external_workers(self) -> int:
         return len(self.hosts)
 
-    def worker_commands(self) -> List[str]:
-        """One launch command per host, in worker-id order."""
+    def _bare_commands(self, persist: bool = True,
+                       python: Optional[str] = None) -> List[str]:
+        """Launch commands WITHOUT the token (the manager supplies it
+        out-of-band: env for local daemons, stdin over ssh).  `python`
+        defaults per host: this interpreter for loopback hosts, the
+        cluster's remote_python elsewhere."""
+        flag = " --persist" if persist else ""
+        out = []
+        for k, host in enumerate(self.hosts):
+            exe = python or (
+                shlex.quote(sys.executable) if _is_local(host)
+                else self.remote_python
+            )
+            out.append(
+                f"{exe} -m quokka_tpu.runtime.worker "
+                f"--store {self.coordinator}:{self.store_port} --worker-id {k}"
+                + flag
+            )
+        return out
+
+    def worker_commands(self, persist: bool = True) -> List[str]:
+        """One launch command per host, in worker-id order, for a human (or a
+        scheduler template) to run.  persist=True (the default) keeps each
+        daemon alive across queries.  NOTE: embeds the cluster token for
+        copy-paste convenience — anyone who can read the command line can
+        join the cluster; QuokkaClusterManager.start_cluster passes the token
+        out-of-band instead."""
+        from quokka_tpu.runtime.rpc import default_token
+
+        token = shlex.quote(default_token())
         return [
-            f"python -m quokka_tpu.runtime.worker "
-            f"--store {self.coordinator}:{self.store_port} --worker-id {k}"
-            for k in range(len(self.hosts))
+            f"QUOKKA_RPC_TOKEN={token} {cmd}"
+            for cmd in self._bare_commands(persist)
         ]
 
 
+def _is_local(host: str) -> bool:
+    return host in ("localhost", "127.0.0.1", "::1", "0.0.0.0")
+
+
 class QuokkaClusterManager:
-    """Provisioning entry points (create/start/stop clusters).  Cloud
+    """Bring-up on existing hosts (start/stop worker daemons); cloud VM
     provisioning is not available in the embedded build."""
+
+    def __init__(self, ssh_user: Optional[str] = None,
+                 ssh_options: Optional[List[str]] = None):
+        self.ssh_user = ssh_user
+        self.ssh_options = ssh_options or ["-o", "StrictHostKeyChecking=no",
+                                           "-o", "BatchMode=yes"]
+        # id(cluster) -> {worker index -> Popen}: one manager can run
+        # several clusters without clobbering handles
+        self._procs: Dict[int, Dict[int, subprocess.Popen]] = {}
 
     def create_local_cluster(self, **kwargs) -> LocalCluster:
         return LocalCluster(**kwargs)
 
+    # -- daemon bring-up ------------------------------------------------------
+    def start_cluster(self, cluster: TPUPodCluster,
+                      log_dir: Optional[str] = None) -> "TPUPodCluster":
+        """Launch one worker daemon per host (reference:
+        utils.py:316 copy_and_launch_flight, minus the file copy — the
+        package must already be importable on each host).  Loopback hosts
+        launch as local subprocesses; remote hosts over ssh (the daemon is
+        left running detached with nohup).  Returns the cluster for
+        chaining into QuokkaContext(cluster=...)."""
+        from quokka_tpu.runtime.rpc import default_token
+
+        token = default_token()
+        cmds = cluster._bare_commands(persist=True)
+        for k, (host, cmd) in enumerate(zip(cluster.hosts, cmds)):
+            log = None
+            try:
+                if log_dir:
+                    os.makedirs(log_dir, exist_ok=True)
+                    log = open(os.path.join(log_dir, f"worker-{k}.log"), "ab")
+                if _is_local(host):
+                    env = dict(os.environ)
+                    env["QUOKKA_RPC_TOKEN"] = token
+                    # a loopback daemon runs this same installation: make the
+                    # package importable regardless of the caller's cwd
+                    pkg_root = os.path.dirname(os.path.dirname(
+                        os.path.dirname(os.path.abspath(__file__))))
+                    env["PYTHONPATH"] = (
+                        pkg_root + os.pathsep + env["PYTHONPATH"]
+                        if env.get("PYTHONPATH") else pkg_root
+                    )
+                    p = subprocess.Popen(
+                        shlex.split(cmd), env=env,
+                        stdout=log or subprocess.DEVNULL,
+                        stderr=subprocess.STDOUT,
+                    )
+                else:
+                    # token travels on ssh stdin — never on the remote argv
+                    # (ps-visible) and never interpolated into shell text
+                    target = (f"{self.ssh_user}@{host}" if self.ssh_user
+                              else host)
+                    p = subprocess.Popen(
+                        ["ssh", *self.ssh_options, target,
+                         "read -r QUOKKA_RPC_TOKEN; export QUOKKA_RPC_TOKEN; "
+                         f"nohup {cmd} >/tmp/quokka-worker-{k}.log 2>&1 &"],
+                        stdin=subprocess.PIPE,
+                        stdout=log or subprocess.DEVNULL,
+                        stderr=subprocess.STDOUT,
+                    )
+                    p.stdin.write((token + "\n").encode())
+                    p.stdin.close()
+            finally:
+                if log is not None:
+                    log.close()  # the child keeps its inherited fd
+            self._procs.setdefault(id(cluster), {})[k] = p
+        return cluster
+
+    def stop_cluster(self, cluster: TPUPodCluster) -> None:
+        """Terminate THIS cluster's daemons; remote hosts get a pkill over
+        ssh."""
+        for k, p in self._procs.pop(id(cluster), {}).items():
+            if p.poll() is None:
+                p.terminate()
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+        for k, host in enumerate(cluster.hosts):
+            if not _is_local(host):
+                target = f"{self.ssh_user}@{host}" if self.ssh_user else host
+                subprocess.run(
+                    ["ssh", *self.ssh_options, target,
+                     "pkill -f 'quokka_tpu.runtime.worker.*--worker-id "
+                     f"{k}' || true"],
+                    check=False,
+                )
+
+    terminate_cluster = stop_cluster
+
+    # -- provisioning (not available) -----------------------------------------
     def create_cluster(self, *args, **kwargs):
         raise NotImplementedError(
-            "cloud cluster provisioning (EC2/GKE) is not available in the "
+            "cloud VM provisioning (EC2/GKE) is not available in the "
             "embedded build; construct a TPUPodCluster from existing hosts "
-            "or use LocalCluster"
+            "(then start_cluster launches its daemons) or use LocalCluster"
         )
 
     get_cluster_from_json = create_cluster
-    start_cluster = create_cluster
-    stop_cluster = create_cluster
-    terminate_cluster = create_cluster

@@ -5,7 +5,12 @@ initializes (hence top-of-module, before any quokka_tpu import)."""
 import os
 
 os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ["QUOKKA_JAX_CACHE_DIR"] = "0"  # persistent cache is for TPU runs only
+# Persistent compile cache across test runs: CPU compiles are cheap singly but
+# the suite compiles thousands of programs; warm runs skip nearly all of it.
+os.environ.setdefault(
+    "QUOKKA_JAX_CACHE_DIR", os.path.expanduser("~/.cache/quokka_tpu_test_jax")
+)
+os.environ.setdefault("QUOKKA_JAX_CACHE_MIN_SECS", "0")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
@@ -16,6 +21,12 @@ import jax
 # the env var — force CPU back before any backend initializes.
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+# CPU compiles are individually fast (mostly < 0.5s, the production cache
+# threshold) but number in the thousands across the suite: cache all of them.
+try:
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+except Exception:
+    pass
 
 assert jax.default_backend() == "cpu", jax.devices()
 assert jax.device_count() == 8, jax.devices()

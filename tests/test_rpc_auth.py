@@ -1,0 +1,110 @@
+"""RPC HMAC handshake (runtime/rpc.py): unauthorized peers are refused before
+any pickle is deserialized; both directions authenticate (VERDICT r2 #8)."""
+
+import socket
+
+import pytest
+
+from quokka_tpu.runtime.rpc import (
+    RpcAuthError,
+    RpcClient,
+    RpcServer,
+    default_token,
+)
+
+
+class Target:
+    import threading
+
+    def __init__(self):
+        import threading
+
+        self._lock = threading.RLock()
+        self.calls = []
+
+    def ping(self, x):
+        self.calls.append(x)
+        return x * 2
+
+
+class TestHandshake:
+    def test_authorized_roundtrip(self):
+        t = Target()
+        srv = RpcServer(t, token="s3cret")
+        try:
+            cli = RpcClient(srv.address, token="s3cret")
+            assert cli.call("ping", 21) == 42
+            cli.close()
+        finally:
+            srv.close()
+        assert t.calls == [21]
+
+    def test_wrong_token_refused(self):
+        t = Target()
+        srv = RpcServer(t, token="s3cret")
+        try:
+            with pytest.raises(RpcAuthError):
+                RpcClient(srv.address, token="wrong")
+        finally:
+            srv.close()
+        assert t.calls == []  # nothing was ever dispatched
+
+    def test_raw_garbage_never_reaches_pickle(self):
+        """A peer that skips the handshake and throws bytes at the port gets
+        disconnected; the target object is never touched."""
+        t = Target()
+        srv = RpcServer(t, token="s3cret")
+        try:
+            s = socket.create_connection(srv.address, timeout=5)
+            s.settimeout(5)
+            s.recv(64)  # server's magic + nonce
+            # a pickle-shaped payload without the HMAC reply shape would be
+            # read AS the handshake reply and fail verification
+            s.sendall(b"\x80\x04\x95" + b"A" * 45)
+            # server must close without sending its own proof
+            tail = b""
+            try:
+                while True:
+                    chunk = s.recv(4096)
+                    if not chunk:
+                        break
+                    tail += chunk
+            except socket.timeout:
+                pytest.fail("server kept the unauthenticated connection open")
+            assert tail == b""
+            s.close()
+        finally:
+            srv.close()
+        assert t.calls == []
+
+    def test_server_must_prove_token_too(self):
+        """A fake server that replies with a bogus proof is rejected by the
+        client (protects the client's pickle path from a malicious server)."""
+        import threading
+
+        fake = socket.socket()
+        fake.bind(("127.0.0.1", 0))
+        fake.listen(1)
+
+        def serve():
+            conn, _ = fake.accept()
+            conn.sendall(b"QRPC1" + b"N" * 16)
+            conn.recv(48)
+            conn.sendall(b"X" * 32)  # wrong proof
+            conn.close()
+
+        th = threading.Thread(target=serve, daemon=True)
+        th.start()
+        try:
+            with pytest.raises(RpcAuthError):
+                RpcClient(fake.getsockname(), token="s3cret")
+        finally:
+            fake.close()
+
+    def test_default_token_published_to_environ(self, monkeypatch):
+        monkeypatch.delenv("QUOKKA_RPC_TOKEN", raising=False)
+        import os
+
+        tok = default_token()
+        assert tok and os.environ["QUOKKA_RPC_TOKEN"] == tok
+        assert default_token() == tok  # stable within the process
