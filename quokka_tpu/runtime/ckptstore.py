@@ -1,0 +1,52 @@
+"""Checkpoint store: executor-state snapshots that survive worker loss.
+
+The reference writes checkpoints to an S3 bucket (pyquokka/core.py:678-685)
+precisely because a node's local disk dies with the node; only the HBQ spill
+is node-local (hbq.py).  Same discipline here: checkpoints go to a root that
+all workers can reach — a shared directory, or any fsspec URL (s3://, gs://)
+via exec_config["checkpoint_store"].  Writes are atomic (tmp + rename) on
+local paths so a reader never sees a torn snapshot.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+class CheckpointStore:
+    def __init__(self, root: str):
+        self.root = root.rstrip("/")
+        self._remote = "://" in root
+        if not self._remote:
+            os.makedirs(root, exist_ok=True)
+
+    def _path(self, actor: int, ch: int, state_seq: int) -> str:
+        return f"{self.root}/ckpt-{actor}-{ch}-{state_seq}.pkl"
+
+    def save(self, actor: int, ch: int, state_seq: int, data: bytes) -> None:
+        p = self._path(actor, ch, state_seq)
+        if self._remote:
+            import fsspec
+
+            with fsspec.open(p, "wb") as f:
+                f.write(data)
+            return
+        with open(p + ".tmp", "wb") as f:
+            f.write(data)
+        os.replace(p + ".tmp", p)
+
+    def load(self, actor: int, ch: int, state_seq: int) -> Optional[bytes]:
+        p = self._path(actor, ch, state_seq)
+        if self._remote:
+            import fsspec
+
+            fs, _, paths = fsspec.get_fs_token_paths(p)
+            if not fs.exists(paths[0]):
+                return None
+            with fsspec.open(p, "rb") as f:
+                return f.read()
+        if not os.path.exists(p):
+            return None
+        with open(p, "rb") as f:
+            return f.read()

@@ -32,10 +32,15 @@ def ipc_to_table(data: bytes) -> pa.Table:
 
 
 class CacheService:
-    """RPC target wrapping a worker's local BatchCache for remote do_put."""
+    """RPC target wrapping a worker's local BatchCache for remote do_put,
+    plus read access to the worker's private HBQ spill so an adopter
+    elsewhere can replay objects this worker produced (the reference
+    co-locates ReplayTasks with an HBQ copy, coordinator.py:424-552; here
+    the adopter pulls over the data plane instead)."""
 
-    def __init__(self, cache: BatchCache):
+    def __init__(self, cache: BatchCache, hbq=None):
         self.cache = cache
+        self.hbq = hbq
         self._lock = threading.RLock()  # for RpcServer __multi__ (unused)
 
     def put_ipc(self, name: Tuple, ipc: bytes, sorted_by=None):
@@ -45,12 +50,28 @@ class CacheService:
     def size(self) -> int:
         return self.cache.size()
 
+    def hbq_names_for_target(self, tgt_actor: int, tgt_ch: int):
+        if self.hbq is None:
+            return []
+        return self.hbq.names_for_target(tgt_actor, tgt_ch)
+
+    def hbq_get_ipc(self, name: Tuple) -> Optional[bytes]:
+        if self.hbq is None:
+            return None
+        table = self.hbq.get(tuple(name))
+        if table is None:
+            return None
+        return table_to_ipc(table)
+
 
 class DataPlaneClient:
     """Push batches to a peer worker's cache."""
 
-    def __init__(self, address: Tuple[str, int]):
-        self._rpc = RpcClient(address)
+    def __init__(self, address: Tuple[str, int], timeout: float = 30.0):
+        # shorter than the RPC default: a DEAD REMOTE host must fail a
+        # recovery probe in bounded time, and 30s/recv is still ample for
+        # large Arrow IPC puts
+        self._rpc = RpcClient(address, timeout=timeout)
 
     def put(self, name: Tuple, batch, sorted_by=None) -> None:
         self._rpc.call(
@@ -58,9 +79,18 @@ class DataPlaneClient:
             sorted_by,
         )
 
+    def hbq_names_for_target(self, tgt_actor: int, tgt_ch: int):
+        return [tuple(n) for n in
+                self._rpc.call("hbq_names_for_target", tgt_actor, tgt_ch)]
+
+    def hbq_get(self, name: Tuple) -> Optional[pa.Table]:
+        ipc = self._rpc.call("hbq_get_ipc", tuple(name))
+        return None if ipc is None else ipc_to_table(ipc)
+
     def close(self) -> None:
         self._rpc.close()
 
 
-def serve_cache(cache: BatchCache, host: str = "127.0.0.1") -> RpcServer:
-    return RpcServer(CacheService(cache), host=host)
+def serve_cache(cache: BatchCache, host: str = "127.0.0.1",
+                hbq=None) -> RpcServer:
+    return RpcServer(CacheService(cache, hbq=hbq), host=host)

@@ -233,7 +233,11 @@ def _coordinate(graph, cs, procs, owned, timeout, kill_after_inputs,
                 kill_after_inputs = None
         # failure detection: dead process or stale heartbeat.  External
         # (multi-host) workers have no local PID: heartbeat staleness only.
+        # ONE sweep collects every death before any recovery runs, so rewind
+        # planning sees the whole co-dead set (a consumer on worker A whose
+        # tape needs a producer on co-dead worker B requires joint planning).
         now = time.time()
+        newly_dead: List[int] = []
         for w in all_ids:
             p = procs.get(w)
             if w in dead:
@@ -261,9 +265,7 @@ def _coordinate(graph, cs, procs, owned, timeout, kill_after_inputs,
                             "fault_tolerance is not enabled"
                         )
                     dead.add(w)
-                    if not _recover_worker(graph, cs, w, owned, procs, dead,
-                                           all_ids):
-                        raise RuntimeError(f"worker {w} died; no survivor")
+                    newly_dead.append(w)
                 continue
             if not p.is_alive() and w not in started:
                 raise RuntimeError(
@@ -293,10 +295,13 @@ def _coordinate(graph, cs, procs, owned, timeout, kill_after_inputs,
                         "(no HBQ spill to recover from)"
                     )
                 dead.add(w)
-                self_heal = _recover_worker(graph, cs, w, owned, procs, dead,
-                                            all_ids)
-                if not self_heal:
-                    raise RuntimeError(f"worker {w} died and no survivor exists")
+                newly_dead.append(w)
+        if newly_dead:
+            if not _recover_workers(graph, cs, newly_dead, owned, procs, dead,
+                                    all_ids):
+                raise RuntimeError(
+                    f"workers {newly_dead} died and no survivor exists"
+                )
         if _all_done(graph, cs):
             return
         while stage_idx < len(stages) - 1 and not _stage_undone(
@@ -306,11 +311,21 @@ def _coordinate(graph, cs, procs, owned, timeout, kill_after_inputs,
             cs.set("STAGE", stages[stage_idx])
 
 
-def _recover_worker(graph, cs, dead_worker: int, owned, procs, dead,
-                    all_ids=None) -> bool:
-    """Reassign the dead worker's channels to survivors and trigger adoption
-    (reference: coordinator.py:219-421 recovery barrier, simplified to the
-    shared-disk case).  Survivors include live EXTERNAL workers."""
+def _recover_workers(graph, cs, dead_workers: List[int], owned, procs, dead,
+                     all_ids=None) -> bool:
+    """Reassign every dead worker's channels to survivors and trigger
+    adoption (reference: coordinator.py:219-421 recovery barrier).  No shared
+    disk is assumed: each worker spills to a PRIVATE HBQ dir and adopters
+    pull surviving copies from live peers over the data plane (or re-read
+    input lineage when no copy survives); executor checkpoints go to the
+    checkpoint store (exec_config["checkpoint_store"], an fsspec URL — the
+    reference's S3 bucket, core.py:678-685).  Survivors include live
+    EXTERNAL workers.
+
+    Rewind planning runs over the UNION of the dead workers' exec channels:
+    a consumer on one dead worker whose tape consumes a co-dead producer's
+    pre-checkpoint outputs forces that producer to a deeper checkpoint
+    (engine.plan_rewinds)."""
     pool = all_ids if all_ids is not None else list(procs)
     survivors = [
         w for w in pool
@@ -318,15 +333,28 @@ def _recover_worker(graph, cs, dead_worker: int, owned, procs, dead,
     ]
     if not survivors:
         return False
-    per_actor = owned.get(dead_worker, {})
+    from quokka_tpu.runtime.engine import plan_rewinds
+
+    dead_exec = [
+        (aid, ch)
+        for dw in dead_workers
+        for aid, chs in owned.get(dw, {}).items()
+        if graph.actors[aid].kind == "exec"
+        for ch in chs
+    ]
+    choices = plan_rewinds(cs, dead_exec)
     i = 0
     with cs.transaction():
-        for aid, chs in per_actor.items():
-            for ch in chs:
-                w = survivors[i % len(survivors)]
-                i += 1
-                cs.tset("CLT", (aid, ch), w)
-                owned[w].setdefault(aid, []).append(ch)
-                cs.mailbox_push(w, ("adopt", aid, ch))
-    owned[dead_worker] = {}
+        for dw in dead_workers:
+            for aid, chs in owned.get(dw, {}).items():
+                for ch in chs:
+                    w = survivors[i % len(survivors)]
+                    i += 1
+                    cs.tset("CLT", (aid, ch), w)
+                    owned[w].setdefault(aid, []).append(ch)
+                    cs.mailbox_push(
+                        w, ("adopt", aid, ch, choices.get((aid, ch)))
+                    )
+    for dw in dead_workers:
+        owned[dw] = {}
     return True

@@ -241,6 +241,47 @@ class TaskGraph:
         return out
 
 
+def plan_rewinds(store, dead_exec: List[Tuple[int, int]]) -> Dict[Tuple[int, int], Tuple[int, int, int]]:
+    """Need-driven checkpoint selection for a set of simultaneously lost exec
+    channels (the reference's rewind requests, coordinator.py:221-229,274-334).
+
+    Default = each channel's latest checkpoint.  But when channel X's replay
+    tape consumes an object produced by co-dead channel Y at an output seq
+    BELOW Y's chosen checkpoint out_seq, no surviving copy of that object may
+    exist (HBQ spill is producer-local and died with Y's worker) — Y must
+    rewind to a checkpoint old enough to regenerate it.  Iterate to fixpoint;
+    choices only move backward, bounded by (0, 0, 0), so this terminates."""
+    dead = set(dead_exec)
+    choice: Dict[Tuple[int, int], Tuple[int, int, int]] = {}
+    for (a, ch) in dead:
+        lct = store.tget("LCT", (a, ch))
+        choice[(a, ch)] = tuple(lct) if lct is not None else (0, 0, 0)
+    changed = True
+    while changed:
+        changed = False
+        for (a, ch) in dead:
+            for ev in store.tape_slice(a, ch, choice[(a, ch)][2]):
+                if ev[0] != "exec":
+                    continue
+                for name in ev[2]:
+                    key = (name[0], name[1])
+                    if key not in dead:
+                        continue  # producer alive: its HBQ still serves it
+                    seq = name[2]
+                    if choice[key][1] <= seq:
+                        continue  # producer's replay regenerates it
+                    hist = [(0, 0, 0)] + list(
+                        store.tget("LT", ("ckpts",) + key) or []
+                    )
+                    best = max(
+                        (h for h in hist if h[1] <= seq), key=lambda h: h[0]
+                    )
+                    if tuple(best) != choice[key]:
+                        choice[key] = tuple(best)
+                        changed = True
+    return choice
+
+
 def _feeds(partitioner, src_ch: int, tgt_ch: int, n_tgt: int) -> bool:
     if isinstance(partitioner, PassThroughPartitioner):
         return src_ch % n_tgt == tgt_ch
@@ -642,8 +683,17 @@ class Engine:
             return
         self.store.tappend("LT", ("tape", actor, ch), event)
 
-    def _ckpt_file(self, actor: int, ch: int, state_seq: int) -> str:
-        return os.path.join(self.g.ckpt_dir, f"ckpt-{actor}-{ch}-{state_seq}.pkl")
+    def _ckpt_store(self):
+        """Checkpoints outlive their writer (reference: S3, core.py:678-685):
+        exec_config["checkpoint_store"] may point anywhere fsspec can reach;
+        default = the run's checkpoint dir (shared on one machine)."""
+        store = getattr(self, "_ckpt_store_obj", None)
+        if store is None:
+            from quokka_tpu.runtime.ckptstore import CheckpointStore
+
+            root = self.g.exec_config.get("checkpoint_store") or self.g.ckpt_dir
+            store = self._ckpt_store_obj = CheckpointStore(root)
+        return store
 
     def _checkpoint(self, executor, task: ExecutorTask) -> None:
         """Snapshot executor state + input frontier + tape position
@@ -653,8 +703,9 @@ class Engine:
             # replay; recording an LCT here would silently drop state
             return
         state = executor.checkpoint()
-        with open(self._ckpt_file(task.actor, task.channel, task.state_seq), "wb") as f:
-            pickle.dump(state, f)
+        self._ckpt_store().save(
+            task.actor, task.channel, task.state_seq, pickle.dumps(state)
+        )
         tape_len = self.store.tape_len(task.actor, task.channel)
         with self.store.transaction():
             self.store.tset(
@@ -662,37 +713,55 @@ class Engine:
                 (task.actor, task.channel),
                 (task.state_seq, task.out_seq, tape_len),
             )
+            # full checkpoint HISTORY, not just the latest: recovery may have
+            # to rewind a producer PAST its latest checkpoint when a co-dead
+            # consumer's tape needs outputs the latest checkpoint postdates
+            # (the reference's rewind requests, coordinator.py:221-229)
+            self.store.tappend(
+                "LT", ("ckpts", task.actor, task.channel),
+                (task.state_seq, task.out_seq, tape_len),
+            )
             self.store.tset(
                 "IRT",
                 (task.actor, task.channel, task.state_seq),
                 {a: dict(c) for a, c in task.input_reqs.items()},
             )
-        # events before the checkpoint position are dead: recovery always
-        # restores from this (latest) checkpoint — GC the tape prefix
-        self.store.tape_trim(task.actor, task.channel, tape_len)
+        # The tape is NOT trimmed at checkpoints: pre-checkpoint events must
+        # stay replayable because a failure can lose both a producer and a
+        # consumer, and regenerating the consumer's lost inputs may require
+        # replaying the producer from an older state than its latest
+        # checkpoint (no shared spill disk is assumed).  Tape entries are
+        # small host tuples — the reference similarly keeps full lineage in
+        # Redis for the run's lifetime.
 
     def simulate_failure_and_recover(self, failed: List[Tuple[int, int]]) -> None:
         """Kill the given exec (actor, channel) workers — losing executor
         state, their queued tasks, and cached inputs destined to them — then
-        run the recovery protocol (coordinator.py:219-552): restore from the
-        latest checkpoint, rebuild the input frontier from IRT, and replay
-        already-produced inputs from the HBQ spill."""
+        run the recovery protocol (coordinator.py:219-552): restore from a
+        checkpoint chosen by the rewind planner, rebuild the input frontier
+        from IRT, and replay already-produced inputs from the HBQ spill."""
         assert self.g.hbq is not None, "fault tolerance is not enabled"
+        dead_exec = []
         for (a, ch) in failed:
             info = self.g.actors[a]
             assert info.kind == "exec", "simulated failures target exec workers"
             for name in list(self.cache.flights_info()):
                 if name[3] == a and name[5] == ch:
                     self.cache.gc([name])
-            self._recover_channel(a, ch)
+            dead_exec.append((a, ch))
+        choices = plan_rewinds(self.store, dead_exec)
+        for (a, ch) in failed:
+            self._recover_channel(a, ch, choice=choices.get((a, ch)))
 
-    def _recover_channel(self, a: int, ch: int) -> None:
+    def _recover_channel(self, a: int, ch: int, choice=None) -> None:
         """Rebuild one lost channel by QUEUEING recovery tasks into NTT (the
         reference pushes TapedInputTask/TapedExecutorTask/ReplayTask from the
         coordinator, pyquokka/coordinator.py:424-552): whichever worker owns
         the channel after reassignment pops and executes them through its
         normal task loop.  Shared by the embedded failure simulation and the
-        distributed worker's channel adoption (runtime/worker.py)."""
+        distributed worker's channel adoption (runtime/worker.py).
+        `choice` = (state_seq, out_seq, tape_pos) from the rewind planner;
+        None restores the latest checkpoint."""
         info = self.g.actors[a]
         self.store.tdel("DST", (a, ch))
         self.store.ntt_remove_channel(a, ch)
@@ -706,11 +775,9 @@ class Engine:
             else:
                 self.store.sadd("DST", (a, ch), "done")
             return
-        lct = self.store.tget("LCT", (a, ch))
-        if lct is not None:
-            state_seq, out_seq, tape_pos = lct
-        else:
-            state_seq, out_seq, tape_pos = 0, 0, 0
+        if choice is None:
+            choice = self.store.tget("LCT", (a, ch)) or (0, 0, 0)
+        state_seq, out_seq, tape_pos = choice
         reqs = {
             s: dict(c)
             for s, c in self.store.tget("IRT", (a, ch, state_seq)).items()
@@ -726,26 +793,100 @@ class Engine:
             ),
         )
 
+    # -- HBQ resolution hooks -------------------------------------------------
+    # The embedded engine owns the run's only HBQ; the distributed Worker
+    # overrides these to aggregate its OWN spill dir with every live peer's
+    # (served over the data plane) — the reference's ReplayTask-co-located-
+    # with-an-HBQ-copy discipline (coordinator.py:424-552) with the transfer
+    # direction inverted: the adopter pulls instead of the holder pushing.
+    def _hbq_names_for_target(self, tgt_actor: int, tgt_ch: int):
+        return self.g.hbq.names_for_target(tgt_actor, tgt_ch)
+
+    def _hbq_fetch(self, name: Tuple):
+        return self.g.hbq.get(name)
+
+    def _recompute_object(self, name: Tuple):
+        """Last-resort recovery of a lost object (no live HBQ holds it):
+        when its producer is an INPUT actor, the read is pure per lineage —
+        re-read the lineage and re-partition for exactly the lost consumer
+        channel (the reference's 'new input requests', coordinator.py:274-334).
+        Exec-produced objects are regenerated by the producer's own tape
+        replay instead; returns None for those."""
+        src_a, src_ch, seq, tgt_a, _pfn, tgt_ch = name
+        info = self.g.actors.get(src_a)
+        if info is None or info.kind != "input":
+            return None
+        lineage = self.store.tget("LT", (src_a, src_ch, seq))
+        if lineage is None:
+            return None
+        batch = self._read_and_bridge(info, src_ch, lineage)
+        if info.predicate is not None:
+            # exactly the live input path: source predicate BEFORE push
+            # (handle_input_task), else the recomputed object gains rows
+            batch = info.predicate(batch)
+        parts = self._partition_fn(src_a, tgt_a)(batch, src_ch)
+        return parts.get(tgt_ch)
+
+    def _resolve_lost_object(self, name: Tuple):
+        """cache -> any live HBQ -> input re-read; None if irrecoverable
+        right now (the producer's tape replay may still regenerate it)."""
+        b = self.cache.get(name)
+        if b is not None:
+            return b
+        table = self._hbq_fetch(name)
+        if table is not None:
+            return bridge.arrow_to_device(table)
+        return self._recompute_object(name)
+
     def handle_exectape_task(self, task: TapedExecutorTask) -> bool:
         """Run a queued tape replay: recreate the executor, restore the
         checkpoint named by task.state_seq, re-run the recorded event history,
         then requeue the channel as a live ExecutorTask plus a ReplayTask that
-        refills its input cache from the HBQ spill."""
+        refills its input cache from the HBQ spill.
+
+        All tape inputs are resolved BEFORE any event executes: a missing one
+        (its producer's own adoption/replay may not have re-pushed it yet)
+        requeues this task untouched instead of corrupting executor state
+        with a partial replay."""
         a, ch = task.actor, task.channel
-        self.execs[(a, ch)] = self.g.actors[a].executor_factory()
-        path = self._ckpt_file(a, ch, task.state_seq)
-        if os.path.exists(path):
-            with open(path, "rb") as f:
-                self.execs[(a, ch)].restore(pickle.load(f))
-        elif task.state_seq > 0:
-            raise FileNotFoundError(
-                f"checkpoint {path} named by LCT is missing — cannot rebuild "
-                f"channel ({a},{ch}) at state {task.state_seq}"
-            )
         reqs = {s: dict(c) for s, c in task.input_reqs.items()}
         tape = self.store.tape_slice(a, ch, task.tape_pos)
+        resolved: Dict[Tuple, DeviceBatch] = {}
+        for ev in tape:
+            if ev[0] != "exec":
+                continue
+            for name in ev[2]:
+                if name in resolved:
+                    continue
+                b = self._resolve_lost_object(name)
+                if b is None:
+                    # time-based, not attempt-based: the co-dead producer's
+                    # own replay (possibly from state 0 with a long tape) can
+                    # legitimately take minutes to regenerate this object
+                    deadline = getattr(task, "retry_deadline", None)
+                    if deadline is None:
+                        deadline = task.retry_deadline = time.time() + 600.0
+                    if time.time() > deadline:
+                        raise RuntimeError(
+                            f"tape input {name} for channel ({a},{ch}) is in "
+                            "no live HBQ and its producer never regenerated "
+                            "it within 600s — irrecoverable loss"
+                        )
+                    self.store.ntt_push(a, task)
+                    time.sleep(0.05)
+                    return False
+                resolved[name] = b
+        self.execs[(a, ch)] = self.g.actors[a].executor_factory()
+        blob = self._ckpt_store().load(a, ch, task.state_seq)
+        if blob is not None:
+            self.execs[(a, ch)].restore(pickle.loads(blob))
+        elif task.state_seq > 0:
+            raise FileNotFoundError(
+                f"checkpoint for ({a},{ch}) state {task.state_seq} named by "
+                "LCT is missing from the checkpoint store — cannot rebuild"
+            )
         state_seq, out_seq = self._replay_tape(
-            a, ch, tape, reqs, task.state_seq, task.out_seq
+            a, ch, tape, reqs, task.state_seq, task.out_seq, resolved
         )
         # replay-complete check: the tape must advance the state exactly to
         # where the coordinator said the channel was when it queued this task
@@ -756,7 +897,7 @@ class Engine:
         with self.store.transaction():
             self.store.tset("EST", (a, ch), state_seq)
         if self.g.hbq is not None:
-            hbq_names = self.g.hbq.names_for_target(a, ch)
+            hbq_names = self._hbq_names_for_target(a, ch)
             specs = [
                 name
                 for name in hbq_names
@@ -781,31 +922,34 @@ class Engine:
 
     def handle_replay_task(self, task: ReplayTask) -> bool:
         """Re-push spilled post-partition objects to the (rebuilt) consumer's
-        cache — the reference's ReplayTask (pyquokka/core.py:967-1025), except
-        the objects come off the shared spill dir rather than a peer's HBQ."""
+        cache — the reference's ReplayTask (pyquokka/core.py:967-1025), the
+        objects coming off this worker's own HBQ or a live peer's (or an
+        input re-read when no copy survives)."""
         for name in task.replay_specs:
-            table = self.g.hbq.get(name)
-            if table is not None:
-                self._cache_put(name, bridge.arrow_to_device(table))
+            b = self._resolve_lost_object(name)
+            if b is not None:
+                self._cache_put(name, b)
         return True
 
     def _replay_tape(self, actor: int, ch: int, events, reqs,
-                     state_seq: int, out_seq: int):
+                     state_seq: int, out_seq: int, resolved=None):
         """Re-run the recorded event history: identical inputs in identical
         order reproduce identical outputs at identical seqs (so downstream
-        consumers — which may already hold some of them — stay consistent)."""
+        consumers — which may already hold some of them — stay consistent).
+        `resolved` maps pre-fetched object names to batches
+        (handle_exectape_task resolves the whole tape up front)."""
         info = self.g.actors[actor]
         executor = self.execs[(actor, ch)]
+        resolved = resolved or {}
         for ev in events:
             if ev[0] == "exec":
                 _, src_actor, names, emitted = ev
                 batches = []
                 for name in names:
-                    b = self.cache.get(name)
+                    b = resolved.get(name)
                     if b is None:
-                        table = self.g.hbq.get(name)
-                        assert table is not None, f"lost object {name} not in HBQ"
-                        b = bridge.arrow_to_device(table)
+                        b = self._resolve_lost_object(name)
+                        assert b is not None, f"lost object {name} not in any HBQ"
                     batches.append(b)
                 out = executor.execute(batches, info.source_streams[src_actor], ch)
                 re_emitted = out is not None

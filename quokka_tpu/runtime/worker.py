@@ -58,13 +58,10 @@ def _actors_from_spec(spec: Dict) -> Dict[int, ActorInfo]:
 
 class Worker(Engine):
     def __init__(self, spec: Dict, store, cache: BatchCache, worker_id: int,
-                 owned: Dict[int, List[int]]):
+                 owned: Dict[int, List[int]], hbq=None):
         actors = _actors_from_spec(spec)
-        hbq = None
-        if spec["hbq_path"]:
-            from quokka_tpu.runtime.hbq import HBQ
-
-            hbq = HBQ(spec["hbq_path"])
+        if hbq is None and spec["hbq_path"]:
+            hbq = _worker_hbq(spec, worker_id)
         g = WorkerGraph(store, cache, actors, spec["exec_config"], hbq,
                         spec["ckpt_dir"])
         self.worker_id = worker_id
@@ -140,12 +137,83 @@ class Worker(Engine):
     def _result_append(self, info, channel, seq, table):
         self.store.result_append(info.id, channel, seq, table_to_ipc(table))
 
+    # -- HBQ across workers ---------------------------------------------------
+    # Spill is producer-local (each worker's PRIVATE dir — no shared
+    # filesystem assumed); recovery aggregates this worker's HBQ with every
+    # reachable peer's, served over the data plane.  An unreachable peer is
+    # negative-cached for a while (a dead REMOTE host otherwise costs a full
+    # connect timeout per probe), and per-target holder maps are TTL-cached
+    # so resolving N lost objects costs ~P listing calls, not N*P probes.
+    _PEER_DOWN_TTL = 15.0
+    _HOLDER_TTL = 1.0
+
+    def _iter_peer_clients(self, refresh_addrs: bool = True):
+        if refresh_addrs:
+            now = time.time()
+            if now - getattr(self, "_addrs_at", 0) > 2.0:
+                self._peer_addrs = dict(self.store.get("worker_addrs") or {})
+                self._addrs_at = now
+        down = getattr(self, "_peers_down", None)
+        if down is None:
+            down = self._peers_down = {}
+        for w in sorted(self._peer_addrs):
+            if w == self.worker_id:
+                continue
+            if time.time() < down.get(w, 0):
+                continue
+            try:
+                yield w, self._peer(w)
+            except (ConnectionError, OSError):
+                self._peers.pop(w, None)
+                down[w] = time.time() + self._PEER_DOWN_TTL
+
+    def _hbq_holders(self, tgt: Tuple[int, int]):
+        """name -> peer worker id, one listing RPC per live peer, TTL-cached
+        (listings grow while co-dead producers replay, so the cache is
+        deliberately short-lived)."""
+        cache = getattr(self, "_holder_cache", None)
+        if cache is None:
+            cache = self._holder_cache = {}
+        hit = cache.get(tgt)
+        if hit is not None and time.time() - hit[0] < self._HOLDER_TTL:
+            return hit[1]
+        holders = {}
+        for w, cli in self._iter_peer_clients():
+            try:
+                for name in cli.hbq_names_for_target(*tgt):
+                    holders[name] = w
+            except (ConnectionError, OSError):
+                self._peers.pop(w, None)
+                self._peers_down[w] = time.time() + self._PEER_DOWN_TTL
+        cache[tgt] = (time.time(), holders)
+        return holders
+
+    def _hbq_names_for_target(self, tgt_actor: int, tgt_ch: int):
+        names = set(self.g.hbq.names_for_target(tgt_actor, tgt_ch))
+        names.update(self._hbq_holders((tgt_actor, tgt_ch)))
+        return sorted(names)
+
+    def _hbq_fetch(self, name):
+        table = self.g.hbq.get(name)
+        if table is not None:
+            return table
+        w = self._hbq_holders((name[3], name[5])).get(tuple(name))
+        if w is None:
+            return None
+        try:
+            return self._peer(w).hbq_get(name)
+        except (ConnectionError, OSError):
+            self._peers.pop(w, None)
+            self._peers_down[w] = time.time() + self._PEER_DOWN_TTL
+            return None
+
     # -- recovery adoption ----------------------------------------------------
-    def _adopt(self, actor: int, channel: int):
+    def _adopt(self, actor: int, channel: int, choice=None):
         """Take over a failed peer's channel: the shared Engine recovery path
-        (checkpoint + tape + HBQ replay) against this worker's local cache."""
+        (checkpoint + tape + HBQ replay) against this worker's local cache.
+        `choice` is the coordinator's rewind-planner checkpoint selection."""
         self.owned.setdefault(actor, set()).add(channel)
-        self._recover_channel(actor, channel)
+        self._recover_channel(actor, channel, choice=choice)
 
     # -- main loop ------------------------------------------------------------
     def run_worker(self, heartbeat_every: float = 0.2):
@@ -174,7 +242,8 @@ class Worker(Engine):
             for msg in self.store.mailbox_drain(self.worker_id):
                 if msg[0] == "adopt":
                     self._refresh_clt()
-                    self._adopt(msg[1], msg[2])
+                    self._adopt(msg[1], msg[2],
+                                choice=msg[3] if len(msg) > 3 else None)
             if self.store.get("SHUTDOWN"):
                 return
             stage = self.store.get("STAGE", 0)
@@ -191,6 +260,15 @@ class Worker(Engine):
                 progress |= self.dispatch_task(task)
             if not progress:
                 time.sleep(0.01)
+
+
+def _worker_hbq(spec: Dict, worker_id: int):
+    """Each worker spills into its own PRIVATE subdir of the run's spill
+    root — nothing assumes peers can read it from disk (multi-host safe);
+    recovery fetches across workers over the data plane instead."""
+    from quokka_tpu.runtime.hbq import HBQ
+
+    return HBQ(os.path.join(spec["hbq_path"], f"worker-{worker_id}"))
 
 
 def worker_main(spec_bytes: bytes, store_addr, worker_id: int, owned):
@@ -214,17 +292,18 @@ def worker_main(spec_bytes: bytes, store_addr, worker_id: int, owned):
     store = ControlStoreClient(tuple(store_addr))
     try:
         cache = BatchCache()
+        hbq = _worker_hbq(spec, worker_id) if spec["hbq_path"] else None
         # advertise the address peers can actually reach: the local IP of the
         # socket we used to reach the coordinator (loopback stays loopback;
         # a cross-host connection yields this machine's routable IP, and the
         # cache binds all interfaces in that case)
         my_ip = store._rpc._sock.getsockname()[0]
         bind = "127.0.0.1" if my_ip.startswith("127.") else "0.0.0.0"
-        server = serve_cache(cache, host=bind)
+        server = serve_cache(cache, host=bind, hbq=hbq)
         store.set(f"worker_addr:{worker_id}", (my_ip, server.address[1]))
         # the coordinator merges individual keys into 'worker_addrs' itself
         store.heartbeat(worker_id)
-        w = Worker(spec, store, cache, worker_id, owned)
+        w = Worker(spec, store, cache, worker_id, owned, hbq=hbq)
         try:
             w.run_worker()
         finally:

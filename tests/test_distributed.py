@@ -105,6 +105,78 @@ class TestKill9Recovery:
         exp = q(QuokkaContext())
         pd.testing.assert_frame_equal(got, exp, check_dtype=False)
 
+    def test_kill_worker_private_spill_dirs(self, tmp_path):
+        """VERDICT r2 #4: recovery must not assume a shared spill disk.
+        Every worker spills post-partition objects into its own PRIVATE
+        subdir (multi-host discipline); checkpoints go to the checkpoint
+        STORE (standing in for the reference's S3 bucket, core.py:678-685).
+        A kill -9'd worker's spill is unreachable — the adopter must pull
+        surviving copies from live peers over the data plane or re-read
+        input lineage."""
+        import os
+
+        import pyarrow.parquet as pq
+
+        fact, dim = make_data(seed=7)
+        fp, dp = str(tmp_path / "fact.parquet"), str(tmp_path / "dim.parquet")
+        pq.write_table(fact, fp, row_group_size=1024)
+        pq.write_table(dim, dp)
+        spill = str(tmp_path / "spill")
+        ckpt_store = str(tmp_path / "ckpt_store")  # the "object store"
+
+        def q(ctx):
+            return (
+                ctx.read_parquet(fp)
+                .join(ctx.read_parquet(dp), on="k")
+                .groupby("grp")
+                .agg_sql("sum(v) as sv, count(*) as n")
+                .collect()
+                .sort_values("grp")
+                .reset_index(drop=True)
+            )
+
+        ctx = QuokkaContext(
+            cluster=LocalCluster(n_workers=2),
+            exec_config={
+                "fault_tolerance": True,
+                "checkpoint_interval": 2,
+                "hbq_path": spill,
+                "checkpoint_store": ckpt_store,
+                "inject_kill_worker": (1, 6),
+            },
+        )
+        # the run dir is wiped on completion: observe the spill layout WHILE
+        # the query runs
+        import threading
+
+        seen = set()
+        stop = threading.Event()
+
+        def watch():
+            while not stop.is_set():
+                for run in os.listdir(spill) if os.path.isdir(spill) else []:
+                    rd = os.path.join(spill, run)
+                    try:
+                        seen.update(os.listdir(rd))
+                    except OSError:
+                        pass
+                stop.wait(0.05)
+
+        th = threading.Thread(target=watch, daemon=True)
+        th.start()
+        try:
+            got = q(ctx)
+        finally:
+            stop.set()
+            th.join(timeout=5)
+        exp = q(QuokkaContext())
+        pd.testing.assert_frame_equal(got, exp, check_dtype=False)
+        # spills live ONLY in per-worker private dirs — nothing at the run's
+        # top level — and checkpoints went to the store
+        spilled = {e for e in seen if not e.startswith("ckpt-")}
+        assert spilled and all(e.startswith("worker-") for e in spilled), seen
+        assert any(f.startswith("ckpt-") for f in os.listdir(ckpt_store))
+
 
 class TestTPUPodCluster:
     def test_manager_brings_up_pod_and_runs_queries(self):

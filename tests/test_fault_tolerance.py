@@ -140,3 +140,62 @@ class TestHBQ:
         hbq.gc([name])
         assert not hbq.contains(name)
         assert hbq.get(name) is None
+
+
+class TestRewindPlanner:
+    """plan_rewinds (engine.py): need-driven checkpoint selection when a
+    consumer's tape references a CO-DEAD producer's outputs from before that
+    producer's latest checkpoint (reference: coordinator.py:221-229)."""
+
+    def _store(self):
+        from quokka_tpu.runtime.tables import ControlStore
+
+        return ControlStore()
+
+    def _ckpt(self, cs, a, ch, entries):
+        for e in entries:
+            cs.tappend("LT", ("ckpts", a, ch), e)
+        cs.tset("LCT", (a, ch), entries[-1])
+
+    def test_latest_checkpoint_when_producers_alive(self):
+        from quokka_tpu.runtime.engine import plan_rewinds
+
+        cs = self._store()
+        self._ckpt(cs, 3, 0, [(2, 5, 4), (4, 9, 8)])
+        # tape consumes only from actor 1 (NOT dead): no rewind needed
+        cs.tappend("LT", ("tape", 3, 0),
+                   ("exec", 1, [(1, 0, 9, 3, 1, 0)], True))
+        out = plan_rewinds(cs, [(3, 0)])
+        assert out[(3, 0)] == (4, 9, 8)
+
+    def test_codead_producer_rewinds_to_covering_checkpoint(self):
+        from quokka_tpu.runtime.engine import plan_rewinds
+
+        cs = self._store()
+        # producer (2,0): checkpoints at out_seq 5 and 9
+        self._ckpt(cs, 2, 0, [(2, 5, 4), (4, 9, 8)])
+        # consumer (3,0): no checkpoint; its tape (from pos 0) consumed
+        # producer output seq 6 — covered by (2,5,4) but not (4,9,8)
+        cs.tappend("LT", ("tape", 3, 0),
+                   ("exec", 2, [(2, 0, 6, 3, 2, 0)], True))
+        out = plan_rewinds(cs, [(2, 0), (3, 0)])
+        assert out[(3, 0)] == (0, 0, 0)
+        assert out[(2, 0)] == (2, 5, 4)
+
+    def test_transitive_rewind_to_state_zero(self):
+        from quokka_tpu.runtime.engine import plan_rewinds
+
+        cs = self._store()
+        self._ckpt(cs, 1, 0, [(3, 7, 6)])
+        self._ckpt(cs, 2, 0, [(2, 5, 4)])
+        # consumer (3,0) needs (2,0) seq 1 -> (2,0) rewinds to 0; the
+        # EXTENDED tape of (2,0) then needs (1,0) seq 2 -> (1,0) rewinds to 0
+        cs.tappend("LT", ("tape", 3, 0),
+                   ("exec", 2, [(2, 0, 1, 3, 2, 0)], True))
+        cs.tappend("LT", ("tape", 2, 0),
+                   ("exec", 1, [(1, 0, 2, 2, 1, 0)], True))
+        cs.tappend("LT", ("tape", 2, 0),
+                   ("exec", 1, [(1, 0, 8, 2, 1, 0)], True))
+        out = plan_rewinds(cs, [(1, 0), (2, 0), (3, 0)])
+        assert out[(2, 0)] == (0, 0, 0)
+        assert out[(1, 0)] == (0, 0, 0)
