@@ -98,6 +98,26 @@ def run_distributed(
     TPUPodCluster.worker_commands())."""
     from quokka_tpu.runtime.rpc import default_token
 
+    if (
+        external_workers > 0
+        and graph.hbq is not None
+        and graph.exec_config.get("checkpoint_interval")
+        and not graph.exec_config.get("checkpoint_store")
+    ):
+        # no checkpoint_interval -> nothing is ever written, recovery rewinds
+        # to state 0 via tape + peer-HBQ pulls and never reads the store, so
+        # that configuration stays legal cross-host
+        # cross-host adopters load checkpoints by name; a local default dir
+        # exists independently on every host, so recovery would read a
+        # different (empty) store than the writer's and die mid-adoption —
+        # mirror the reference's mandatory S3 checkpoint bucket
+        # (pyquokka/core.py:678-685) and refuse up front
+        raise ValueError(
+            "fault_tolerance with external (multi-host) workers requires "
+            'exec_config["checkpoint_store"] to name a store every host can '
+            "reach (an fsspec URL or shared mount); the per-host default "
+            f"checkpoint dir {graph.ckpt_dir!r} is not shared"
+        )
     # resolve (or mint) the cluster token BEFORE spawning workers so children
     # inherit it through the environment
     default_token()

@@ -53,6 +53,17 @@ from quokka_tpu.target_info import (
 )
 
 
+class LostObjectError(RuntimeError):
+    """A tape input that probed available vanished before the replay reached
+    it (e.g. the peer serving its HBQ copy died mid-replay).  Retryable: the
+    caller requeues the TapedExecutorTask and the next attempt rebuilds from
+    the checkpoint."""
+
+    def __init__(self, name):
+        super().__init__(f"lost object {name} vanished during replay")
+        self.name = name
+
+
 class ActorInfo:
     def __init__(self, actor_id, kind, channels, stage=0, sorted_actor=False,
                  channel_major=False):
@@ -838,44 +849,75 @@ class Engine:
             return bridge.arrow_to_device(table)
         return self._recompute_object(name)
 
+    def _hbq_contains(self, name: Tuple) -> bool:
+        """Listing-level probe; the distributed Worker overrides this to also
+        consult peer HBQ listings (no bytes move either way)."""
+        return self.g.hbq is not None and self.g.hbq.contains(name)
+
+    def _object_available(self, name: Tuple) -> bool:
+        """Existence probe WITHOUT materializing bytes: local cache hit, an
+        HBQ listing (local or a peer's), or an input-lineage recompute is
+        possible.  handle_exectape_task pre-flights the whole tape with this
+        so a rewind to (0,0,0) on a long-running channel doesn't hold the
+        channel's entire consumed history in device memory at once."""
+        if self.cache.get(name) is not None:
+            return True
+        if self._hbq_contains(name):
+            return True
+        src_a, src_ch, seq = name[0], name[1], name[2]
+        info = self.g.actors.get(src_a)
+        return (
+            info is not None
+            and info.kind == "input"
+            and self.store.tget("LT", (src_a, src_ch, seq)) is not None
+        )
+
     def handle_exectape_task(self, task: TapedExecutorTask) -> bool:
         """Run a queued tape replay: recreate the executor, restore the
         checkpoint named by task.state_seq, re-run the recorded event history,
         then requeue the channel as a live ExecutorTask plus a ReplayTask that
         refills its input cache from the HBQ spill.
 
-        All tape inputs are resolved BEFORE any event executes: a missing one
-        (its producer's own adoption/replay may not have re-pushed it yet)
-        requeues this task untouched instead of corrupting executor state
-        with a partial replay."""
+        Tape inputs are pre-flighted with EXISTENCE PROBES before any event
+        executes (a missing one — its producer's own adoption/replay may not
+        have re-pushed it yet — requeues this task untouched), then resolved
+        one event at a time inside _replay_tape so a rewind to (0,0,0) never
+        holds the channel's full consumed history in memory simultaneously.
+        A probe-then-vanish race (peer dies mid-replay) surfaces as
+        LostObjectError and requeues the same way: replay emissions are
+        seq-keyed and deterministic, so the retried replay overwrites its own
+        partial output rather than duplicating it."""
         a, ch = task.actor, task.channel
         reqs = {s: dict(c) for s, c in task.input_reqs.items()}
         tape = self.store.tape_slice(a, ch, task.tape_pos)
-        resolved: Dict[Tuple, DeviceBatch] = {}
+
+        def _requeue_waiting(name):
+            # time-based, not attempt-based: the co-dead producer's own
+            # replay (possibly from state 0 with a long tape) can
+            # legitimately take minutes to regenerate this object
+            deadline = getattr(task, "retry_deadline", None)
+            if deadline is None:
+                deadline = task.retry_deadline = time.time() + 600.0
+            if time.time() > deadline:
+                raise RuntimeError(
+                    f"tape input {name} for channel ({a},{ch}) is in "
+                    "no live HBQ and its producer never regenerated "
+                    "it within 600s — irrecoverable loss"
+                )
+            self.store.ntt_push(a, task)
+            time.sleep(0.05)
+            return False
+
+        probed = set()
         for ev in tape:
             if ev[0] != "exec":
                 continue
             for name in ev[2]:
-                if name in resolved:
+                if name in probed:
                     continue
-                b = self._resolve_lost_object(name)
-                if b is None:
-                    # time-based, not attempt-based: the co-dead producer's
-                    # own replay (possibly from state 0 with a long tape) can
-                    # legitimately take minutes to regenerate this object
-                    deadline = getattr(task, "retry_deadline", None)
-                    if deadline is None:
-                        deadline = task.retry_deadline = time.time() + 600.0
-                    if time.time() > deadline:
-                        raise RuntimeError(
-                            f"tape input {name} for channel ({a},{ch}) is in "
-                            "no live HBQ and its producer never regenerated "
-                            "it within 600s — irrecoverable loss"
-                        )
-                    self.store.ntt_push(a, task)
-                    time.sleep(0.05)
-                    return False
-                resolved[name] = b
+                if not self._object_available(name):
+                    return _requeue_waiting(name)
+                probed.add(name)
         self.execs[(a, ch)] = self.g.actors[a].executor_factory()
         blob = self._ckpt_store().load(a, ch, task.state_seq)
         if blob is not None:
@@ -885,9 +927,13 @@ class Engine:
                 f"checkpoint for ({a},{ch}) state {task.state_seq} named by "
                 "LCT is missing from the checkpoint store — cannot rebuild"
             )
-        state_seq, out_seq = self._replay_tape(
-            a, ch, tape, reqs, task.state_seq, task.out_seq, resolved
-        )
+        try:
+            state_seq, out_seq = self._replay_tape(
+                a, ch, tape, reqs, task.state_seq, task.out_seq
+            )
+        except LostObjectError as e:
+            self.execs.pop((a, ch), None)  # discard the partial rebuild
+            return _requeue_waiting(e.name)
         # replay-complete check: the tape must advance the state exactly to
         # where the coordinator said the channel was when it queued this task
         assert state_seq == task.last_state_seq, (
@@ -932,24 +978,22 @@ class Engine:
         return True
 
     def _replay_tape(self, actor: int, ch: int, events, reqs,
-                     state_seq: int, out_seq: int, resolved=None):
+                     state_seq: int, out_seq: int):
         """Re-run the recorded event history: identical inputs in identical
         order reproduce identical outputs at identical seqs (so downstream
         consumers — which may already hold some of them — stay consistent).
-        `resolved` maps pre-fetched object names to batches
-        (handle_exectape_task resolves the whole tape up front)."""
+        Inputs resolve LAZILY, one event at a time — probed available by the
+        caller, but never all materialized at once."""
         info = self.g.actors[actor]
         executor = self.execs[(actor, ch)]
-        resolved = resolved or {}
         for ev in events:
             if ev[0] == "exec":
                 _, src_actor, names, emitted = ev
                 batches = []
                 for name in names:
-                    b = resolved.get(name)
+                    b = self._resolve_lost_object(name)
                     if b is None:
-                        b = self._resolve_lost_object(name)
-                        assert b is not None, f"lost object {name} not in any HBQ"
+                        raise LostObjectError(name)
                     batches.append(b)
                 out = executor.execute(batches, info.source_streams[src_actor], ch)
                 re_emitted = out is not None

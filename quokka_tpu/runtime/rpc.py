@@ -139,9 +139,14 @@ class _Handler(socketserver.BaseRequestHandler):
         target = self.server.target  # type: ignore[attr-defined]
         token = self.server.token  # type: ignore[attr-defined]
         try:
+            # a silent peer (port scanner, half-open connect) must not pin
+            # this handler thread forever waiting on the handshake reply
+            self.request.settimeout(10.0)
             if not _server_handshake(self.request, token):
                 return  # unauthenticated peer: no pickle is ever read
-        except (ConnectionError, OSError):
+            # authenticated: long-poll RPCs may legitimately idle far longer
+            self.request.settimeout(None)
+        except (ConnectionError, OSError, socket.timeout):
             return
         while True:
             try:

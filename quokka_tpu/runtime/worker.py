@@ -193,6 +193,11 @@ class Worker(Engine):
         names.update(self._hbq_holders((tgt_actor, tgt_ch)))
         return sorted(names)
 
+    def _hbq_contains(self, name):
+        if self.g.hbq is not None and self.g.hbq.contains(name):
+            return True
+        return tuple(name) in self._hbq_holders((name[3], name[5]))
+
     def _hbq_fetch(self, name):
         table = self.g.hbq.get(name)
         if table is not None:
@@ -370,13 +375,24 @@ def _serve_one_session(addr, worker_id: int, join_timeout: float,
             spec_bytes = store.get("spec")
             owned = store.get(("owned", worker_id))
             if spec_bytes is not None and owned is not None:
+                if sid is None:
+                    # session_id is published BEFORE spec (run_distributed),
+                    # so it is guaranteed visible once spec is — this re-read
+                    # closes the sid-then-spec interleave that would
+                    # otherwise run a session without recording it in
+                    # `served` (split-brain on crash-and-reconnect)
+                    sid = store.get("session_id")
+                    if served is not None and sid in served:
+                        return False
                 break
             time.sleep(0.2)
     finally:
         store.close()
     if spec_bytes is None or owned is None:
         return False
-    if served is not None and sid is not None:
+    if served is not None:
+        if sid is None:
+            return False  # store never published a session id: do not run
         served.add(sid)
     worker_main(spec_bytes, addr, worker_id, owned)
     return True

@@ -214,8 +214,11 @@ class QuokkaClusterManager:
                 target = f"{self.ssh_user}@{host}" if self.ssh_user else host
                 subprocess.run(
                     ["ssh", *self.ssh_options, target,
+                     # token boundary ( |$) so stopping worker 1 never
+                     # matches 10-19 when one host runs several daemons
+                     # (--persist may follow the id)
                      "pkill -f 'quokka_tpu.runtime.worker.*--worker-id "
-                     f"{k}' || true"],
+                     f"{k}( |$)' || true"],
                     check=False,
                 )
 
