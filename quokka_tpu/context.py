@@ -9,6 +9,7 @@ cluster deployments swap the TaskGraph's store/cache for served ones.
 from __future__ import annotations
 
 import copy
+import logging
 from typing import Dict, List, Optional
 
 import pyarrow as pa
@@ -22,6 +23,8 @@ from quokka_tpu.dataset.readers import (
     InputParquetDataset,
 )
 from quokka_tpu.runtime.engine import TaskGraph
+
+_log = logging.getLogger("quokka_tpu.mesh")
 
 
 def _contains_agg(e) -> bool:
@@ -46,6 +49,9 @@ class QuokkaContext:
         # jax.sharding.Mesh: run supported plans SPMD with channels == shards
         # (parallel/mesh_exec.py); unsupported plans fall back to the engine
         self.mesh = mesh
+        # reason string for the most recent mesh->engine fallback (None when
+        # the last collect ran on the mesh); also logged as a warning
+        self.last_mesh_fallback = None
         self.io_channels = io_channels
         self.exec_channels = exec_channels
         self.exec_config = dict(config.DEFAULT_EXEC_CONFIG)
@@ -298,9 +304,16 @@ class QuokkaContext:
                 table = MeshExecutor(self.mesh).run_to_arrow(sub, sink_id)
                 ds = ResultDataset()
                 ds.append(0, table)
+                self.last_mesh_fallback = None
                 return ds
-            except MeshUnsupported:
-                pass  # plan shape not covered: embedded engine below
+            except MeshUnsupported as e:
+                # plan shape not covered: embedded engine below — LOUDLY
+                # (the mesh is an explicit user request; a silent single-
+                # device downgrade would misrepresent what ran)
+                self.last_mesh_fallback = str(e)
+                _log.warning(
+                    "mesh execution fell back to the embedded engine: %s", e
+                )
         self._assign_stages(sub, sink_id)
         graph = TaskGraph(self.exec_config)
         actor_of: Dict[int, int] = {}

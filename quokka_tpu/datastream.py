@@ -661,14 +661,17 @@ class OrderedStream(DataStream):
             parts = {0: HashPartitioner(left_by), 1: HashPartitioner(right_by)}
         else:
             parts = {0: PassThroughPartitioner(), 1: PassThroughPartitioner()}
-        node = logical.StatefulNode(
+        node = logical.AsofJoinNode(
             [self.node_id, right.node_id],
             out_schema,
-            functools.partial(SortedAsofExecutor, 
+            functools.partial(SortedAsofExecutor,
                 left_on, right_on, left_by, right_by, suffix, direction=direction
             ),
-            partitioners=parts,
-            sorted_output=[left_on],
+            parts,
+            [left_on],
+            left_on=left_on, right_on=right_on,
+            left_by=left_by, right_by=right_by,
+            suffix=suffix, direction=direction,
         )
         nid = self.ctx.add_node(node)
         return OrderedStream(self.ctx, nid)
@@ -706,12 +709,13 @@ class OrderedStream(DataStream):
         else:
             out_schema = by + extra + [n for n, _ in plan.finals]
             out_sorted = [extra[0]]  # windows emit ordered by their start
-        node = logical.StatefulNode(
+        node = logical.WindowAggNode(
             [self.node_id],
             out_schema,
             factory,
-            partitioners={0: HashPartitioner(by) if by else PassThroughPartitioner()},
-            sorted_output=out_sorted,
+            {0: HashPartitioner(by) if by else PassThroughPartitioner()},
+            out_sorted,
+            time_col=time_col, by=by, window=window, plan=plan, trigger=trigger,
         )
         nid = self.ctx.add_node(node)
         return OrderedStream(self.ctx, nid)

@@ -87,20 +87,46 @@ class _TimeRebase:
         if jnp.issubdtype(col.data.dtype, jnp.floating):
             return batch
         if self._tbase is None:
-            # The base is fixed by the FIRST batch — including a narrow one
-            # (base 0, passthrough).  A later wide batch then rebases against
-            # base 0 and raises cleanly instead of silently mixing absolute
-            # and rebased window coordinates in one executor state.
-            if col.hi is None:
+            # The base is fixed by the FIRST batch — including a narrow-int32
+            # one (base 0, passthrough).  A later wide batch then rebases
+            # against base 0 and raises cleanly instead of silently mixing
+            # absolute and rebased window coordinates in one executor state.
+            # Narrow int64 (x64 mode) keeps absolute coordinates while they
+            # fit int32 window arithmetic (parity with the non-x64 narrow
+            # path) and rebases like wide when they don't (ns epochs — the
+            # downstream ``wid.astype(int32)`` would overflow).
+            if col.hi is None and col.data.dtype != jnp.int64:
                 self._tbase = 0
             else:
-                vals = timewide.host_i64(col, batch.valid)
-                mn = int(vals.min()) if len(vals) else 0
-                align = max(1, int(align))
-                self._tbase = ((mn - 2**29) // align) * align
+                if batch.count_valid():
+                    mn = timewide.host_min_i64(col, batch.valid)
+                    mx = timewide.host_max_i64(col, batch.valid)
+                else:
+                    mn = mx = 0
+                if (
+                    col.hi is None
+                    and mn > -(2**31)
+                    and mx < 2**31 - 1 - headroom
+                ):
+                    self._tbase = 0
+                else:
+                    align = max(1, int(align))
+                    self._tbase = ((mn - 2**29) // align) * align
             self._t_kind = col.kind
             self._t_unit = col.unit
         if self._tbase == 0 and col.hi is None:
+            if col.data.dtype == jnp.int64 and batch.count_valid():
+                # absolute-coordinate mode was fixed by the first batch:
+                # verify every later batch still fits int32 instead of
+                # silently overflowing downstream casts
+                mx = timewide.host_max_i64(col, batch.valid)
+                mn = timewide.host_min_i64(col, batch.valid)
+                if mn <= -(2**31) or mx >= 2**31 - 1 - headroom:
+                    raise ValueError(
+                        "time column left the int32 window range fixed by "
+                        "the stream's first batch; cast to a coarser unit "
+                        "(e.g. ms/s)"
+                    )
             return batch  # narrow stream: absolute int32 coordinates as-is
         rel = timewide.rebase_narrow(col, batch.valid, self._tbase, headroom)
         return batch.with_column(col_name, rel)

@@ -219,6 +219,51 @@ class StatefulNode(Node):
         return "Stateful"
 
 
+class AsofJoinNode(StatefulNode):
+    """As-of join (OrderedStream.join_asof).  A StatefulNode for the engine
+    path (SortedAsofExecutor does streaming frontier matching), but carries
+    the join parameters so the mesh path can run it as one shard_map program
+    (hash-shuffle both sides by the `by` keys over ICI, then the
+    data-parallel sort+scan asof kernel per shard — parallel/mesh_exec.
+    mesh_asof).  Reference: pyquokka/orderedstream.py:37 join_asof."""
+
+    def __init__(self, parents, schema, executor_factory, partitioners,
+                 sorted_output, *, left_on, right_on, left_by, right_by,
+                 suffix, direction):
+        super().__init__(parents, schema, executor_factory, partitioners,
+                         sorted_output)
+        self.left_on = left_on
+        self.right_on = right_on
+        self.left_by = list(left_by)
+        self.right_by = list(right_by)
+        self.suffix = suffix
+        self.direction = direction
+
+    def describe(self):
+        return f"AsofJoin({self.direction} on {self.left_on})"
+
+
+class WindowAggNode(StatefulNode):
+    """Window aggregation (OrderedStream.window_agg).  A StatefulNode for the
+    streaming engine path, carrying window parameters so the mesh path can
+    run tumbling/hopping windows as a window-id group-by in one shard_map
+    (parallel/mesh_exec.mesh_window_agg).  Reference: pyquokka/datastream.py
+    windowed_transform + windowtypes compilation."""
+
+    def __init__(self, parents, schema, executor_factory, partitioners,
+                 sorted_output, *, time_col, by, window, plan, trigger):
+        super().__init__(parents, schema, executor_factory, partitioners,
+                         sorted_output)
+        self.time_col = time_col
+        self.by = list(by)
+        self.window = window
+        self.plan = plan
+        self.trigger = trigger
+
+    def describe(self):
+        return f"WindowAgg({type(self.window).__name__})"
+
+
 class JoinNode(Node):
     """Binary hash join; parents[0] = probe (stream 0), parents[1] = build."""
 

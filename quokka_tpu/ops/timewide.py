@@ -42,6 +42,13 @@ def widen_limbs(col: NumCol) -> Tuple[jax.Array, jax.Array]:
     d = col.data
     if jnp.issubdtype(d.dtype, jnp.floating):
         raise TypeError("widen_limbs on float column")
+    if d.dtype == jnp.int64:
+        # narrow int64 storage (x64 mode): split exactly — the old int32
+        # cast silently truncated ns-epoch timestamps
+        hi = (d >> jnp.int64(32)).astype(jnp.int32)
+        lo_u = (d & jnp.int64(0xFFFFFFFF)).astype(jnp.uint32)
+        lo = _bitcast(lo_u ^ _SIGN, jnp.int32)
+        return hi, lo
     d = d.astype(jnp.int32)
     hi = jnp.where(d < 0, jnp.int32(-1), jnp.int32(0))
     lo = _bitcast(_bitcast(d, jnp.uint32) ^ _SIGN, jnp.int32)

@@ -28,6 +28,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 import numpy as np
 import pyarrow as pa
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -297,6 +298,232 @@ def mesh_join(
 
 
 # ---------------------------------------------------------------------------
+# mesh asof join (shuffle both sides by `by` keys -> per-shard sort+scan)
+# ---------------------------------------------------------------------------
+
+
+def _side_time_limbs(col, other, direction: str) -> List[jax.Array]:
+    """Per-side time arrays for the asof kernel, widened consistently with
+    the OTHER side (mixed wide/narrow int pairs widen both — same rule as
+    ops/asof.asof_join)."""
+    from quokka_tpu.ops import timewide
+
+    if col.hi is not None or other.hi is not None:
+        limbs = timewide.widen_limbs(col)
+        if direction == "forward":
+            limbs = timewide.not_limbs(limbs)
+        return list(limbs)
+    d = col.data
+    return [-d] if direction == "forward" else [d]
+
+
+def mesh_asof(
+    mesh: Mesh,
+    axis: str,
+    trades: DeviceBatch,
+    quotes: DeviceBatch,
+    left_on: str,
+    right_on: str,
+    left_by: List[str],
+    right_by: List[str],
+    payload: List[str],
+    direction: str,
+) -> DeviceBatch:
+    """As-of join over the mesh: both sides key-shuffled by the `by` columns
+    with one all_to_all each (equal-key groups land whole on one shard), then
+    the embedded engine's data-parallel sort+scan asof kernel
+    (ops/asof._asof_match) per shard.  Unmatched trades are dropped — the
+    same default as the streaming SortedAsofExecutor (keep_unmatched=False,
+    executors/ts_execs.py:210).
+
+    The reference reaches the same layout by hash-partitioning channels on
+    the symbol key and walking frontiers per channel
+    (pyquokka/executors/ts_executors.py:324-383); here the per-shard match is
+    one sort + one log-depth associative scan — no sequential walk."""
+    from quokka_tpu.ops.asof import _asof_match
+
+    if not left_by:
+        raise MeshUnsupported("by-less asof join on mesh (no shuffle key)")
+    tl = key_limbs(trades, left_by)
+    ql = key_limbs(quotes, right_by)
+    if len(tl) != len(ql):
+        raise MeshUnsupported("asof by-key column types differ")
+    nlimb = len(tl)
+    tc, qc = trades.columns[left_on], quotes.columns[right_on]
+    t_times = _side_time_limbs(tc, qc, direction)
+    q_times = _side_time_limbs(qc, tc, direction)
+    ntime = len(t_times)
+    t_carry, t_slices = _flatten_cols(trades, trades.names)
+    q_carry, q_slices = _flatten_cols(quotes, payload)
+    ntc, nqc = len(t_carry), len(q_carry)
+    # carried-array positions of the trade time column: the per-shard output
+    # re-sorts on these raw (un-negated) limbs so each shard stays ascending
+    # in time — the OrderedStream contract the streaming executor keeps per
+    # channel (shard == channel)
+    t_time_lo, t_time_hi = next(
+        (lo, hi) for (name, lo, hi) in t_slices if name == left_on
+    )
+
+    def step(*arrs):
+        i = 0
+        tlimbs = arrs[i:i + nlimb]; i += nlimb
+        tt = arrs[i:i + ntime]; i += ntime
+        tcar = arrs[i:i + ntc]; i += ntc
+        tvalid = arrs[i]; i += 1
+        qlimbs = arrs[i:i + nlimb]; i += nlimb
+        qt = arrs[i:i + ntime]; i += ntime
+        qcar = arrs[i:i + nqc]; i += nqc
+        qvalid = arrs[i]
+        ts, tv = collective_hash_shuffle(
+            tlimbs + tt + tcar, tvalid, tuple(range(nlimb)), axis
+        )
+        qs, qv = collective_hash_shuffle(
+            qlimbs + qt + qcar, qvalid, tuple(range(nlimb)), axis
+        )
+        stl, stt, stc = ts[:nlimb], ts[nlimb:nlimb + ntime], ts[nlimb + ntime:]
+        sql, sqt, sqc = qs[:nlimb], qs[nlimb:nlimb + ntime], qs[nlimb + ntime:]
+        p = tv.shape[0]
+        limbs = tuple(
+            jnp.concatenate([a, b.astype(a.dtype)]) for a, b in zip(stl, sql)
+        )
+        times = tuple(
+            jnp.concatenate([a, b.astype(a.dtype)]) for a, b in zip(stt, sqt)
+        )
+        is_trade = jnp.concatenate(
+            [jnp.ones(p, dtype=bool), jnp.zeros(qv.shape[0], dtype=bool)]
+        )
+        valid = jnp.concatenate([tv, qv])
+        match_orig, matched = _asof_match(limbs, times, is_trade, valid, p)
+        quote_idx = jnp.clip(match_orig - p, 0, qv.shape[0] - 1)
+        pay = tuple(c[quote_idx] for c in sqc)
+        # drop unmatched (SortedAsofExecutor's keep_unmatched=False default)
+        # and restore per-shard time order, invalid rows last
+        ovalid = tv & matched
+        out_cols = stc + pay
+        iota = jnp.arange(p, dtype=jnp.int32)
+        inv = (~ovalid).astype(jnp.int32)
+        tkeys = list(stc[t_time_lo:t_time_hi])
+        sorted_ = lax.sort([inv, *tkeys, iota], num_keys=1 + len(tkeys))
+        perm = sorted_[-1]
+        return tuple(c[perm] for c in out_cols) + (sorted_[0] == 0,)
+
+    fn = jax.jit(
+        jax.shard_map(step, mesh=mesh, in_specs=P(axis), out_specs=P(axis),
+                      check_vma=False)
+    )
+    outs = fn(*tl, *t_times, *t_carry, trades.valid,
+              *ql, *q_times, *q_carry, quotes.valid)
+    stc = outs[:ntc]
+    pay = outs[ntc:ntc + nqc]
+    ovalid = outs[-1]
+    cols = {}
+    for name, lo, hi in t_slices:
+        cols[name] = _rebuild_col(trades.columns[name], list(stc[lo:hi]))
+    out = DeviceBatch(cols, ovalid, None, None)
+    for name, lo, hi in q_slices:
+        col = _rebuild_col(quotes.columns[name], list(pay[lo:hi]))
+        out = out.with_column(name, col)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# mesh window aggregation (window-id group-by in one shard_map)
+# ---------------------------------------------------------------------------
+
+
+def mesh_window_agg(
+    mesh: Mesh,
+    axis: str,
+    batch: DeviceBatch,
+    by: List[str],
+    time_data: jax.Array,
+    size: int,
+    hop: int,
+    partials: List[Tuple[str, str, Optional[str]]],
+    recombine_ops: List[str],
+) -> DeviceBatch:
+    """Tumbling/hopping window aggregation over the mesh.  In a (bounded)
+    batch execution a time window is just a computed group key: each row is
+    replicated size//hop times onto its covering window ids INSIDE the
+    shard_map (static factor), locally partial-aggregated, key-shuffled by
+    (by..., window id) over ICI, and final-aggregated per shard — the same
+    partial->shuffle->final discipline as mesh_groupby.  The streaming
+    engine's HoppingWindowExecutor (executors/ts_execs.py:372-430) emits
+    identical windows incrementally via watermarks; triggers only change
+    WHEN windows emit, not their content, so the batch result matches both.
+    Returns groups carrying by-columns + "__wid" + partial outputs."""
+    k = max(1, size // hop)
+    limbs = key_limbs(batch, by) if by else []
+    nlimb = len(limbs)
+    carried, slices = _flatten_cols(batch, by)
+    ncarry = len(carried)
+    vals = [
+        batch.columns[c].data if c is not None
+        else jnp.zeros(batch.padded_len, jnp.int32)
+        for (_, _, c) in partials
+    ]
+    pops = tuple(op for (_, op, _) in partials)
+    rops = tuple(recombine_ops)
+
+    def step(*arrs):
+        lb = arrs[:nlimb]
+        t = arrs[nlimb]
+        ca = arrs[nlimb + 1:nlimb + 1 + ncarry]
+        va = arrs[nlimb + 1 + ncarry:-1]
+        valid = arrs[-1]
+        # replicate onto the k covering windows (same mask expression as
+        # HoppingWindowExecutor._assign_windows)
+        wids, oks = [], []
+        for j in range(k):
+            wid = t // hop - j
+            ok = valid & (wid >= 0) & (t < (wid * hop + size)) & (t >= wid * hop)
+            wids.append(wid.astype(jnp.int32))
+            oks.append(ok)
+        wid = jnp.concatenate(wids)
+        rvalid = jnp.concatenate(oks)
+        rep = lambda xs: tuple(jnp.concatenate([x] * k) for x in xs)  # noqa: E731
+        rlb = rep(lb) + (wid,)
+        rca = rep(ca)
+        rva = rep(va)
+        n = rvalid.shape[0]
+        pouts, _, grep, num = kernels.sorted_groupby(rlb, rva, pops, rvalid)
+        glimbs = tuple(l[grep] for l in rlb)
+        gcarry = tuple(c[grep] for c in rca)
+        gvalid = jnp.arange(n) < num
+        cols = glimbs + gcarry + tuple(pouts)
+        shuf, svalid = collective_hash_shuffle(
+            cols, gvalid, tuple(range(nlimb + 1)), axis
+        )
+        slb = shuf[:nlimb + 1]
+        sca = shuf[nlimb + 1:nlimb + 1 + ncarry]
+        sva = shuf[nlimb + 1 + ncarry:]
+        fouts, _, rep2, num2 = kernels.sorted_groupby(slb, sva, rops, svalid)
+        fcarry = tuple(c[rep2] for c in sca)
+        fwid = slb[nlimb][rep2]
+        fvalid = jnp.arange(svalid.shape[0]) < num2
+        return fcarry + (fwid,) + tuple(fouts) + (fvalid,)
+
+    fn = jax.jit(
+        jax.shard_map(step, mesh=mesh, in_specs=P(axis), out_specs=P(axis),
+                      check_vma=False)
+    )
+    outs = fn(*limbs, time_data, *carried, *vals, batch.valid)
+    fcarry = outs[:ncarry]
+    fwid = outs[ncarry]
+    fvals = outs[ncarry + 1:-1]
+    fvalid = outs[-1]
+    cols = {}
+    for name, lo, hi in slices:
+        cols[name] = _rebuild_col(batch.columns[name], list(fcarry[lo:hi]))
+    cols["__wid"] = NumCol(fwid, "i")
+    for (pname, _, _), arr in zip(partials, fvals):
+        cols[pname] = NumCol(
+            arr, "f" if jnp.issubdtype(arr.dtype, jnp.floating) else "i"
+        )
+    return DeviceBatch(cols, fvalid, None, None)
+
+
+# ---------------------------------------------------------------------------
 # plan walker
 # ---------------------------------------------------------------------------
 
@@ -310,15 +537,42 @@ class MeshExecutor:
         logical.SourceNode, logical.FilterNode, logical.ProjectionNode,
         logical.MapNode, logical.DistinctNode, logical.AggNode,
         logical.JoinNode, logical.SortNode, logical.TopKNode, logical.SinkNode,
+        logical.AsofJoinNode, logical.WindowAggNode,
     )
+    MAX_WINDOW_REPLICATION = 16
 
     def run_to_arrow(self, sub: Dict[int, logical.Node], sink_id: int) -> pa.Table:
         # pre-walk node TYPES so unsupported plans fall back before any work
         # runs (data-dependent bailouts like a non-unique join build side can
         # still abort mid-run and re-execute on the engine — unavoidable)
+        from quokka_tpu import windows as W
+
         for node in sub.values():
             if not isinstance(node, self.SUPPORTED):
                 raise MeshUnsupported(f"node {type(node).__name__} on mesh")
+            if isinstance(node, logical.AsofJoinNode) and not node.left_by:
+                raise MeshUnsupported("by-less asof join on mesh")
+            if isinstance(node, logical.WindowAggNode):
+                if not isinstance(
+                    node.window, (W.TumblingWindow, W.HoppingWindow)
+                ):
+                    raise MeshUnsupported(
+                        f"{type(node.window).__name__} on mesh"
+                    )
+                hop = (
+                    node.window.size
+                    if isinstance(node.window, W.TumblingWindow)
+                    else node.window.hop
+                )
+                # the replication factor is a STATIC in-program blowup of the
+                # whole sharded dataset (the streaming executor pays it only
+                # per bounded batch) — cap it and let the engine take
+                # fine-hopped windows
+                if node.window.size // max(1, hop) > self.MAX_WINDOW_REPLICATION:
+                    raise MeshUnsupported(
+                        f"hopping replication factor {node.window.size // hop} "
+                        f"> {self.MAX_WINDOW_REPLICATION} on mesh"
+                    )
             if isinstance(node, logical.JoinNode) and node.how not in (
                 "inner", "left", "semi", "anti"
             ):
@@ -362,6 +616,10 @@ class MeshExecutor:
             return self._compact_reshard(g.select(list(node.keys)))
         if isinstance(node, logical.AggNode):
             return self._agg(sub, node)
+        if isinstance(node, logical.AsofJoinNode):
+            return self._asof(sub, node)
+        if isinstance(node, logical.WindowAggNode):
+            return self._window(sub, node)
         if isinstance(node, logical.JoinNode):
             return self._join(sub, node)
         if isinstance(node, (logical.SortNode, logical.TopKNode)):
@@ -434,6 +692,91 @@ class MeshExecutor:
         if not parts:
             raise MeshUnsupported("aggregation produced no output")
         return parts[0] if len(parts) == 1 else bridge.concat_batches(parts)
+
+    def _asof(self, sub, node: logical.AsofJoinNode) -> DeviceBatch:
+        trades = self._exec(sub, node.parents[0])
+        quotes = self._exec(sub, node.parents[1])
+        # payload naming mirrors OrderedStream.join_asof: quote columns other
+        # than the by-keys and the time key, suffixed on collision
+        rpayload = [
+            c for c in quotes.names
+            if c not in set(node.right_by) and c != node.right_on
+        ]
+        rename = {
+            c: c + node.suffix for c in rpayload if c in set(trades.names)
+        }
+        if rename:
+            quotes = quotes.rename(rename)
+            rpayload = [rename.get(c, c) for c in rpayload]
+        out = mesh_asof(
+            self.mesh, self.axis, trades, quotes, node.left_on, node.right_on,
+            list(node.left_by), list(node.right_by), rpayload, node.direction,
+        )
+        out = out.select([c for c in node.schema if c in out.columns])
+        return self._compact_reshard(out)
+
+    def _window(self, sub, node: logical.WindowAggNode) -> DeviceBatch:
+        from quokka_tpu import windows as W
+        from quokka_tpu.ops import timewide
+
+        b = self._exec(sub, node.parents[0])
+        plan = node.plan
+        for name, e in plan.pre:
+            b = b.with_column(name, evaluate_to_column(e, b))
+        win = node.window
+        size = win.size
+        hop = size if isinstance(win, W.TumblingWindow) else win.hop
+        col = b.columns[node.time_col]
+        if jnp.issubdtype(col.data.dtype, jnp.floating):
+            raise MeshUnsupported("float time column in mesh window")
+        t_kind, t_unit = col.kind, col.unit
+        tbase = 0
+        headroom = size + hop
+        need_rebase = col.hi is not None
+        mn = 0
+        if (need_rebase or col.data.dtype == jnp.int64) and b.count_valid():
+            mn = timewide.host_min_i64(col, b.valid)
+            if not need_rebase:
+                mx = timewide.host_max_i64(col, b.valid)
+                # narrow int64 keeps absolute coordinates while they fit
+                # int32 window arithmetic (parity with _TimeRebase)
+                need_rebase = mn <= -(2**31) or mx >= 2**31 - 1 - headroom
+        if need_rebase:
+            # same exact int32 rebase discipline as the streaming executors
+            # (_TimeRebase): base aligned to the hop so absolute window
+            # boundaries stay epoch-aligned.  Two device reductions + two
+            # scalar transfers — never a full-column host gather.
+            align = max(1, int(hop))
+            tbase = ((mn - 2**29) // align) * align
+            col = timewide.rebase_narrow(col, b.valid, tbase,
+                                         headroom=headroom)
+        partials = [(p, op, tmp) for (p, op, tmp) in plan.partials]
+        recombine = [op for (_, op) in plan.recombine]
+        g = mesh_window_agg(
+            self.mesh, self.axis, b, list(node.by), col.data, size, hop,
+            partials, recombine,
+        )
+        # window bounds + finals on the (small) materialized group set
+        host = _materialize(g)
+        start = host.columns["__wid"].data * hop
+        host = host.with_column(
+            "window_start", timewide.add_base(start, tbase, t_kind, t_unit)
+        )
+        host = host.with_column(
+            "window_end", timewide.add_base(start + size, tbase, t_kind, t_unit)
+        )
+        for name, e in plan.finals:
+            host = host.with_column(name, evaluate_to_column(e, host))
+        seen, out_cols = set(), []
+        for c in node.by + ["window_start", "window_end"] + [
+            n for n, _ in plan.finals
+        ]:
+            if c not in seen:
+                seen.add(c)
+                out_cols.append(c)
+        # honor the node's declared sorted_output (windows emit ordered by
+        # their start — same contract as the streaming executors)
+        return kernels.sort_batch(host.select(out_cols), ["window_start"], [False])
 
     def _join(self, sub, node: logical.JoinNode) -> DeviceBatch:
         probe = self._exec(sub, node.parents[0])

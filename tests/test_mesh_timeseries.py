@@ -1,0 +1,275 @@
+"""Time-series tier on the mesh: asof joins and tumbling/hopping windows run
+SPMD (hash-shuffle by symbol over all_to_all, per-shard sort+scan kernels —
+parallel/mesh_exec.mesh_asof / mesh_window_agg) and must equal the embedded
+engine's streaming executors.  Session/sliding windows and by-less asof fall
+back to the engine — LOUDLY (ctx.last_mesh_fallback records why)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from quokka_tpu import QuokkaContext
+from quokka_tpu.parallel.mesh import make_mesh
+from quokka_tpu.windows import HoppingWindow, SessionWindow, TumblingWindow
+
+from test_timeseries import make_ticks
+
+
+@pytest.fixture(scope="module")
+def ticks(tmp_path_factory):
+    import pyarrow.parquet as pq
+
+    root = tmp_path_factory.mktemp("mesh_ticks")
+    trades, quotes = make_ticks()
+    tp, qp = str(root / "trades.parquet"), str(root / "quotes.parquet")
+    pq.write_table(trades, tp, row_group_size=512)
+    pq.write_table(quotes, qp, row_group_size=512)
+    return tp, qp, trades.to_pandas(), quotes.to_pandas()
+
+
+def _contexts():
+    return QuokkaContext(), QuokkaContext(mesh=make_mesh(8))
+
+
+def _streams(ctx, tp, qp):
+    t = ctx.read_sorted_parquet(tp, sorted_by="time")
+    q = ctx.read_sorted_parquet(qp, sorted_by="time")
+    return t, q
+
+
+def _norm(df, keys):
+    return df.sort_values(keys).reset_index(drop=True)
+
+
+class TestMeshAsof:
+    @pytest.mark.parametrize("direction", ["backward", "forward"])
+    def test_asof_matches_engine(self, ticks, direction):
+        tp, qp, tdf, qdf = ticks
+        plain, mesh = _contexts()
+        t, q = _streams(plain, tp, qp)
+        exp = t.join_asof(q, on="time", by="symbol", direction=direction).collect()
+        t, q = _streams(mesh, tp, qp)
+        got = t.join_asof(q, on="time", by="symbol", direction=direction).collect()
+        assert mesh.last_mesh_fallback is None, mesh.last_mesh_fallback
+        keys = ["symbol", "time", "size"]
+        exp, got = _norm(exp, keys), _norm(got, keys)
+        assert list(got.columns) == list(exp.columns)
+        pd.testing.assert_frame_equal(got, exp, check_dtype=False)
+
+    def test_asof_then_agg(self, ticks):
+        tp, qp, tdf, qdf = ticks
+        plain, mesh = _contexts()
+
+        def agg(ctx):
+            t, q = _streams(ctx, tp, qp)
+            return (
+                t.join_asof(q, on="time", by="symbol")
+                .groupby("symbol")
+                .agg_sql("sum(size) as total_size, count(*) as n")
+                .collect()
+            )
+
+        exp = agg(plain)
+        got = agg(mesh)
+        assert mesh.last_mesh_fallback is None, mesh.last_mesh_fallback
+        pd.testing.assert_frame_equal(
+            _norm(got, ["symbol"]), _norm(exp, ["symbol"]), check_dtype=False
+        )
+
+    def test_byless_asof_falls_back_loudly(self, ticks):
+        tp, qp, tdf, qdf = ticks
+        plain, mesh = _contexts()
+        t, q = _streams(plain, tp, qp)
+        exp = t.join_asof(q, on="time").collect()
+        t, q = _streams(mesh, tp, qp)
+        got = t.join_asof(q, on="time").collect()
+        assert mesh.last_mesh_fallback is not None
+        assert "asof" in mesh.last_mesh_fallback
+        keys = ["time", "size"]
+        pd.testing.assert_frame_equal(
+            _norm(got, keys), _norm(exp, keys), check_dtype=False
+        )
+
+
+class TestMeshWindows:
+    def test_tumbling_matches_engine(self, ticks):
+        tp, qp, tdf, qdf = ticks
+        plain, mesh = _contexts()
+        t, _ = _streams(plain, tp, qp)
+        exp = t.window_agg(
+            TumblingWindow(10_000),
+            "sum(size) as total, count(*) as n, avg(size) as mean_sz",
+            by="symbol",
+        ).collect()
+        t, _ = _streams(mesh, tp, qp)
+        got = t.window_agg(
+            TumblingWindow(10_000),
+            "sum(size) as total, count(*) as n, avg(size) as mean_sz",
+            by="symbol",
+        ).collect()
+        assert mesh.last_mesh_fallback is None, mesh.last_mesh_fallback
+        keys = ["symbol", "window_start"]
+        exp, got = _norm(exp, keys), _norm(got, keys)
+        assert list(got.columns) == list(exp.columns)
+        pd.testing.assert_frame_equal(got, exp, check_dtype=False)
+
+    def test_hopping_matches_engine(self, ticks):
+        tp, qp, tdf, qdf = ticks
+        plain, mesh = _contexts()
+        t, _ = _streams(plain, tp, qp)
+        exp = t.window_agg(
+            HoppingWindow(20_000, 10_000), "count(*) as n, sum(size) as total",
+            by="symbol",
+        ).collect()
+        t, _ = _streams(mesh, tp, qp)
+        got = t.window_agg(
+            HoppingWindow(20_000, 10_000), "count(*) as n, sum(size) as total",
+            by="symbol",
+        ).collect()
+        assert mesh.last_mesh_fallback is None, mesh.last_mesh_fallback
+        keys = ["symbol", "window_start"]
+        pd.testing.assert_frame_equal(
+            _norm(got, keys), _norm(exp, keys), check_dtype=False
+        )
+
+    def test_fine_hop_falls_back_loudly(self, ticks):
+        # replication factor size//hop above the cap must leave the mesh
+        # (static whole-dataset blowup inside one shard_map), not OOM it
+        tp, qp, tdf, qdf = ticks
+        plain, mesh = _contexts()
+        t, _ = _streams(plain, tp, qp)
+        exp = t.window_agg(
+            HoppingWindow(50_000, 1_000), "count(*) as n", by="symbol"
+        ).collect()
+        t, _ = _streams(mesh, tp, qp)
+        got = t.window_agg(
+            HoppingWindow(50_000, 1_000), "count(*) as n", by="symbol"
+        ).collect()
+        assert mesh.last_mesh_fallback is not None
+        assert "replication" in mesh.last_mesh_fallback
+        keys = ["symbol", "window_start"]
+        pd.testing.assert_frame_equal(
+            _norm(got, keys), _norm(exp, keys), check_dtype=False
+        )
+
+    def test_session_falls_back_loudly(self, ticks):
+        tp, qp, tdf, qdf = ticks
+        plain, mesh = _contexts()
+        t, _ = _streams(plain, tp, qp)
+        exp = t.window_agg(
+            SessionWindow(50), "sum(size) as total", by="symbol"
+        ).collect()
+        t, _ = _streams(mesh, tp, qp)
+        got = t.window_agg(
+            SessionWindow(50), "sum(size) as total", by="symbol"
+        ).collect()
+        assert mesh.last_mesh_fallback is not None
+        assert "SessionWindow" in mesh.last_mesh_fallback
+        keys = ["symbol", "session_start"]
+        pd.testing.assert_frame_equal(
+            _norm(got, keys), _norm(exp, keys), check_dtype=False
+        )
+
+
+EPOCH_NS = 1_600_000_000_000_000_000  # wide int64: exercises the two-limb path
+
+
+def _make_ns_ticks(tmp_path_factory):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    r = np.random.default_rng(17)
+    n_tr, n_qt = 1500, 3000
+    syms = np.array([f"N{i}" for i in range(4)])
+    # span < 2^31 ns so the mesh/engine int32 window rebase stays exact
+    trades = pa.table({
+        "time": EPOCH_NS + np.sort(
+            r.integers(0, 1_200_000_000, n_tr)
+        ).astype(np.int64),
+        "symbol": syms[r.integers(0, 4, n_tr)],
+        "size": r.integers(1, 100, n_tr).astype(np.int64),
+    })
+    quotes = pa.table({
+        "time": EPOCH_NS + np.sort(
+            r.choice(1_200_000_000, n_qt, replace=False)
+        ).astype(np.int64),
+        "symbol": syms[r.integers(0, 4, n_qt)],
+        "bid": r.uniform(10, 20, n_qt).round(3),
+    })
+    root = tmp_path_factory.mktemp("mesh_ns_ticks")
+    tp, qp = str(root / "t.parquet"), str(root / "q.parquet")
+    pq.write_table(trades, tp, row_group_size=512)
+    pq.write_table(quotes, qp, row_group_size=512)
+    return tp, qp, trades.to_pandas(), quotes.to_pandas()
+
+
+@pytest.fixture(scope="module")
+def ns_ticks(tmp_path_factory):
+    return _make_ns_ticks(tmp_path_factory)
+
+
+class TestMeshWideTimestamps:
+    """ns-epoch int64 times force the wide two-limb branches: widen/not_limbs
+    in mesh_asof's _side_time_limbs and the rebase_narrow path in _window."""
+
+    @pytest.mark.parametrize("direction", ["backward", "forward"])
+    def test_ns_asof_vs_pandas(self, ns_ticks, direction):
+        tp, qp, tdf, qdf = ns_ticks
+        plain, mesh = _contexts()
+        t, q = _streams(mesh, tp, qp)
+        got = t.join_asof(q, on="time", by="symbol", direction=direction).collect()
+        assert mesh.last_mesh_fallback is None, mesh.last_mesh_fallback
+        exp = pd.merge_asof(
+            tdf.sort_values("time"), qdf.sort_values("time"),
+            on="time", by="symbol", direction=direction,
+        ).dropna(subset=["bid"])
+        keys = ["symbol", "time", "size"]
+        got, exp = _norm(got, keys), _norm(exp, keys)
+        assert len(got) == len(exp)
+        np.testing.assert_allclose(
+            got.bid.to_numpy(), exp.bid.to_numpy(), rtol=1e-9
+        )
+
+    def test_ns_tumbling_vs_pandas(self, ns_ticks):
+        tp, qp, tdf, qdf = ns_ticks
+        plain, mesh = _contexts()
+        size = 100_000_000  # 0.1 s in ns
+        t, _ = _streams(mesh, tp, qp)
+        got = t.window_agg(
+            TumblingWindow(size), "sum(size) as total, count(*) as n",
+            by="symbol",
+        ).collect()
+        assert mesh.last_mesh_fallback is None, mesh.last_mesh_fallback
+        d = tdf.copy()
+        d["w"] = (d.time // size) * size
+        exp = (
+            d.groupby(["symbol", "w"])
+            .agg(total=("size", "sum"), n=("size", "size"))
+            .reset_index()
+        )
+        got = _norm(got, ["symbol", "window_start"])
+        exp = _norm(exp, ["symbol", "w"])
+        assert len(got) == len(exp)
+        np.testing.assert_array_equal(
+            got.window_start.to_numpy(), exp.w.to_numpy()
+        )
+        np.testing.assert_array_equal(got.total.to_numpy(), exp.total.to_numpy())
+        np.testing.assert_array_equal(got.n.to_numpy(), exp.n.to_numpy())
+
+    def test_ns_tumbling_matches_engine(self, ns_ticks):
+        tp, qp, tdf, qdf = ns_ticks
+        plain, mesh = _contexts()
+        size = 100_000_000
+        t, _ = _streams(plain, tp, qp)
+        exp = t.window_agg(
+            TumblingWindow(size), "sum(size) as total", by="symbol"
+        ).collect()
+        t, _ = _streams(mesh, tp, qp)
+        got = t.window_agg(
+            TumblingWindow(size), "sum(size) as total", by="symbol"
+        ).collect()
+        assert mesh.last_mesh_fallback is None, mesh.last_mesh_fallback
+        keys = ["symbol", "window_start"]
+        pd.testing.assert_frame_equal(
+            _norm(got, keys), _norm(exp, keys), check_dtype=False
+        )
