@@ -134,13 +134,49 @@ class QuokkaContext:
         reader = InputCSVDataset(path, schema=schema, has_header=has_header, sep=sep)
         return self.new_stream(logical.SourceNode(reader, list(reader.schema.names)))
 
-    def read_rest(self, requests_list, record_path=None, schema=None) -> DataStream:
+    def read_rest(self, requests_list, record_path=None, schema=None,
+                  method: str = "get", headers=None) -> DataStream:
         """Paged REST endpoint: each (url, params) request is one lineage unit
-        (reference crypto_dataset.py)."""
+        (reference crypto_dataset.py, GET and POST variants — method="post"
+        sends params as the JSON body)."""
         from quokka_tpu.dataset.cloud import InputRestDataset
 
         reader = InputRestDataset(requests_list, record_path=record_path,
-                                  schema=schema)
+                                  schema=schema, method=method, headers=headers)
+        return self.new_stream(logical.SourceNode(reader, list(reader.schema)))
+
+    def read_files(self, path: str, files_per_batch: int = 1) -> DataStream:
+        """Whole files as (filename, object) rows — unstructured blobs
+        (reference InputDiskFilesDataset / InputS3FilesDataset,
+        pyquokka/dataset/unordered_readers.py:206-272).  `path` may be a local
+        directory, a glob, or an fsspec URL (s3://bucket/prefix)."""
+        from quokka_tpu.dataset.cloud import InputFilesDataset
+
+        reader = InputFilesDataset(path, files_per_batch=files_per_batch)
+        return self.new_stream(logical.SourceNode(reader, list(reader.schema)))
+
+    def read_lance(self, path: str, columns=None) -> DataStream:
+        """Lance-format dataset (reference InputLanceDataset,
+        pyquokka/dataset/unordered_readers.py:101-205).  Requires the `lance`
+        library, which is not baked into every image: when it is present the
+        dataset reads fragment-by-fragment (one lineage unit per fragment);
+        when absent this raises with the supported substitute — Parquet plus
+        the IVF ANN sidecar (ctx.read_parquet + build_ivf_index +
+        nearest_neighbors, dataset/vector.py), which covers the reference's
+        Lance use case (vector top-k with index pushdown, apps/vectors)."""
+        try:
+            import lance  # noqa: F401
+        except ImportError:
+            raise ImportError(
+                "the 'lance' library is not installed in this image.  For the "
+                "vector-search role Lance plays in the reference, use Parquet "
+                "with the IVF sidecar instead: ctx.read_parquet(...) + "
+                "quokka_tpu.dataset.vector.build_ivf_index(...) + "
+                ".nearest_neighbors(...) — same pushdown, TPU-native top-k."
+            ) from None
+        from quokka_tpu.dataset.cloud import InputLanceDataset
+
+        reader = InputLanceDataset(path, columns=columns)
         return self.new_stream(logical.SourceNode(reader, list(reader.schema)))
 
     def read_json(self, path) -> DataStream:

@@ -54,8 +54,15 @@ class CountExecutor(Executor):
 # killed worker) must not leak dirs under config.SPILL_DIR forever
 _SPILL_DIRS: set = set()
 
+# process-wide count of operators that crossed a spill threshold (one per
+# spilling operator instance) — tests assert production-threshold runs
+# actually exercised the disk tier
+SPILL_EVENTS = 0
+
 
 def _new_spill_dir(prefix: str) -> str:
+    global SPILL_EVENTS
+    SPILL_EVENTS += 1
     import atexit
     import os
     import tempfile

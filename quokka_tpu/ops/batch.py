@@ -33,10 +33,15 @@ _FNV_PRIME = 0x100000001B3
 _MASK64 = 0xFFFFFFFFFFFFFFFF
 
 
-def fnv1a64(s: str) -> int:
-    """Stable 64-bit FNV-1a hash (process-independent, unlike Python hash())."""
+def fnv1a64(s) -> int:
+    """Stable 64-bit FNV-1a hash (process-independent, unlike Python hash()).
+    Accepts str or bytes (binary dictionary values hash their raw bytes)."""
     h = _FNV_OFFSET
-    for b in s.encode("utf-8", errors="surrogatepass"):
+    data = (
+        s if isinstance(s, (bytes, bytearray))
+        else s.encode("utf-8", errors="surrogatepass")
+    )
+    for b in data:
         h = ((h ^ b) * _FNV_PRIME) & _MASK64
     return h
 
@@ -54,11 +59,13 @@ def _hash_strings(values: Sequence) -> np.ndarray:
 
 
 class StringDict:
-    """Host-side dictionary for a string column: values + 64-bit hashes as
-    two uint32 limb arrays (device-friendly)."""
+    """Host-side dictionary for a string (or binary) column: values + 64-bit
+    hashes as two uint32 limb arrays (device-friendly).  `binary` marks a
+    bytes-valued dictionary (whole-file blob columns) so device_to_arrow
+    round-trips to pa.binary instead of pa.string."""
 
-    def __init__(self, values: np.ndarray):
-        # values: np object/str array of unique strings (may contain None)
+    def __init__(self, values: np.ndarray, binary: Optional[bool] = None):
+        # values: np object array of unique strings/bytes (may contain None)
         vals = np.asarray(values, dtype=object)
         if len(vals) == 0:
             # invariant: a dictionary is never empty.  All-invalid batches
@@ -66,6 +73,16 @@ class StringDict:
             # without special-casing zero-length host arrays.
             vals = np.array([None], dtype=object)
         self.values = vals
+        if binary is None:
+            # value sniff is a fallback only: an ALL-NULL dictionary can't be
+            # sniffed, so producers that know the arrow type (bridge) pass
+            # the flag explicitly to keep binary columns binary across
+            # all-null batches
+            binary = next(
+                (isinstance(v, (bytes, bytearray)) for v in vals if v is not None),
+                False,
+            )
+        self.binary = bool(binary)
         self._h64: Optional[np.ndarray] = None
 
     def __len__(self) -> int:
@@ -315,9 +332,41 @@ class DeviceBatch:
         return DeviceBatch(cols, self.valid, self.nrows, self.sorted_by, self.nrows_dev)
 
     def take(self, idx: jax.Array, valid: jax.Array, nrows: Optional[int]) -> "DeviceBatch":
-        return DeviceBatch(
-            {n: c.take(idx) for n, c in self.columns.items()}, valid, nrows, self.sorted_by
-        )
+        cols = gather_columns(self.columns, idx)
+        return DeviceBatch(cols, valid, nrows, self.sorted_by)
+
+
+@jax.jit
+def _gather_all(arrays, idx):
+    """One compiled program gathering EVERY column at once: eager per-column
+    `a[idx]` costs a separate dispatch (and bounds-check chain) per array —
+    the dominant cost of wide-row takes in the engine's join path."""
+    return tuple(a[idx] for a in arrays)
+
+
+def gather_columns(columns: Dict[str, "Column"], idx: jax.Array) -> Dict[str, "Column"]:
+    """Row-gather a whole column dict through a single fused XLA program."""
+    arrays: List[jax.Array] = []
+    for c in columns.values():
+        if isinstance(c, StrCol):
+            arrays.append(c.codes)
+        elif isinstance(c, VecCol):
+            arrays.append(c.data)
+        else:
+            if c.hi is not None:
+                arrays.append(c.hi)
+            arrays.append(c.data)
+    gathered = iter(_gather_all(tuple(arrays), idx))
+    out: Dict[str, Column] = {}
+    for n, c in columns.items():
+        if isinstance(c, StrCol):
+            out[n] = StrCol(next(gathered), c.dictionary)
+        elif isinstance(c, VecCol):
+            out[n] = VecCol(next(gathered))
+        else:
+            hi = next(gathered) if c.hi is not None else None
+            out[n] = NumCol(next(gathered), c.kind, hi=hi, unit=c.unit)
+    return out
 
 
 def key_limbs(batch: DeviceBatch, cols: Sequence[str]) -> List[jax.Array]:

@@ -87,8 +87,21 @@ def arrow_column_to_device(arr: pa.ChunkedArray, padded: int):
             idx = pc.fill_null(idx, -1)  # null rows -> code -1
         codes = idx.to_numpy(zero_copy_only=False).astype(np.int32)
         values = arr.dictionary.to_pylist()
-        return StrCol(_pad(codes, padded), StringDict(np.array(values, dtype=object)))
-    if pa.types.is_string(t) or pa.types.is_large_string(t):
+        # the arrow value type decides binary-ness — a value sniff would
+        # misclassify an all-null batch of a binary column as string
+        is_bin = pa.types.is_binary(t.value_type) or pa.types.is_large_binary(
+            t.value_type
+        )
+        return StrCol(
+            _pad(codes, padded),
+            StringDict(np.array(values, dtype=object), binary=is_bin),
+        )
+    if (
+        pa.types.is_string(t) or pa.types.is_large_string(t)
+        or pa.types.is_binary(t) or pa.types.is_large_binary(t)
+    ):
+        # binary columns (whole-file blobs) dictionary-encode like strings:
+        # bytes stay on the host dictionary, int32 codes go on device
         enc = pc.dictionary_encode(arr)
         if isinstance(enc, pa.ChunkedArray):
             enc = enc.combine_chunks()
@@ -222,7 +235,8 @@ def device_to_arrow(batch: DeviceBatch) -> pa.Table:
             out = np.empty(len(codes), dtype=object)
             for i, c in enumerate(codes):
                 out[i] = vals[c] if 0 <= c < len(vals) else None
-            arrays.append(pa.array(out, type=pa.string()))
+            typ = pa.binary() if col.dictionary.binary else pa.string()
+            arrays.append(pa.array(out, type=typ))
         else:
             from quokka_tpu.ops.batch import NULL_I32, NULL_I64
 
@@ -267,12 +281,19 @@ def merge_dicts(dicts: Sequence[StringDict]):
     if len(dicts) == 1:
         return dicts[0], [None]
     all_vals = np.concatenate([d.values for d in dicts])
-    # np.unique on object arrays with None fails; substitute sentinel
+    # np.unique on object arrays with None fails; substitute sentinel.
+    # Uniqueness keys are str() reprs (injective per column type); merged
+    # values are the ORIGINAL objects so bytes dictionaries survive intact.
     sent = "\x00__null__"
     flat = np.array([sent if v is None else v for v in all_vals], dtype=object)
-    uniq, inverse = np.unique(flat.astype(str), return_inverse=True)
-    merged_vals = np.array([None if v == sent else v for v in uniq], dtype=object)
-    merged = StringDict(merged_vals)
+    uniq, first_idx, inverse = np.unique(
+        flat.astype(str), return_index=True, return_inverse=True
+    )
+    merged_vals = np.array(
+        [None if flat[i] == sent else all_vals[i] for i in first_idx],
+        dtype=object,
+    )
+    merged = StringDict(merged_vals, binary=any(d.binary for d in dicts))
     remaps = []
     off = 0
     for d in dicts:

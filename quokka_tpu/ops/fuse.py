@@ -48,7 +48,7 @@ import numpy as np
 
 from quokka_tpu import config
 from quokka_tpu.ops import expr_compile, kernels
-from quokka_tpu.ops.batch import DeviceBatch, NumCol, StrCol
+from quokka_tpu.ops.batch import DeviceBatch, NumCol, StrCol, gather_columns
 
 
 def _is_string_dependent(e: Expr, batch: DeviceBatch) -> bool:
@@ -269,9 +269,7 @@ class FusedPartialAgg:
             batch.valid,
         )
         *agg_arrays, rep, num = outs
-        cols = {}
-        for k in self.keys:
-            cols[k] = batch.columns[k].take(rep)
+        cols = gather_columns({k: batch.columns[k] for k in self.keys}, rep)
         for (pname, _, _), arr in zip(self.plan.partials, agg_arrays):
             cols[pname] = NumCol(
                 arr, "f" if jnp.issubdtype(arr.dtype, jnp.floating) else "i"

@@ -26,7 +26,7 @@ import numpy as np
 from jax import lax
 
 from quokka_tpu import config
-from quokka_tpu.ops.batch import DeviceBatch, NumCol, StrCol, key_limbs
+from quokka_tpu.ops.batch import DeviceBatch, NumCol, StrCol, gather_columns, key_limbs
 
 # ---------------------------------------------------------------------------
 # masking / compaction
@@ -289,9 +289,7 @@ def groupby_aggregate(
         ranks = jnp.zeros(n, dtype=jnp.int32)
         num = jnp.minimum(jnp.sum(batch.valid), 1).astype(jnp.int32)
         outs, counts, rep = _segment_aggs(ranks, batch.valid, arrays, ops)
-    cols = {}
-    for k in keys:
-        cols[k] = batch.columns[k].take(rep)
+    cols = gather_columns({k: batch.columns[k] for k in keys}, rep)
     for (name, _, _), arr in zip(aggs, outs):
         cols[name] = NumCol(arr, "f" if jnp.issubdtype(arr.dtype, jnp.floating) else "i")
     group_valid = jnp.arange(n) < num

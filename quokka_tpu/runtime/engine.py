@@ -570,6 +570,9 @@ class Engine:
                     self._emit(info, task.channel, out_seq, o)
                     self._metric(task.actor, task.channel, self._rows_of(o), 0)
                     out_seq += 1
+            # all sink emissions must land before DST says done: a consumer
+            # (collect, coordinator result read) may act on "done" immediately
+            self._flush_emits()
             with self.store.transaction():
                 self.store.tset("LIT", (task.actor, task.channel), out_seq - 1)
                 self.store.tset("EST", (task.actor, task.channel), task.state_seq)
@@ -1017,13 +1020,56 @@ class Engine:
                     out_seq += 1
         return state_seq, out_seq
 
+    # at most this many sink batches may be in flight on the emitter thread
+    # (bounds device memory held by un-converted DeviceBatches)
+    _EMIT_INFLIGHT = 8
+
     def _emit(self, info: ActorInfo, channel: int, seq: int, out: DeviceBatch) -> None:
         if getattr(info, "blocking", False) or info.blocking_dataset is not None:
-            with tracing.span("emit.result_d2h"):
-                table = bridge.device_to_arrow(out)
-            self._result_append(info, channel, seq, table)
+            # sink emission is the engine's big blocking host segment (a full
+            # device->host sync per output batch): run it on a single emitter
+            # thread so the task loop keeps dispatching device work — the
+            # reference gets this overlap from concurrent Ray actors
+            # (pyquokka/core.py:276-376).  One thread => FIFO order; appends
+            # are seq-keyed so replay re-emissions stay idempotent.  The
+            # emitter is FLUSHED before a channel is marked done (DST) so no
+            # consumer can observe a partially-shipped result set.
+            self._emit_submit(
+                lambda: self._convert_and_append(info, channel, seq, out)
+            )
         else:
             self.push(info.id, channel, seq, out)
+
+    def _convert_and_append(self, info, channel, seq, out):
+        with tracing.span("emit.result_d2h"):
+            table = bridge.device_to_arrow(out)
+        self._result_append(info, channel, seq, table)
+
+    def _emit_submit(self, fn) -> None:
+        pool = getattr(self, "_emit_pool", None)
+        if pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            pool = self._emit_pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="quokka-emit"
+            )
+            self._emit_futs = []
+        self._emit_futs.append(pool.submit(fn))
+        while len(self._emit_futs) > self._EMIT_INFLIGHT:
+            self._emit_futs.pop(0).result()
+
+    def _flush_emits(self) -> None:
+        futs = getattr(self, "_emit_futs", None)
+        if futs:
+            self._emit_futs = []
+            for f in futs:
+                f.result()  # propagate the first conversion/append error
+
+    def _shutdown_emitter(self) -> None:
+        pool = getattr(self, "_emit_pool", None)
+        if pool is not None:
+            self._emit_pool = None
+            pool.shutdown(wait=True)
 
     def _result_append(self, info: ActorInfo, channel: int, seq: int, table) -> None:
         """Blocking-node output sink; the distributed worker overrides this to
@@ -1040,12 +1086,14 @@ class Engine:
     def run(self, max_batches: Optional[int] = None, timeout: float = 3600.0) -> None:
         try:
             self._run(max_batches, timeout)
+            self._flush_emits()
         finally:
             try:
                 self._flush_metrics()
             except Exception:
                 pass  # a dead store must not block thread shutdown below
             self._shutdown_prefetch()
+            self._shutdown_emitter()
 
     def _io_threads(self) -> int:
         n = sum(a.channels for a in self.g.actors.values() if a.kind == "input")

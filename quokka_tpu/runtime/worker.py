@@ -311,12 +311,14 @@ def worker_main(spec_bytes: bytes, store_addr, worker_id: int, owned):
         w = Worker(spec, store, cache, worker_id, owned, hbq=hbq)
         try:
             w.run_worker()
+            w._flush_emits()
         finally:
             try:
                 w._flush_metrics()
             except Exception:
                 pass  # a dead coordinator store must not block shutdown
             w._shutdown_prefetch()
+            w._shutdown_emitter()
             server.close()
     except Exception:
         import traceback

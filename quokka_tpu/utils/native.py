@@ -78,7 +78,11 @@ def fnv1a64_many(values: Sequence) -> Optional[np.ndarray]:
     lib = _find_lib()
     if lib is None:
         return None
-    encoded = [(v if v is not None else "").encode("utf-8", errors="surrogatepass") for v in values]
+    encoded = [
+        bytes(v) if isinstance(v, (bytes, bytearray))
+        else (v if v is not None else "").encode("utf-8", errors="surrogatepass")
+        for v in values
+    ]
     n = len(encoded)
     offsets = np.zeros(n + 1, dtype=np.int64)
     for i, b in enumerate(encoded):

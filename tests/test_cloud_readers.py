@@ -124,6 +124,118 @@ class TestRest:
         finally:
             srv.shutdown()
 
+    def test_rest_post_reader(self, data_dir):
+        import http.server
+
+        class H(http.server.BaseHTTPRequestHandler):
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", "0"))
+                req = json.loads(self.rfile.read(n) or b"{}")
+                page = req.get("page", 0)
+                rows = [
+                    {"t": page * 10 + i, "v": float(page * 10 + i)}
+                    for i in range(req.get("limit", 2))
+                ]
+                body = json.dumps({"rows": rows}).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), H)
+        th = threading.Thread(target=srv.serve_forever, daemon=True)
+        th.start()
+        try:
+            url = f"http://127.0.0.1:{srv.server_address[1]}/query"
+            ctx = QuokkaContext()
+            got = (
+                ctx.read_rest(
+                    [(url, {"page": p, "limit": 3}) for p in range(2)],
+                    record_path="rows",
+                    method="post",
+                )
+                .agg_sql("count(*) as n, sum(v) as s")
+                .collect()
+            )
+            assert got.n[0] == 6
+            # pages 0 and 1: values 0,1,2 and 10,11,12
+            np.testing.assert_allclose(got.s[0], 0 + 1 + 2 + 10 + 11 + 12)
+        finally:
+            srv.shutdown()
+
+    def test_rest_rejects_unknown_method(self):
+        from quokka_tpu.dataset.cloud import InputRestDataset
+
+        with pytest.raises(ValueError, match="method"):
+            InputRestDataset([("http://x", None)], method="delete")
+
+
+class TestWholeFiles:
+    def test_disk_directory_as_rows(self, tmp_path):
+        d = tmp_path / "blobs"
+        d.mkdir()
+        payloads = {}
+        for i in range(7):
+            p = d / f"img_{i}.bin"
+            payloads[str(p)] = bytes([i]) * (10 + i)
+            p.write_bytes(payloads[str(p)])
+        ctx = QuokkaContext(io_channels=3)
+        got = ctx.read_files(str(d)).collect()
+        assert sorted(got.filename) == sorted(payloads)
+        by_name = dict(zip(got.filename, got.object))
+        for name, blob in payloads.items():
+            assert bytes(by_name[name]) == blob
+
+    def test_glob_and_batching(self, tmp_path):
+        d = tmp_path / "docs"
+        d.mkdir()
+        for i in range(5):
+            (d / f"doc{i}.txt").write_bytes(b"x" * i)
+        (d / "skip.dat").write_bytes(b"nope")
+        ctx = QuokkaContext()
+        got = ctx.read_files(str(d / "*.txt"), files_per_batch=2).collect()
+        assert len(got) == 5
+        assert all(f.endswith(".txt") for f in got.filename)
+
+    def test_missing_path_raises(self, tmp_path):
+        from quokka_tpu.dataset.cloud import InputFilesDataset
+
+        with pytest.raises(FileNotFoundError):
+            InputFilesDataset(str(tmp_path / "nope" / "*")).get_own_state(2)
+
+    def test_binary_roundtrip_through_device(self):
+        # blobs dictionary-encode (codes on device, bytes on host) and come
+        # back as pa.binary, not stringified
+        import pyarrow as pa
+
+        from quokka_tpu.ops import bridge
+
+        t = pa.table({
+            "name": ["a", "b", "c", "a"],
+            "blob": pa.array([b"\x00\x01", b"xyz", None, b"\x00\x01"], pa.binary()),
+        })
+        b = bridge.arrow_to_device(t)
+        back = bridge.device_to_arrow(b)
+        assert back.schema.field("blob").type == pa.binary()
+        assert back.column("blob").to_pylist() == [b"\x00\x01", b"xyz", None, b"\x00\x01"]
+
+
+class TestLance:
+    def test_read_lance_absent_names_substitute(self):
+        try:
+            import lance  # noqa: F401
+
+            pytest.skip("lance present: fallback path not reachable")
+        except ImportError:
+            pass
+        ctx = QuokkaContext()
+        with pytest.raises(ImportError, match="IVF sidecar"):
+            ctx.read_lance("/tmp/nonexistent.lance")
+
 
 class TestAnnPushdown:
     """IVF sidecar + push_ann (the Lance vector-index role, VERDICT item 8)."""

@@ -1,0 +1,112 @@
+"""SF1 slow tier (run with `pytest -m slow`): the TPC-H oracle queries at
+SF1 with PRODUCTION spill thresholds (no monkeypatching — SURVEY.md §4's
+"TPC-H SF0.01..1 vs the correctness oracle" at the top of the range), plus
+shapes big enough that the disk tier engages naturally: an external sort of
+SF1 lineitem (6M rows > config.SPILL_SORT_ROWS) and a grace join with a
+build side past config.SPILL_JOIN_BUILD_ROWS.  SPILL_EVENTS asserts the
+spill paths actually ran."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from quokka_tpu import QuokkaContext, config
+from quokka_tpu.executors import sql_execs
+
+import tpch_data
+import test_tpch
+import test_tpch2
+
+pytestmark = pytest.mark.slow
+
+SF = 1.0
+
+
+@pytest.fixture(scope="module")
+def env(tmp_path_factory):
+    root = tmp_path_factory.mktemp("tpch_sf1")
+    tables = tpch_data.generate(sf=SF, seed=7)
+    paths = tpch_data.write_parquet_dir(tables, str(root))
+    ctx = QuokkaContext(io_channels=3, exec_channels=2)
+    dfs = {k: t.to_pandas() for k, t in tables.items()}
+    return ctx, paths, dfs
+
+
+def test_q1_sf1(env):
+    test_tpch.test_q1(env)
+
+
+def test_q3_sf1(env):
+    test_tpch.test_q3(env)
+
+
+def test_q5_sf1(env):
+    test_tpch.test_q5(env)
+
+
+def test_q18_sf1(env):
+    test_tpch2.test_q18(env)
+
+
+def test_q21_sf1(env):
+    test_tpch2.test_q21(env)
+
+
+def test_external_sort_spills_at_production_threshold(env):
+    ctx, paths, dfs = env
+    l = dfs["lineitem"]
+    assert len(l) > config.SPILL_SORT_ROWS, (
+        "fixture must exceed the production sort threshold for this test "
+        f"to mean anything ({len(l)} <= {config.SPILL_SORT_ROWS})"
+    )
+    before = sql_execs.SPILL_EVENTS
+    got = (
+        ctx.read_parquet(paths["lineitem"],
+                         columns=["l_orderkey", "l_extendedprice"])
+        .sort(["l_extendedprice", "l_orderkey"], descending=[True, False])
+        .collect()
+    )
+    assert sql_execs.SPILL_EVENTS > before, (
+        "SF1 sort never crossed the production spill threshold"
+    )
+    exp = l.sort_values(
+        ["l_extendedprice", "l_orderkey"], ascending=[False, True]
+    ).reset_index(drop=True)
+    assert len(got) == len(exp)
+    np.testing.assert_allclose(
+        got.l_extendedprice.to_numpy(), exp.l_extendedprice.to_numpy()
+    )
+    # spot-check full row alignment on the extremes (ties broken by orderkey)
+    np.testing.assert_array_equal(
+        got.l_orderkey.head(1000).to_numpy(), exp.l_orderkey.head(1000).to_numpy()
+    )
+
+
+def test_grace_join_spills_at_production_threshold(env):
+    ctx, paths, dfs = env
+    l = dfs["lineitem"]
+    assert len(l) > config.SPILL_JOIN_BUILD_ROWS
+    before = sql_execs.SPILL_EVENTS
+    # lineitem self-join on orderkey: the build side accumulates all 6M rows
+    # and must partition to disk (grace mode) at the production threshold
+    left = ctx.read_parquet(paths["lineitem"],
+                            columns=["l_orderkey", "l_quantity"])
+    right = (
+        ctx.read_parquet(paths["lineitem"],
+                         columns=["l_orderkey", "l_extendedprice"])
+        .rename({"l_orderkey": "r_orderkey"})
+    )
+    got = (
+        left.join(right, left_on="l_orderkey", right_on="r_orderkey")
+        .agg_sql("count(*) as n, sum(l_quantity) as sq")
+        .collect()
+    )
+    assert sql_execs.SPILL_EVENTS > before, (
+        "SF1 join build never crossed the production spill threshold"
+    )
+    sizes = l.groupby("l_orderkey").size()
+    exp_n = int((sizes * sizes).sum())
+    assert int(got.n[0]) == exp_n
+    per_order = l.groupby("l_orderkey").l_quantity.sum()
+    exp_sq = float((per_order * sizes).sum())
+    np.testing.assert_allclose(float(got.sq[0]), exp_sq, rtol=1e-6)
