@@ -732,12 +732,13 @@ class OrderedStream(DataStream):
         by = [by] if isinstance(by, str) else list(by or [])
         out_schema = self.schema + [f"{c}_shifted_{n}" for c in columns]
         time_col = self.time_col
-        node = logical.StatefulNode(
+        node = logical.ShiftNode(
             [self.node_id],
             out_schema,
             functools.partial(ShiftExecutor, time_col, by, columns, n),
-            partitioners={0: HashPartitioner(by) if by else PassThroughPartitioner()},
-            sorted_output=[time_col],
+            {0: HashPartitioner(by) if by else PassThroughPartitioner()},
+            [time_col],
+            time_col=time_col, by=by, columns=columns, n=n,
         )
         return self._ordered(node)
 

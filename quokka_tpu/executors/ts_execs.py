@@ -740,12 +740,16 @@ class ShiftExecutor(Executor):
         src = iota - self.n
         ok = src >= seg_start
         src = jnp.clip(src, 0, n - 1)
+        from quokka_tpu.ops.batch import with_nulls
+
         out = s
         for c in self.columns:
             col = s.columns[c]
             taken = col.take(src)
-            if isinstance(taken, NumCol) and taken.kind == "f":
-                taken = NumCol(jnp.where(ok, taken.data, jnp.nan), "f")
+            # rows with no history (under n predecessors in their key
+            # segment) get NULL, not a clipped gather's garbage — polars
+            # shift semantics for every column kind, not just floats
+            taken = with_nulls(taken, ~ok)
             out = out.with_column(f"{c}_shifted_{self.n}", taken)
         # keep last n rows per key as the next batch's carry
         rank_from_end = _rows_from_segment_end(iota, seg_start_flag, n)

@@ -264,6 +264,26 @@ class WindowAggNode(StatefulNode):
         return f"WindowAgg({type(self.window).__name__})"
 
 
+class ShiftNode(StatefulNode):
+    """Per-key lag (OrderedStream.shift).  StatefulNode for the streaming
+    engine (ShiftExecutor carries per-key tails across batches); the mesh
+    path runs it as one shard_map (shuffle by key, per-shard sort + segment
+    shift — parallel/mesh_exec.mesh_shift).  Reference:
+    pyquokka/orderedstream.py:13."""
+
+    def __init__(self, parents, schema, executor_factory, partitioners,
+                 sorted_output, *, time_col, by, columns, n):
+        super().__init__(parents, schema, executor_factory, partitioners,
+                         sorted_output)
+        self.time_col = time_col
+        self.by = list(by)
+        self.columns = list(columns)
+        self.n = n
+
+    def describe(self):
+        return f"Shift(n={self.n})"
+
+
 class JoinNode(Node):
     """Binary hash join; parents[0] = probe (stream 0), parents[1] = build."""
 

@@ -36,7 +36,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from quokka_tpu import config, logical
 from quokka_tpu.ops import bridge, kernels
 from quokka_tpu.ops import join as join_ops
-from quokka_tpu.ops.batch import DeviceBatch, NumCol, StrCol, VecCol, key_limbs, with_nulls
+from quokka_tpu.ops.batch import (
+    DeviceBatch, NumCol, StrCol, VecCol, _int_sentinel, key_limbs, with_nulls,
+)
 from quokka_tpu.ops.expr_compile import evaluate_predicate, evaluate_to_column
 from quokka_tpu.parallel.mesh import collective_hash_shuffle
 
@@ -524,6 +526,126 @@ def mesh_window_agg(
 
 
 # ---------------------------------------------------------------------------
+# mesh shift (shuffle by key -> per-shard sort + segment lag)
+# ---------------------------------------------------------------------------
+
+
+def mesh_shift(
+    mesh: Mesh,
+    axis: str,
+    batch: DeviceBatch,
+    by: List[str],
+    time_col: str,
+    columns: List[str],
+    n_lag: int,
+) -> DeviceBatch:
+    """Per-key lag over the mesh: rows key-shuffle with one all_to_all, then
+    each shard sorts its (complete) key groups by (key, time) and takes the
+    value n rows earlier within the key segment — the same segment
+    formulation as the streaming ShiftExecutor
+    (executors/ts_execs.py:716-757), without the cross-batch tail carry
+    (each shard sees its keys whole).  Rows with no history get NULL
+    (NaN for floats, the int sentinel otherwise); per-shard output is
+    KEY-major (sorted by key limbs, then time), not globally time-ordered."""
+    from quokka_tpu.ops import timewide
+    from quokka_tpu.ops.asof import _seg_fill_forward
+
+    if not by:
+        raise MeshUnsupported("by-less shift on mesh (no shuffle key)")
+    limbs = key_limbs(batch, by)
+    nlimb = len(limbs)
+    tc = batch.columns[time_col]
+    if jnp.issubdtype(tc.data.dtype, jnp.floating):
+        tlimbs = [tc.data]
+    else:
+        tlimbs = list(timewide.widen_limbs(tc))
+    ntime = len(tlimbs)
+    carried, slices = _flatten_cols(batch, batch.names)
+    ncarry = len(carried)
+    # shift sources are single narrow arrays already inside `carried` (the
+    # rejection rules below guarantee one array per column): index them there
+    # instead of shuffling the same data twice
+    shift_idx = []
+    shift_float = []
+    for c in columns:
+        col = batch.columns[c]
+        if isinstance(col, (StrCol, VecCol)):
+            raise MeshUnsupported(f"shift of non-numeric column {c!r} on mesh")
+        if col.hi is not None or col.kind == "b":
+            raise MeshUnsupported(
+                f"shift of wide-int/bool column {c!r} on mesh"
+            )
+        lo, hi = next((lo, hi) for (n2, lo, hi) in slices if n2 == c)
+        assert hi == lo + 1
+        shift_idx.append(lo)
+        shift_float.append(jnp.issubdtype(col.data.dtype, jnp.floating))
+
+    def step(*arrs):
+        i = 0
+        lb = arrs[i:i + nlimb]; i += nlimb
+        tl = arrs[i:i + ntime]; i += ntime
+        ca = arrs[i:i + ncarry]; i += ncarry
+        valid = arrs[i]
+        shuf, svalid = collective_hash_shuffle(
+            lb + tl + ca, valid, tuple(range(nlimb)), axis
+        )
+        slb = shuf[:nlimb]
+        stl = shuf[nlimb:nlimb + ntime]
+        sca = shuf[nlimb + ntime:]
+        ssv = tuple(sca[j] for j in shift_idx)
+        p = svalid.shape[0]
+        iota = jnp.arange(p, dtype=jnp.int32)
+        inv = (~svalid).astype(jnp.int32)
+        sorted_ = lax.sort(
+            [inv, *slb, *stl, iota], num_keys=1 + nlimb + ntime
+        )
+        perm = sorted_[-1]
+        valid_s = sorted_[0] == 0
+        klimbs_s = sorted_[1:1 + nlimb]
+        key_changed = jnp.zeros(p, dtype=bool)
+        for l in klimbs_s:
+            key_changed = key_changed | (l != jnp.roll(l, 1))
+        seg_flag = key_changed | (iota == 0)
+        seg_start = _seg_fill_forward(
+            jnp.where(seg_flag, iota, -1), seg_flag
+        )
+        src = iota - n_lag
+        ok = src >= seg_start
+        src = jnp.clip(src, 0, p - 1)
+        out_ca = tuple(c[perm] for c in sca)
+        shifted = []
+        for arr, is_f in zip(ssv, shift_float):
+            t = arr[perm][src]
+            if is_f:
+                t = jnp.where(ok, t, jnp.nan)
+            else:
+                # no-history rows get the int null sentinel (with_nulls
+                # semantics — parity with the streaming ShiftExecutor)
+                t = jnp.where(ok, t, _int_sentinel(t.dtype))
+            shifted.append(t)
+        return out_ca + tuple(shifted) + (valid_s,)
+
+    fn = jax.jit(
+        jax.shard_map(step, mesh=mesh, in_specs=P(axis), out_specs=P(axis),
+                      check_vma=False)
+    )
+    outs = fn(*limbs, *tlimbs, *carried, batch.valid)
+    oca = outs[:ncarry]
+    osh = outs[ncarry:-1]
+    ovalid = outs[-1]
+    cols = {}
+    for name, lo, hi in slices:
+        cols[name] = _rebuild_col(batch.columns[name], list(oca[lo:hi]))
+    out = DeviceBatch(cols, ovalid, None, None)
+    for c, arr, is_f in zip(columns, osh, shift_float):
+        out = out.with_column(
+            f"{c}_shifted_{n_lag}",
+            NumCol(arr, batch.columns[c].kind, unit=batch.columns[c].unit),
+        )
+    return out
+
+
+# ---------------------------------------------------------------------------
 # plan walker
 # ---------------------------------------------------------------------------
 
@@ -537,7 +659,7 @@ class MeshExecutor:
         logical.SourceNode, logical.FilterNode, logical.ProjectionNode,
         logical.MapNode, logical.DistinctNode, logical.AggNode,
         logical.JoinNode, logical.SortNode, logical.TopKNode, logical.SinkNode,
-        logical.AsofJoinNode, logical.WindowAggNode,
+        logical.AsofJoinNode, logical.WindowAggNode, logical.ShiftNode,
     )
     MAX_WINDOW_REPLICATION = 16
 
@@ -552,6 +674,8 @@ class MeshExecutor:
                 raise MeshUnsupported(f"node {type(node).__name__} on mesh")
             if isinstance(node, logical.AsofJoinNode) and not node.left_by:
                 raise MeshUnsupported("by-less asof join on mesh")
+            if isinstance(node, logical.ShiftNode) and not node.by:
+                raise MeshUnsupported("by-less shift on mesh")
             if isinstance(node, logical.WindowAggNode):
                 if not isinstance(
                     node.window, (W.TumblingWindow, W.HoppingWindow)
@@ -618,6 +742,14 @@ class MeshExecutor:
             return self._agg(sub, node)
         if isinstance(node, logical.AsofJoinNode):
             return self._asof(sub, node)
+        if isinstance(node, logical.ShiftNode):
+            b = self._exec(sub, node.parents[0])
+            out = mesh_shift(
+                self.mesh, self.axis, b, list(node.by), node.time_col,
+                list(node.columns), node.n,
+            )
+            out = out.select([c for c in node.schema if c in out.columns])
+            return self._compact_reshard(out)
         if isinstance(node, logical.WindowAggNode):
             return self._window(sub, node)
         if isinstance(node, logical.JoinNode):

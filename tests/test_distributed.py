@@ -299,3 +299,101 @@ class TestExternalWorker:
         np.testing.assert_allclose(got.sv.to_numpy(), exp["sum"].to_numpy(), rtol=1e-9)
         assert got.n.tolist() == exp["size"].tolist()
         graph.cleanup()
+
+
+class TestGCloudProvisioner:
+    """Command construction + response parsing with an injected runner (the
+    reference's EC2 create/start/stop/terminate surface, utils.py:191-500,
+    mapped onto `gcloud compute tpus tpu-vm`).  No gcloud binary needed."""
+
+    class _FakeRun:
+        def __init__(self, describe_json):
+            self.calls = []
+            self.describe_json = describe_json
+
+        def __call__(self, cmd, capture_output=True, text=True):
+            import json
+            import types
+
+            self.calls.append(cmd)
+            out = ""
+            if "describe" in cmd:
+                out = json.dumps(self.describe_json)
+            return types.SimpleNamespace(returncode=0, stdout=out, stderr="")
+
+    DESC = {
+        "name": "projects/p/locations/z/nodes/myslice",
+        "state": "READY",
+        "networkEndpoints": [
+            {"ipAddress": "10.0.0.2", "accessConfig": {"externalIp": "34.1.1.1"}},
+            {"ipAddress": "10.0.0.3", "accessConfig": {"externalIp": "34.1.1.2"}},
+        ],
+    }
+
+    def test_create_builds_cluster_from_endpoints(self):
+        from quokka_tpu.utils.cluster import GCloudTPUProvisioner
+
+        fake = self._FakeRun(self.DESC)
+        prov = GCloudTPUProvisioner("proj", "us-central2-b", runner=fake)
+        cluster = prov.create_cluster("myslice", accelerator_type="v5litepod-8")
+        create, describe = fake.calls
+        assert create[:5] == ["gcloud", "compute", "tpus", "tpu-vm", "create"]
+        assert "myslice" in create
+        assert "--accelerator-type=v5litepod-8" in create
+        assert "--project=proj" in create and "--zone=us-central2-b" in create
+        assert "describe" in describe
+        assert cluster.hosts == ["10.0.0.2", "10.0.0.3"]
+        assert cluster.coordinator == "10.0.0.2"
+        # the provisioned cluster plugs straight into daemon bring-up
+        cmds = cluster.worker_commands()
+        assert len(cmds) == 2 and "--worker-id 1" in cmds[1]
+
+    def test_external_ips_and_lifecycle(self):
+        from quokka_tpu.utils.cluster import GCloudTPUProvisioner
+
+        fake = self._FakeRun(self.DESC)
+        prov = GCloudTPUProvisioner("proj", "z", runner=fake)
+        cluster = prov.get_cluster("myslice", internal_ips=False)
+        assert cluster.hosts == ["34.1.1.1", "34.1.1.2"]
+        prov.stop_cluster("myslice")
+        prov.terminate_cluster("myslice")
+        assert any("stop" in c for c in fake.calls)
+        assert any("delete" in c and "--quiet" in c for c in fake.calls)
+
+    def test_gcloud_failure_surfaces(self):
+        import types
+
+        from quokka_tpu.utils.cluster import GCloudTPUProvisioner
+
+        def boom(cmd, capture_output=True, text=True):
+            return types.SimpleNamespace(returncode=1, stdout="",
+                                         stderr="PERMISSION_DENIED: nope")
+
+        prov = GCloudTPUProvisioner("proj", "z", runner=boom)
+        with pytest.raises(RuntimeError, match="PERMISSION_DENIED"):
+            prov.get_cluster("myslice")
+
+    def test_no_endpoints_is_loud(self):
+        from quokka_tpu.utils.cluster import GCloudTPUProvisioner
+
+        fake = self._FakeRun({"name": "n", "state": "CREATING"})
+        prov = GCloudTPUProvisioner("proj", "z", runner=fake)
+        with pytest.raises(RuntimeError, match="no network endpoints"):
+            prov.get_cluster("n")
+
+    def test_manager_delegates_with_coordinates(self):
+        from quokka_tpu.utils import cluster as C
+
+        fake = self._FakeRun(self.DESC)
+        orig = C.GCloudTPUProvisioner
+        try:
+            C.GCloudTPUProvisioner = lambda project, zone: orig(
+                project, zone, runner=fake
+            )
+            mgr = C.QuokkaClusterManager()
+            got = mgr.create_cluster("myslice", project="p", zone="z")
+            assert got.hosts == ["10.0.0.2", "10.0.0.3"]
+        finally:
+            C.GCloudTPUProvisioner = orig
+        with pytest.raises(NotImplementedError, match="TPUPodCluster"):
+            C.QuokkaClusterManager().create_cluster()

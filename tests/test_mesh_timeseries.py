@@ -171,6 +171,72 @@ class TestMeshWindows:
         )
 
 
+class TestMeshShift:
+    @pytest.fixture(scope="class")
+    def unique_ticks(self, tmp_path_factory):
+        # unique (symbol, time) pairs: with ties the lag target is
+        # order-dependent in the ENGINE too (reader order breaks ties), so
+        # cross-backend equality is only defined on tie-free data
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        r = np.random.default_rng(29)
+        n, nsym = 4000, 5
+        times = np.sort(r.choice(500_000, n, replace=False)).astype(np.int64)
+        t = pa.table({
+            "time": times,
+            "symbol": np.array([f"S{i}" for i in range(nsym)])[
+                r.integers(0, nsym, n)
+            ],
+            "size": r.integers(1, 500, n).astype(np.int64),
+            "px": r.uniform(1, 100, n).round(3),
+        })
+        p = str(tmp_path_factory.mktemp("shift_ticks") / "t.parquet")
+        pq.write_table(t, p, row_group_size=512)
+        return p, t.to_pandas()
+
+    @pytest.mark.parametrize("n", [1, 3])
+    def test_shift_matches_engine_and_pandas(self, unique_ticks, n):
+        tp, tdf = unique_ticks
+        plain, mesh = _contexts()
+        exp = (
+            plain.read_sorted_parquet(tp, sorted_by="time")
+            .shift(["size", "px"], n=n, by="symbol").collect()
+        )
+        got = (
+            mesh.read_sorted_parquet(tp, sorted_by="time")
+            .shift(["size", "px"], n=n, by="symbol").collect()
+        )
+        assert mesh.last_mesh_fallback is None, mesh.last_mesh_fallback
+        keys = ["symbol", "time"]
+        exp, got = _norm(exp, keys), _norm(got, keys)
+        assert list(got.columns) == list(exp.columns)
+        pd.testing.assert_frame_equal(got, exp, check_dtype=False)
+        # independent oracle on the float column (NaN where no history)
+        d = tdf.sort_values(["symbol", "time"])
+        oracle = d.groupby("symbol").px.shift(n)
+        oracle = oracle.reindex(d.index)
+        merged = d.assign(px_oracle=oracle).sort_values(keys).reset_index(drop=True)
+        np.testing.assert_allclose(
+            got[f"px_shifted_{n}"].to_numpy(), merged.px_oracle.to_numpy(),
+            equal_nan=True,
+        )
+
+    def test_byless_shift_falls_back_loudly(self, ticks):
+        tp, qp, tdf, qdf = ticks
+        plain, mesh = _contexts()
+        t, _ = _streams(plain, tp, qp)
+        exp = t.shift(["size"], n=1).collect()
+        t, _ = _streams(mesh, tp, qp)
+        got = t.shift(["size"], n=1).collect()
+        assert mesh.last_mesh_fallback is not None
+        assert "shift" in mesh.last_mesh_fallback
+        keys = ["time", "size"]
+        pd.testing.assert_frame_equal(
+            _norm(got, keys), _norm(exp, keys), check_dtype=False
+        )
+
+
 EPOCH_NS = 1_600_000_000_000_000_000  # wide int64: exercises the two-limb path
 
 
