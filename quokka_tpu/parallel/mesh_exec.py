@@ -525,6 +525,245 @@ def mesh_window_agg(
     return DeviceBatch(cols, fvalid, None, None)
 
 
+def _rebase_time(b: DeviceBatch, col, headroom: int, align: int = 1):
+    """(narrow_col, tbase): exact int32 rebase when the time column is wide
+    or holds int64 absolute values outside int32 window arithmetic — the
+    _TimeRebase discipline shared by every mesh window/shift path.  Two
+    device reductions + two scalar transfers; never a full-column gather."""
+    from quokka_tpu.ops import timewide
+
+    tbase = 0
+    need = col.hi is not None
+    mn = 0
+    if (need or col.data.dtype == jnp.int64) and b.count_valid():
+        mn = timewide.host_min_i64(col, b.valid)
+        if not need:
+            mx = timewide.host_max_i64(col, b.valid)
+            need = mn <= -(2**31) or mx >= 2**31 - 1 - headroom
+    if need:
+        align = max(1, int(align))
+        tbase = ((mn - 2**29) // align) * align
+        col = timewide.rebase_narrow(col, b.valid, tbase, headroom=headroom)
+    return col, tbase
+
+
+# ---------------------------------------------------------------------------
+# mesh session windows (shuffle by key -> per-shard sessionize + groupby)
+# ---------------------------------------------------------------------------
+
+
+def mesh_session_window(
+    mesh: Mesh,
+    axis: str,
+    batch: DeviceBatch,
+    by: List[str],
+    time_data: jax.Array,
+    timeout: int,
+    partials: List[Tuple[str, str, Optional[str]]],
+) -> DeviceBatch:
+    """Gap-based session windows over the mesh: rows key-shuffle with one
+    all_to_all, each shard sorts its complete key groups by time, flags a
+    new session where the gap exceeds the timeout (same boundary rule as
+    SessionWindowExecutor._sessionize, executors/ts_execs.py:505-530), and
+    aggregates per (key, session id) locally — sessions are whole per shard,
+    so no second shuffle or recombine pass is needed.  Returns groups
+    carrying by-columns + "__first_t"/"__last_t" + partial outputs."""
+    limbs = key_limbs(batch, by) if by else []
+    nlimb = len(limbs)
+    carried, slices = _flatten_cols(batch, by)
+    ncarry = len(carried)
+    vals = [
+        batch.columns[c].data if c is not None
+        else jnp.zeros(batch.padded_len, jnp.int32)
+        for (_, _, c) in partials
+    ]
+    pops = tuple(op for (_, op, _) in partials) + ("min", "max")
+
+    def step(*arrs):
+        lb = arrs[:nlimb]
+        t = arrs[nlimb]
+        ca = arrs[nlimb + 1:nlimb + 1 + ncarry]
+        va = arrs[nlimb + 1 + ncarry:-1]
+        valid = arrs[-1]
+        cols = lb + (t,) + ca + tuple(va)
+        if nlimb:
+            shuf, svalid = collective_hash_shuffle(
+                cols, valid, tuple(range(nlimb)), axis
+            )
+        else:
+            # by-less sessions: a single global timeline — only correct on
+            # one shard; the pre-walk rejects this shape
+            shuf, svalid = cols, valid
+        slb = shuf[:nlimb]
+        st = shuf[nlimb]
+        sca = shuf[nlimb + 1:nlimb + 1 + ncarry]
+        sva = shuf[nlimb + 1 + ncarry:]
+        p = svalid.shape[0]
+        iota = jnp.arange(p, dtype=jnp.int32)
+        inv = (~svalid).astype(jnp.int32)
+        sorted_ = lax.sort([inv, *slb, st, iota], num_keys=2 + nlimb)
+        perm = sorted_[-1]
+        valid_s = sorted_[0] == 0
+        klimbs_s = sorted_[1:1 + nlimb]
+        t_s = sorted_[1 + nlimb]
+        key_changed = jnp.zeros(p, dtype=bool)
+        for l in klimbs_s:
+            key_changed = key_changed | (l != jnp.roll(l, 1))
+        gap = t_s - jnp.roll(t_s, 1)
+        new_sess = (iota == 0) | key_changed | (gap > timeout)
+        sess_id = jnp.cumsum(new_sess.astype(jnp.int32)) - 1
+        va_s = tuple(a[perm] for a in sva)
+        ca_s = tuple(c[perm] for c in sca)
+        glimbs = klimbs_s + (sess_id,)
+        outs, _, rep, num = kernels.sorted_groupby(
+            glimbs, va_s + (t_s, t_s), pops, valid_s
+        )
+        gcarry = tuple(c[rep] for c in ca_s)
+        gvalid = jnp.arange(p) < num
+        return gcarry + tuple(outs) + (gvalid,)
+
+    fn = jax.jit(
+        jax.shard_map(step, mesh=mesh, in_specs=P(axis), out_specs=P(axis),
+                      check_vma=False)
+    )
+    outs = fn(*limbs, time_data, *carried, *vals, batch.valid)
+    gcarry = outs[:ncarry]
+    pouts = outs[ncarry:-1]
+    gvalid = outs[-1]
+    cols = {}
+    for name, lo, hi in slices:
+        cols[name] = _rebuild_col(batch.columns[name], list(gcarry[lo:hi]))
+    for (pname, _, _), arr in zip(partials, pouts[:-2]):
+        cols[pname] = NumCol(
+            arr, "f" if jnp.issubdtype(arr.dtype, jnp.floating) else "i"
+        )
+    cols["__first_t"] = NumCol(pouts[-2], "i")
+    cols["__last_t"] = NumCol(pouts[-1], "i")
+    return DeviceBatch(cols, gvalid, None, None)
+
+
+# ---------------------------------------------------------------------------
+# mesh sliding windows (shuffle by key -> per-shard rolling kernels)
+# ---------------------------------------------------------------------------
+
+
+def mesh_sliding_window(
+    mesh: Mesh,
+    axis: str,
+    batch: DeviceBatch,
+    by: List[str],
+    time_data: jax.Array,
+    size: int,
+    partials: List[Tuple[str, str, Optional[str]]],
+) -> Tuple[DeviceBatch, List[str]]:
+    """Per-event trailing-window aggregates over the mesh: key-shuffle, then
+    each shard runs the SAME rolling kernels as SlidingWindowExecutor
+    (executors/ts_execs.py:638-686 — segmented bisection for window bounds,
+    prefix sums for sum/count, sparse-table range queries for min/max) over
+    its complete key groups.  Returns (per-event batch in per-shard
+    key-major order, partial output names)."""
+    from quokka_tpu.executors.ts_execs import (
+        _bisect_left_segmented,
+        _bisect_right_segmented,
+        _max_fill,
+        _min_fill,
+        _range_minmax,
+        _rows_from_segment_end,
+    )
+    from quokka_tpu.ops.asof import _seg_fill_forward
+
+    if not by:
+        raise MeshUnsupported("by-less sliding window on mesh")
+    for _, op, _ in partials:
+        if op not in ("sum", "count", "min", "max"):
+            raise MeshUnsupported(f"sliding window op {op!r} on mesh")
+    limbs = key_limbs(batch, by)
+    nlimb = len(limbs)
+    carried, slices = _flatten_cols(batch, batch.names)
+    ncarry = len(carried)
+    # value columns (incl. plan-pre temps) are already inside `carried`:
+    # index them there instead of shuffling the same data twice.  count has
+    # no input column (index -1, derived from validity inside the step).
+    val_idx = []
+    for (_, op, tmp) in partials:
+        if tmp is None:
+            val_idx.append(-1)
+        else:
+            lo, hi = next((lo, hi) for (n2, lo, hi) in slices if n2 == tmp)
+            assert hi == lo + 1, "sliding value columns are narrow numerics"
+            val_idx.append(lo)
+    pops = tuple(op for (_, op, _) in partials)
+    count_dtype = jnp.float64 if config.x64_enabled() else jnp.float32
+
+    def step(*arrs):
+        i = 0
+        lb = arrs[i:i + nlimb]; i += nlimb
+        t_in = arrs[i]; i += 1
+        ca = arrs[i:i + ncarry]; i += ncarry
+        valid = arrs[-1]
+        shuf, svalid = collective_hash_shuffle(
+            lb + (t_in,) + ca, valid, tuple(range(nlimb)), axis
+        )
+        slb = shuf[:nlimb]
+        st = shuf[nlimb]
+        sca = shuf[nlimb + 1:]
+        sva = tuple(
+            sca[j] if j >= 0 else svalid for j in val_idx
+        )
+        p = svalid.shape[0]
+        iota = jnp.arange(p, dtype=jnp.int32)
+        inv = (~svalid).astype(jnp.int32)
+        sorted_ = lax.sort([inv, *slb, st, iota], num_keys=2 + nlimb)
+        perm = sorted_[-1]
+        valid_s = sorted_[0] == 0
+        klimbs_s = sorted_[1:1 + nlimb]
+        t_s = sorted_[1 + nlimb]
+        key_changed = jnp.zeros(p, dtype=bool)
+        for l in klimbs_s:
+            key_changed = key_changed | (l != jnp.roll(l, 1))
+        seg_flag = key_changed | (iota == 0)
+        seg_start = _seg_fill_forward(jnp.where(seg_flag, iota, -1), seg_flag)
+        lo_t = t_s - size
+        left = _bisect_left_segmented(t_s, lo_t, seg_start, iota)
+        seg_end = iota + _rows_from_segment_end(iota, seg_flag, p)
+        right = _bisect_right_segmented(t_s, t_s, iota, seg_end)
+        outs = []
+        for (pname, op, _), varr in zip(partials, sva):
+            x_s = varr[perm]
+            if op in ("min", "max"):
+                fill = _max_fill(x_s.dtype) if op == "min" else _min_fill(x_s.dtype)
+                x = jnp.where(valid_s, x_s, fill)
+                outs.append(_range_minmax(x, left, right, op))
+                continue
+            if op == "count":
+                x = valid_s.astype(count_dtype)
+            else:
+                x = jnp.where(valid_s, x_s, 0)
+            cs = jnp.cumsum(x)
+            before = jnp.where(left > 0, cs[jnp.maximum(left - 1, 0)], 0)
+            outs.append(cs[right] - before)
+        out_ca = tuple(c[perm] for c in sca)
+        return out_ca + tuple(outs) + (valid_s,)
+
+    fn = jax.jit(
+        jax.shard_map(step, mesh=mesh, in_specs=P(axis), out_specs=P(axis),
+                      check_vma=False)
+    )
+    outs = fn(*limbs, time_data, *carried, batch.valid)
+    oca = outs[:ncarry]
+    pouts = outs[ncarry:-1]
+    ovalid = outs[-1]
+    cols = {}
+    for name, lo, hi in slices:
+        cols[name] = _rebuild_col(batch.columns[name], list(oca[lo:hi]))
+    out = DeviceBatch(cols, ovalid, None, None)
+    pnames = []
+    for (pname, _, _), arr in zip(partials, pouts):
+        out = out.with_column(pname, NumCol(arr, "f"))
+        pnames.append(pname)
+    return out, pnames
+
+
 # ---------------------------------------------------------------------------
 # mesh shift (shuffle by key -> per-shard sort + segment lag)
 # ---------------------------------------------------------------------------
@@ -677,26 +916,43 @@ class MeshExecutor:
             if isinstance(node, logical.ShiftNode) and not node.by:
                 raise MeshUnsupported("by-less shift on mesh")
             if isinstance(node, logical.WindowAggNode):
-                if not isinstance(
+                if isinstance(node.window, W.SessionWindow):
+                    if not node.by:
+                        raise MeshUnsupported(
+                            "by-less session window on mesh (global timeline)"
+                        )
+                elif isinstance(node.window, W.SlidingWindow):
+                    if not node.by:
+                        raise MeshUnsupported(
+                            "by-less sliding window on mesh (global timeline)"
+                        )
+                    if any(
+                        op not in ("sum", "count", "min", "max")
+                        for _, op, _ in node.plan.partials
+                    ):
+                        raise MeshUnsupported("sliding window op on mesh")
+                elif not isinstance(
                     node.window, (W.TumblingWindow, W.HoppingWindow)
                 ):
                     raise MeshUnsupported(
                         f"{type(node.window).__name__} on mesh"
                     )
-                hop = (
-                    node.window.size
-                    if isinstance(node.window, W.TumblingWindow)
-                    else node.window.hop
-                )
-                # the replication factor is a STATIC in-program blowup of the
-                # whole sharded dataset (the streaming executor pays it only
-                # per bounded batch) — cap it and let the engine take
-                # fine-hopped windows
-                if node.window.size // max(1, hop) > self.MAX_WINDOW_REPLICATION:
-                    raise MeshUnsupported(
-                        f"hopping replication factor {node.window.size // hop} "
-                        f"> {self.MAX_WINDOW_REPLICATION} on mesh"
+                else:
+                    hop = (
+                        node.window.size
+                        if isinstance(node.window, W.TumblingWindow)
+                        else node.window.hop
                     )
+                    # the replication factor is a STATIC in-program blowup of
+                    # the whole sharded dataset (the streaming executor pays
+                    # it only per bounded batch) — cap it and let the engine
+                    # take fine-hopped windows
+                    if node.window.size // max(1, hop) > self.MAX_WINDOW_REPLICATION:
+                        raise MeshUnsupported(
+                            f"hopping replication factor "
+                            f"{node.window.size // hop} "
+                            f"> {self.MAX_WINDOW_REPLICATION} on mesh"
+                        )
             if isinstance(node, logical.JoinNode) and node.how not in (
                 "inner", "left", "semi", "anti"
             ):
@@ -856,32 +1112,19 @@ class MeshExecutor:
         for name, e in plan.pre:
             b = b.with_column(name, evaluate_to_column(e, b))
         win = node.window
+        if isinstance(win, W.SessionWindow):
+            return self._session_window(node, b)
+        if isinstance(win, W.SlidingWindow):
+            return self._sliding_window(node, b)
         size = win.size
         hop = size if isinstance(win, W.TumblingWindow) else win.hop
         col = b.columns[node.time_col]
         if jnp.issubdtype(col.data.dtype, jnp.floating):
             raise MeshUnsupported("float time column in mesh window")
         t_kind, t_unit = col.kind, col.unit
-        tbase = 0
-        headroom = size + hop
-        need_rebase = col.hi is not None
-        mn = 0
-        if (need_rebase or col.data.dtype == jnp.int64) and b.count_valid():
-            mn = timewide.host_min_i64(col, b.valid)
-            if not need_rebase:
-                mx = timewide.host_max_i64(col, b.valid)
-                # narrow int64 keeps absolute coordinates while they fit
-                # int32 window arithmetic (parity with _TimeRebase)
-                need_rebase = mn <= -(2**31) or mx >= 2**31 - 1 - headroom
-        if need_rebase:
-            # same exact int32 rebase discipline as the streaming executors
-            # (_TimeRebase): base aligned to the hop so absolute window
-            # boundaries stay epoch-aligned.  Two device reductions + two
-            # scalar transfers — never a full-column host gather.
-            align = max(1, int(hop))
-            tbase = ((mn - 2**29) // align) * align
-            col = timewide.rebase_narrow(col, b.valid, tbase,
-                                         headroom=headroom)
+        # base aligned to the hop so absolute window boundaries stay
+        # epoch-aligned
+        col, tbase = _rebase_time(b, col, headroom=size + hop, align=hop)
         partials = [(p, op, tmp) for (p, op, tmp) in plan.partials]
         recombine = [op for (_, op) in plan.recombine]
         g = mesh_window_agg(
@@ -909,6 +1152,61 @@ class MeshExecutor:
         # honor the node's declared sorted_output (windows emit ordered by
         # their start — same contract as the streaming executors)
         return kernels.sort_batch(host.select(out_cols), ["window_start"], [False])
+
+    def _session_window(self, node: logical.WindowAggNode, b: DeviceBatch) -> DeviceBatch:
+        from quokka_tpu.ops import timewide
+
+        plan = node.plan
+        timeout = node.window.timeout
+        col = b.columns[node.time_col]
+        if jnp.issubdtype(col.data.dtype, jnp.floating):
+            raise MeshUnsupported("float time column in mesh session window")
+        t_kind, t_unit = col.kind, col.unit
+        col, tbase = _rebase_time(b, col, headroom=int(timeout) + 1)
+        partials = [(p, op, tmp) for (p, op, tmp) in plan.partials]
+        g = mesh_session_window(
+            self.mesh, self.axis, b, list(node.by), col.data, int(timeout),
+            partials,
+        )
+        host = _materialize(g)
+        host = host.rename(
+            {"__first_t": "session_start", "__last_t": "session_end"}
+        )
+        for c in ("session_start", "session_end"):
+            host = host.with_column(
+                c, timewide.add_base(host.columns[c].data, tbase, t_kind, t_unit)
+            )
+        for name, e in plan.finals:
+            host = host.with_column(name, evaluate_to_column(e, host))
+        seen, out_cols = set(), []
+        for c in node.by + ["session_start", "session_end"] + [
+            n for n, _ in plan.finals
+        ]:
+            if c not in seen:
+                seen.add(c)
+                out_cols.append(c)
+        return kernels.sort_batch(
+            host.select(out_cols), ["session_start"], [False]
+        )
+
+    def _sliding_window(self, node: logical.WindowAggNode, b: DeviceBatch) -> DeviceBatch:
+        from quokka_tpu.ops import timewide
+
+        plan = node.plan
+        size = int(node.window.size_before)
+        col = b.columns[node.time_col]
+        if jnp.issubdtype(col.data.dtype, jnp.floating):
+            raise MeshUnsupported("float time column in mesh sliding window")
+        col, _tbase = _rebase_time(b, col, headroom=size + 1)
+        # the ORIGINAL (absolute) time column rides in the carried set; only
+        # the kernel's window arithmetic uses the rebased copy
+        partials = [(p, op, tmp) for (p, op, tmp) in plan.partials]
+        out, _pnames = mesh_sliding_window(
+            self.mesh, self.axis, b, list(node.by), col.data, size, partials,
+        )
+        for name, e in plan.finals:
+            out = out.with_column(name, evaluate_to_column(e, out))
+        return out.select([c for c in node.schema if c in out.columns])
 
     def _join(self, sub, node: logical.JoinNode) -> DeviceBatch:
         probe = self._exec(sub, node.parents[0])

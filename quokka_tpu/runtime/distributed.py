@@ -226,9 +226,20 @@ def _coordinate(graph, cs, procs, owned, timeout, kill_after_inputs,
     t0 = time.time()
     started = set()
     dead: set = set()
+    dbg_at = t0
     while True:
         if time.time() - t0 > timeout:
             raise TimeoutError("distributed run exceeded timeout")
+        if os.environ.get("QUOKKA_DEBUG_COORD") and time.time() - dbg_at > 20:
+            dbg_at = time.time()
+            import sys
+
+            dst = dict(cs.tables.get("DST", {}))
+            ntt = {k: len(v) for k, v in cs.tables.get("NTT", {}).items()}
+            print(f"[coord] t={int(dbg_at - t0)}s DST={sorted(dst)} "
+                  f"NTT={ntt} dead={sorted(dead)} "
+                  f"hb={ {w: round(dbg_at - h, 1) for w, h in cs.heartbeats.items()} }",
+                  file=sys.stderr, flush=True)
         time.sleep(0.05)
         # merge newly registered worker cache addresses for peers to read
         addrs = dict(cs.get("worker_addrs") or {})

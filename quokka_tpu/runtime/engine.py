@@ -901,6 +901,16 @@ class Engine:
             deadline = getattr(task, "retry_deadline", None)
             if deadline is None:
                 deadline = task.retry_deadline = time.time() + 600.0
+            if os.environ.get("QUOKKA_DEBUG_REPLAY"):
+                now = time.time()
+                if now - getattr(task, "_dbg_at", 0) > 3.0:
+                    task._dbg_at = now
+                    import sys
+
+                    print(f"[replay-wait] ({a},{ch}) waiting on {name} "
+                          f"cache={self.cache.get(name) is not None} "
+                          f"hbq={self._hbq_contains(name)}",
+                          file=sys.stderr, flush=True)
             if time.time() > deadline:
                 raise RuntimeError(
                     f"tape input {name} for channel ({a},{ch}) is in "
@@ -947,13 +957,33 @@ class Engine:
             self.store.tset("EST", (a, ch), state_seq)
         if self.g.hbq is not None:
             hbq_names = self._hbq_names_for_target(a, ch)
-            specs = [
+            specs = {
                 name
                 for name in hbq_names
                 if name[0] in reqs
                 and name[1] in reqs[name[0]]
                 and name[2] >= reqs[name[0]][name[1]]
-            ]
+            }
+            # ... plus every input-produced object the producer already
+            # COMMITTED (GIT) past the restored frontier, whether or not a
+            # live HBQ lists it: a partition that lived only in the dead
+            # worker's cache/private HBQ is in nobody's listing, and without
+            # a spec nobody regenerates it — the consumer then waits forever
+            # while the recovered input task skips the seq as already-done
+            # (the deadlock this closes).  These names re-read from lineage
+            # in handle_replay_task (_recompute_object — the reference's
+            # 'new input requests', coordinator.py:274-334).  Bounded to
+            # GIT'd seqs: uncommitted seqs arrive from the live/recovered
+            # producer normally, and exec-produced inputs re-push via their
+            # producer's own tape replay.
+            for src_a, chans in reqs.items():
+                src_info = self.g.actors.get(src_a)
+                if src_info is None or src_info.kind != "input":
+                    continue
+                for sch, nxt in chans.items():
+                    for s in self.store.smembers("GIT", (src_a, sch)):
+                        if s >= nxt:
+                            specs.add((src_a, sch, s, a, src_a, ch))
             if specs:
                 self.store.ntt_push(a, ReplayTask(a, ch, sorted(specs)))
         self.store.ntt_push(a, ExecutorTask(a, ch, state_seq, out_seq, reqs))

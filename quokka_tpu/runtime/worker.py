@@ -238,6 +238,8 @@ class Worker(Engine):
             self.store.heartbeat(self.worker_id)
             time.sleep(0.05)
         last_hb = 0.0
+        dbg = os.environ.get("QUOKKA_DEBUG_WORKER")
+        dbg_at = time.time()
         actors = sorted(self.g.actors.values(), key=lambda a: (a.stage, a.id))
         while True:
             now = time.time()
@@ -253,6 +255,7 @@ class Worker(Engine):
                 return
             stage = self.store.get("STAGE", 0)
             progress = False
+            popped = []
             for info in actors:
                 chans = self.owned.get(info.id)
                 if not chans:
@@ -262,8 +265,24 @@ class Worker(Engine):
                 task = self.store.ntt_pop(info.id, list(chans))
                 if task is None:
                     continue
+                if dbg:
+                    popped.append((info.id, task.name,
+                                   getattr(task, "channel", None)))
                 progress |= self.dispatch_task(task)
-            if not progress:
+            if progress:
+                dbg_at = now
+            else:
+                if dbg and now - dbg_at > 5.0:
+                    dbg_at = now
+                    import sys
+
+                    print(
+                        f"[worker {self.worker_id}] stalled: owned="
+                        f"{ {a: sorted(c) for a, c in self.owned.items()} } "
+                        f"popped={popped} "
+                        f"cache={self.cache.size()} puttable={self.cache.puttable()}",
+                        file=sys.stderr, flush=True,
+                    )
                 time.sleep(0.01)
 
 

@@ -152,20 +152,58 @@ class TestMeshWindows:
             _norm(got, keys), _norm(exp, keys), check_dtype=False
         )
 
-    def test_session_falls_back_loudly(self, ticks):
+    def test_session_matches_engine(self, ticks):
         tp, qp, tdf, qdf = ticks
         plain, mesh = _contexts()
         t, _ = _streams(plain, tp, qp)
         exp = t.window_agg(
-            SessionWindow(50), "sum(size) as total", by="symbol"
+            SessionWindow(50), "sum(size) as total, count(*) as n, "
+            "avg(size) as mean_sz", by="symbol"
         ).collect()
         t, _ = _streams(mesh, tp, qp)
         got = t.window_agg(
-            SessionWindow(50), "sum(size) as total", by="symbol"
+            SessionWindow(50), "sum(size) as total, count(*) as n, "
+            "avg(size) as mean_sz", by="symbol"
         ).collect()
-        assert mesh.last_mesh_fallback is not None
-        assert "SessionWindow" in mesh.last_mesh_fallback
+        assert mesh.last_mesh_fallback is None, mesh.last_mesh_fallback
         keys = ["symbol", "session_start"]
+        exp, got = _norm(exp, keys), _norm(got, keys)
+        assert list(got.columns) == list(exp.columns)
+        pd.testing.assert_frame_equal(got, exp, check_dtype=False)
+
+    def test_sliding_matches_engine(self, ticks):
+        from quokka_tpu.windows import SlidingWindow
+
+        tp, qp, tdf, qdf = ticks
+        plain, mesh = _contexts()
+        t, _ = _streams(plain, tp, qp)
+        exp = t.window_agg(
+            SlidingWindow(5_000),
+            "sum(size) as roll_sum, count(*) as roll_n, max(size) as roll_max",
+            by="symbol",
+        ).collect()
+        t, _ = _streams(mesh, tp, qp)
+        got = t.window_agg(
+            SlidingWindow(5_000),
+            "sum(size) as roll_sum, count(*) as roll_n, max(size) as roll_max",
+            by="symbol",
+        ).collect()
+        assert mesh.last_mesh_fallback is None, mesh.last_mesh_fallback
+        keys = ["symbol", "time", "size"]
+        exp, got = _norm(exp, keys), _norm(got, keys)
+        assert list(got.columns) == list(exp.columns)
+        pd.testing.assert_frame_equal(got, exp, check_dtype=False)
+
+    def test_byless_session_falls_back_loudly(self, ticks):
+        tp, qp, tdf, qdf = ticks
+        plain, mesh = _contexts()
+        t, _ = _streams(plain, tp, qp)
+        exp = t.window_agg(SessionWindow(7), "count(*) as n").collect()
+        t, _ = _streams(mesh, tp, qp)
+        got = t.window_agg(SessionWindow(7), "count(*) as n").collect()
+        assert mesh.last_mesh_fallback is not None
+        assert "session" in mesh.last_mesh_fallback
+        keys = ["session_start"]
         pd.testing.assert_frame_equal(
             _norm(got, keys), _norm(exp, keys), check_dtype=False
         )
