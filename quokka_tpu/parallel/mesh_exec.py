@@ -525,6 +525,44 @@ def mesh_window_agg(
     return DeviceBatch(cols, fvalid, None, None)
 
 
+def _shuffle_sort_segments(limbs, tlimbs, carried, valid, axis: str):
+    """Shared preamble of every per-key ordered mesh kernel (session /
+    sliding / shift): key-hash shuffle -> per-shard stable sort by
+    (validity, key limbs, time limbs) -> segment-boundary flags.
+
+    Boundaries INCLUDE the valid->padding transition (the sort's validity
+    operand participates in the change detection): the all_to_all zero-fills
+    padding slots, so a trailing segment whose key limbs are genuinely
+    all-zero would otherwise absorb the padding rows and positional window
+    bounds (bisection past the segment end) would silently read them.
+
+    Returns (perm, valid_s, klimbs_s, tlimbs_s, shuffled_carried, seg_flag)
+    — carried arrays are SHUFFLED but not yet permuted (gather by `perm` as
+    needed)."""
+    nlimb = len(limbs)
+    nt = len(tlimbs)
+    shuf, svalid = collective_hash_shuffle(
+        tuple(limbs) + tuple(tlimbs) + tuple(carried), valid,
+        tuple(range(nlimb)), axis,
+    )
+    slb = shuf[:nlimb]
+    stl = shuf[nlimb:nlimb + nt]
+    sca = shuf[nlimb + nt:]
+    p = svalid.shape[0]
+    iota = jnp.arange(p, dtype=jnp.int32)
+    inv = (~svalid).astype(jnp.int32)
+    sorted_ = lax.sort([inv, *slb, *stl, iota], num_keys=1 + nlimb + nt)
+    perm = sorted_[-1]
+    valid_s = sorted_[0] == 0
+    klimbs_s = tuple(sorted_[1:1 + nlimb])
+    tlimbs_s = tuple(sorted_[1 + nlimb:1 + nlimb + nt])
+    changed = jnp.zeros(p, dtype=bool)
+    for l in (sorted_[0],) + klimbs_s:
+        changed = changed | (l != jnp.roll(l, 1))
+    seg_flag = changed | (iota == 0)
+    return perm, valid_s, klimbs_s, tlimbs_s, sca, seg_flag
+
+
 def _rebase_time(b: DeviceBatch, col, headroom: int, align: int = 1):
     """(narrow_col, tbase): exact int32 rebase when the time column is wide
     or holds int64 absolute values outside int32 window arithmetic — the
@@ -585,32 +623,14 @@ def mesh_session_window(
         ca = arrs[nlimb + 1:nlimb + 1 + ncarry]
         va = arrs[nlimb + 1 + ncarry:-1]
         valid = arrs[-1]
-        cols = lb + (t,) + ca + tuple(va)
-        if nlimb:
-            shuf, svalid = collective_hash_shuffle(
-                cols, valid, tuple(range(nlimb)), axis
-            )
-        else:
-            # by-less sessions: a single global timeline — only correct on
-            # one shard; the pre-walk rejects this shape
-            shuf, svalid = cols, valid
-        slb = shuf[:nlimb]
-        st = shuf[nlimb]
-        sca = shuf[nlimb + 1:nlimb + 1 + ncarry]
-        sva = shuf[nlimb + 1 + ncarry:]
-        p = svalid.shape[0]
-        iota = jnp.arange(p, dtype=jnp.int32)
-        inv = (~svalid).astype(jnp.int32)
-        sorted_ = lax.sort([inv, *slb, st, iota], num_keys=2 + nlimb)
-        perm = sorted_[-1]
-        valid_s = sorted_[0] == 0
-        klimbs_s = sorted_[1:1 + nlimb]
-        t_s = sorted_[1 + nlimb]
-        key_changed = jnp.zeros(p, dtype=bool)
-        for l in klimbs_s:
-            key_changed = key_changed | (l != jnp.roll(l, 1))
+        perm, valid_s, klimbs_s, (t_s,), shuffled, seg_flag = (
+            _shuffle_sort_segments(lb, (t,), ca + tuple(va), valid, axis)
+        )
+        sca = shuffled[:ncarry]
+        sva = shuffled[ncarry:]
+        p = valid_s.shape[0]
         gap = t_s - jnp.roll(t_s, 1)
-        new_sess = (iota == 0) | key_changed | (gap > timeout)
+        new_sess = seg_flag | (gap > timeout)
         sess_id = jnp.cumsum(new_sess.astype(jnp.int32)) - 1
         va_s = tuple(a[perm] for a in sva)
         ca_s = tuple(c[perm] for c in sca)
@@ -689,8 +709,15 @@ def mesh_sliding_window(
         if tmp is None:
             val_idx.append(-1)
         else:
+            col = batch.columns[tmp]
+            if isinstance(col, (StrCol, VecCol)) or col.hi is not None:
+                # wide ints span two limbs — the rolling kernels want one
+                # array; fall back to the streaming executor instead of
+                # crashing the query
+                raise MeshUnsupported(
+                    f"sliding window over non-narrow column {tmp!r} on mesh"
+                )
             lo, hi = next((lo, hi) for (n2, lo, hi) in slices if n2 == tmp)
-            assert hi == lo + 1, "sliding value columns are narrow numerics"
             val_idx.append(lo)
     pops = tuple(op for (_, op, _) in partials)
     count_dtype = jnp.float64 if config.x64_enabled() else jnp.float32
@@ -701,27 +728,12 @@ def mesh_sliding_window(
         t_in = arrs[i]; i += 1
         ca = arrs[i:i + ncarry]; i += ncarry
         valid = arrs[-1]
-        shuf, svalid = collective_hash_shuffle(
-            lb + (t_in,) + ca, valid, tuple(range(nlimb)), axis
+        perm, valid_s, klimbs_s, (t_s,), sca, seg_flag = (
+            _shuffle_sort_segments(lb, (t_in,), ca, valid, axis)
         )
-        slb = shuf[:nlimb]
-        st = shuf[nlimb]
-        sca = shuf[nlimb + 1:]
-        sva = tuple(
-            sca[j] if j >= 0 else svalid for j in val_idx
-        )
-        p = svalid.shape[0]
+        sva = tuple(sca[j] if j >= 0 else valid_s for j in val_idx)
+        p = valid_s.shape[0]
         iota = jnp.arange(p, dtype=jnp.int32)
-        inv = (~svalid).astype(jnp.int32)
-        sorted_ = lax.sort([inv, *slb, st, iota], num_keys=2 + nlimb)
-        perm = sorted_[-1]
-        valid_s = sorted_[0] == 0
-        klimbs_s = sorted_[1:1 + nlimb]
-        t_s = sorted_[1 + nlimb]
-        key_changed = jnp.zeros(p, dtype=bool)
-        for l in klimbs_s:
-            key_changed = key_changed | (l != jnp.roll(l, 1))
-        seg_flag = key_changed | (iota == 0)
         seg_start = _seg_fill_forward(jnp.where(seg_flag, iota, -1), seg_flag)
         lo_t = t_s - size
         left = _bisect_left_segmented(t_s, lo_t, seg_start, iota)
@@ -825,26 +837,12 @@ def mesh_shift(
         tl = arrs[i:i + ntime]; i += ntime
         ca = arrs[i:i + ncarry]; i += ncarry
         valid = arrs[i]
-        shuf, svalid = collective_hash_shuffle(
-            lb + tl + ca, valid, tuple(range(nlimb)), axis
+        perm, valid_s, _klimbs_s, _tl_s, sca, seg_flag = (
+            _shuffle_sort_segments(lb, tl, ca, valid, axis)
         )
-        slb = shuf[:nlimb]
-        stl = shuf[nlimb:nlimb + ntime]
-        sca = shuf[nlimb + ntime:]
         ssv = tuple(sca[j] for j in shift_idx)
-        p = svalid.shape[0]
+        p = valid_s.shape[0]
         iota = jnp.arange(p, dtype=jnp.int32)
-        inv = (~svalid).astype(jnp.int32)
-        sorted_ = lax.sort(
-            [inv, *slb, *stl, iota], num_keys=1 + nlimb + ntime
-        )
-        perm = sorted_[-1]
-        valid_s = sorted_[0] == 0
-        klimbs_s = sorted_[1:1 + nlimb]
-        key_changed = jnp.zeros(p, dtype=bool)
-        for l in klimbs_s:
-            key_changed = key_changed | (l != jnp.roll(l, 1))
-        seg_flag = key_changed | (iota == 0)
         seg_start = _seg_fill_forward(
             jnp.where(seg_flag, iota, -1), seg_flag
         )

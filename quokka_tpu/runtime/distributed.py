@@ -234,11 +234,14 @@ def _coordinate(graph, cs, procs, owned, timeout, kill_after_inputs,
             dbg_at = time.time()
             import sys
 
+            # snapshot everything before iterating: RPC handler threads
+            # mutate these tables concurrently
             dst = dict(cs.tables.get("DST", {}))
-            ntt = {k: len(v) for k, v in cs.tables.get("NTT", {}).items()}
+            ntt = {k: len(v) for k, v in dict(cs.tables.get("NTT", {})).items()}
+            hbs = dict(cs.heartbeats)
             print(f"[coord] t={int(dbg_at - t0)}s DST={sorted(dst)} "
                   f"NTT={ntt} dead={sorted(dead)} "
-                  f"hb={ {w: round(dbg_at - h, 1) for w, h in cs.heartbeats.items()} }",
+                  f"hb={ {w: round(dbg_at - h, 1) for w, h in hbs.items()} }",
                   file=sys.stderr, flush=True)
         time.sleep(0.05)
         # merge newly registered worker cache addresses for peers to read

@@ -194,6 +194,36 @@ class TestMeshWindows:
         assert list(got.columns) == list(exp.columns)
         pd.testing.assert_frame_equal(got, exp, check_dtype=False)
 
+    def test_sliding_zero_key_not_polluted_by_shuffle_padding(self):
+        # the all_to_all zero-fills padding slots; a trailing key whose
+        # limbs are genuinely all-zero (integer key 0) must not absorb them
+        # — positional window bounds would silently extend over future rows
+        from quokka_tpu.windows import SlidingWindow
+        import pyarrow as pa
+
+        r = np.random.default_rng(3)
+        n = 64
+        t = pa.table({
+            "time": np.arange(n, dtype=np.int64) * 100,
+            "k": np.zeros(n, dtype=np.int64),
+            "v": r.integers(1, 10, n).astype(np.int64),
+        })
+        plain, mesh = _contexts()
+        s = mesh.from_arrow_sorted(t, sorted_by="time")
+        got = s.window_agg(
+            SlidingWindow(5000), "sum(v) as sv", by="k"
+        ).collect()
+        assert mesh.last_mesh_fallback is None, mesh.last_mesh_fallback
+        d = t.to_pandas()
+        exp = [
+            int(d.v[(d.time >= d.time[i] - 5000) & (d.time <= d.time[i])].sum())
+            for i in range(n)
+        ]
+        got = got.sort_values("time").reset_index(drop=True)
+        np.testing.assert_array_equal(
+            got.sv.to_numpy().astype(np.int64), np.array(exp)
+        )
+
     def test_byless_session_falls_back_loudly(self, ticks):
         tp, qp, tdf, qdf = ticks
         plain, mesh = _contexts()
