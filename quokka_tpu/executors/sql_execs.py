@@ -12,10 +12,12 @@ from __future__ import annotations
 import copy
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
+import jax.numpy as jnp
+
 from quokka_tpu import config
 from quokka_tpu.ops import bridge, kernels
 from quokka_tpu.ops import join as join_ops
-from quokka_tpu.ops.batch import DeviceBatch
+from quokka_tpu.ops.batch import DeviceBatch, NumCol
 from quokka_tpu.ops.expr_compile import AggPlan, evaluate_predicate, evaluate_to_column
 from quokka_tpu.executors.base import Executor
 
@@ -122,11 +124,22 @@ class PartialAggExecutor(Executor):
     # compaction costs no blocking device round trip
     MERGE_EVERY = 8
 
+    # adaptive bailout: when the FIRST batch's group count is close to its
+    # row count (near-unique keys — e.g. TPC-H Q3's order-level group-by),
+    # per-batch partial sorts reduce almost nothing while costing the
+    # engine's dominant kernel; switch to PASSTHROUGH: map rows to partial
+    # FORM (pre-exprs + count columns, purely elementwise) and emit them
+    # immediately for the final agg to reduce.  DuckDB's partial-agg
+    # abandonment, TPU-style.  The decision depends only on batch 1's
+    # content, so tape replay reproduces it deterministically.
+    PASSTHROUGH_RATIO = 0.7
+
     def __init__(self, keys: Sequence[str], plan: AggPlan):
         self.keys = list(keys)
         self.plan = plan
         self.state: Optional[DeviceBatch] = None
         self._buffer: List[DeviceBatch] = []
+        self._passthrough: Optional[bool] = None  # undecided until batch 1
         from quokka_tpu.ops.fuse import FusedPartialAgg
 
         self._fused = FusedPartialAgg(self.keys, plan)
@@ -166,13 +179,48 @@ class PartialAggExecutor(Executor):
             parts.append(self.state)
         self.state = self._recombine(parts)
 
+    def _partial_form(self, batch: DeviceBatch) -> DeviceBatch:
+        """Raw rows -> partial-FORM rows (count columns = 1 per valid row,
+        value columns = the pre-expression inputs) with NO grouping: the
+        recombine ops downstream aggregate them exactly like grouped
+        partials."""
+        b = batch
+        for name, e in self.plan.pre:
+            b = b.with_column(name, evaluate_to_column(e, b))
+        cols = {k: b.columns[k] for k in self.keys}
+        for pname, op, tmp in self.plan.partials:
+            if op == "count":
+                cols[pname] = NumCol(b.valid.astype(jnp.int32), "i")
+            else:
+                cols[pname] = b.columns[tmp]
+        return DeviceBatch(cols, b.valid, b.nrows, None, b.nrows_dev)
+
     def execute(self, batches, stream_id, channel):
+        outs = []
         for b in batches:
-            if b is not None:
-                self._buffer.append(self._partial(b))
+            if b is None:
+                continue
+            if self._passthrough:
+                outs.append(self._partial_form(b))
+                continue
+            g = self._partial(b)
+            if self._passthrough is None:
+                rows = b.count_valid()
+                # tiny batches can't decide (a selective first chunk must
+                # not pin the mode for a stream of millions of rows): stay
+                # undecided until a big-enough batch arrives — still
+                # deterministic under tape replay (content-driven)
+                if rows > 4096:
+                    groups = g.count_valid()
+                    self._passthrough = (
+                        groups >= self.PASSTHROUGH_RATIO * rows
+                    )
+            self._buffer.append(g)
         if len(self._buffer) >= self.MERGE_EVERY:
             self._merge()
-        return None
+        if not outs:
+            return None
+        return bridge.concat_batches(outs) if len(outs) > 1 else outs[0]
 
     def done(self, channel):
         self._merge()
@@ -183,10 +231,16 @@ class PartialAggExecutor(Executor):
 
     def checkpoint(self):
         self._merge()  # state-folding is semantics-preserving
-        return None if self.state is None else bridge.device_to_arrow(self.state)
+        table = None if self.state is None else bridge.device_to_arrow(self.state)
+        return {"passthrough": self._passthrough, "state": table}
 
     def restore(self, state):
         self._buffer = []
+        if isinstance(state, dict):
+            self._passthrough = state.get("passthrough")
+            state = state.get("state")
+        else:
+            self._passthrough = None  # legacy checkpoint blob: re-decide
         self.state = None if state is None else bridge.arrow_to_device(state)
 
 
@@ -211,6 +265,10 @@ class FinalAggExecutor(Executor):
         self._buffer: List[DeviceBatch] = []
 
     MERGE_EVERY = 32  # incoming partials are small (post-shuffle compacted)
+    # a passthrough upstream (PartialAggExecutor bailout) ships FULL-SIZE row
+    # batches instead of compacted partials: also fold on accumulated padded
+    # rows so the buffer can't hold 32 raw batches on device at once
+    MERGE_ROWS = 1 << 21
 
     def _merge(self) -> None:
         if not self._buffer:
@@ -226,7 +284,10 @@ class FinalAggExecutor(Executor):
 
     def execute(self, batches, stream_id, channel):
         self._buffer.extend(b for b in batches if b is not None)
-        if len(self._buffer) >= self.MERGE_EVERY:
+        if (
+            len(self._buffer) >= self.MERGE_EVERY
+            or sum(p.padded_len for p in self._buffer) >= self.MERGE_ROWS
+        ):
             self._merge()
         return None
 

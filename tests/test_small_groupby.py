@@ -128,3 +128,104 @@ class TestSmallGroupby:
         exp = oracle(t, ["flag"])
         np.testing.assert_allclose(got.sv.to_numpy(), exp.sv.to_numpy(), rtol=1e-9)
         assert got.n.tolist() == exp.n.tolist()
+
+
+class TestAdaptivePartialAgg:
+    """Near-unique group keys flip PartialAggExecutor into passthrough
+    (partial-FORM rows, no per-batch sort); results must be identical."""
+
+    def _data(self, n=40_000, uniq=True, seed=5):
+        import numpy as np
+        import pyarrow as pa
+
+        r = np.random.default_rng(seed)
+        keys = (
+            np.arange(n, dtype=np.int64) if uniq
+            else r.integers(0, 50, n).astype(np.int64)
+        )
+        return pa.table({
+            "k": r.permutation(keys),
+            "v": r.uniform(0, 10, n).round(4),
+            "w": r.integers(1, 9, n).astype(np.int64),
+        })
+
+    def _q(self, ctx, t, batch_rows):
+        from quokka_tpu import logical
+        from quokka_tpu.dataset.readers import InputArrowDataset
+
+        src = ctx.new_stream(logical.SourceNode(
+            InputArrowDataset(t, batch_rows=batch_rows), list(t.column_names)
+        ))
+        return (
+            src.groupby("k")
+            .agg_sql("sum(v) as sv, count(*) as n, avg(w) as aw, max(v) as mv")
+            .collect()
+            .sort_values("k")
+            .reset_index(drop=True)
+        )
+
+    def test_unique_keys_match_pandas(self):
+        import numpy as np
+
+        from quokka_tpu import QuokkaContext
+        from quokka_tpu.executors.sql_execs import PartialAggExecutor
+
+        t = self._data(uniq=True)
+        d = t.to_pandas()
+        ctx = QuokkaContext(io_channels=2, exec_channels=2)
+        got = self._q(ctx, t, batch_rows=8192)
+        exp = (
+            d.groupby("k")
+            .agg(sv=("v", "sum"), n=("v", "size"), aw=("w", "mean"),
+                 mv=("v", "max"))
+            .reset_index()
+            .sort_values("k")
+            .reset_index(drop=True)
+        )
+        assert len(got) == len(exp)
+        np.testing.assert_array_equal(got.k.to_numpy(), exp.k.to_numpy())
+        np.testing.assert_allclose(got.sv.to_numpy(), exp.sv.to_numpy(), rtol=1e-9)
+        np.testing.assert_array_equal(got.n.to_numpy(), exp.n.to_numpy())
+        np.testing.assert_allclose(got.aw.to_numpy(), exp.aw.to_numpy(), rtol=1e-9)
+        np.testing.assert_allclose(got.mv.to_numpy(), exp.mv.to_numpy(), rtol=1e-9)
+
+    def test_passthrough_decision(self):
+        import pyarrow as pa
+
+        from quokka_tpu.ops import bridge
+        from quokka_tpu.ops.expr_compile import plan_aggregation
+        from quokka_tpu.executors.sql_execs import PartialAggExecutor
+        from quokka_tpu.sqlparse import parse_select_list
+
+        plan = plan_aggregation(parse_select_list(
+            "sum(v) as sv, count(*) as n"))
+        # near-unique keys -> passthrough after batch 1
+        t = self._data(n=10_000, uniq=True)
+        ex = PartialAggExecutor(["k"], plan)
+        b = bridge.arrow_to_device(t)
+        assert ex.execute([b], 0, 0) is None  # batch 1 always aggregates
+        assert ex._passthrough is True
+        out = ex.execute([b], 0, 0)  # batch 2 passes through immediately
+        assert out is not None and out.count_valid() == 10_000
+        # low-cardinality keys -> stays aggregating
+        t2 = self._data(n=10_000, uniq=False)
+        ex2 = PartialAggExecutor(["k"], plan)
+        b2 = bridge.arrow_to_device(t2)
+        ex2.execute([b2], 0, 0)
+        assert ex2._passthrough is False
+        assert ex2.execute([b2], 0, 0) is None
+
+    def test_checkpoint_carries_decision(self):
+        from quokka_tpu.ops import bridge
+        from quokka_tpu.ops.expr_compile import plan_aggregation
+        from quokka_tpu.executors.sql_execs import PartialAggExecutor
+        from quokka_tpu.sqlparse import parse_select_list
+
+        plan = plan_aggregation(parse_select_list("count(*) as n"))
+        t = self._data(n=10_000, uniq=True)
+        ex = PartialAggExecutor(["k"], plan)
+        ex.execute([bridge.arrow_to_device(t)], 0, 0)
+        snap = ex.checkpoint()
+        ex2 = PartialAggExecutor(["k"], plan)
+        ex2.restore(snap)
+        assert ex2._passthrough is True
