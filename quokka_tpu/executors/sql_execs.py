@@ -560,11 +560,7 @@ class BuildProbeJoinExecutor(Executor):
                     "left join: build side produced no batches and no plan "
                     "schema was provided (pass out_schema=)"
                 )
-            import jax.numpy as jnp
-
-            from quokka_tpu.ops.batch import NumCol
-
-            outs = []
+                outs = []
             for probe in live:
                 payload = [c for c in self.out_schema if c not in probe.columns]
                 b = probe
@@ -767,11 +763,8 @@ class SortExecutor(Executor):
             _drop_spill_dir(self._dir)
 
     def _merge_runs(self):
-        import jax.numpy as jnp
         import numpy as np
         import pyarrow as pa
-
-        from quokka_tpu.ops.batch import NumCol
 
         readers = [pa.ipc.open_file(p) for p in self.runs]
         n_chunks = [r.num_record_batches for r in readers]

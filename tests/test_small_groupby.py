@@ -168,7 +168,6 @@ class TestAdaptivePartialAgg:
         import numpy as np
 
         from quokka_tpu import QuokkaContext
-        from quokka_tpu.executors.sql_execs import PartialAggExecutor
 
         t = self._data(uniq=True)
         d = t.to_pandas()
