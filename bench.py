@@ -40,6 +40,9 @@ import time
 BASELINE_GBPS_PER_WORKER = 0.654
 # blocking-runtime per-query averages (seconds, SF100, 4 workers)
 REF_SECONDS_SF100_4W = {"q1": 9.559, "q3": 14.579, "q5": 22.081}
+# asof join + sum: 1.3B quotes x 250M trades in ~35 s on 4 workers
+# (BASELINE.md / blog/orderedstreams.md:51) => rows/s per worker
+REF_ASOF_ROWS_PER_S_PER_WORKER = (1.3e9 + 2.5e8) / 35.0 / 4.0
 
 SF = float(os.environ.get("QUOKKA_BENCH_SF", "1.0"))
 CACHE = os.environ.get("QUOKKA_BENCH_CACHE", "/tmp/quokka_tpu_bench")
@@ -49,9 +52,15 @@ MEASURE_TIMEOUT = int(os.environ.get("QUOKKA_BENCH_TIMEOUT", "2400"))
 
 BENCH_TABLES = ["lineitem", "orders", "customer", "supplier", "nation", "region"]
 
+# tick-backtest scale (rows), ~the reference's 5.2:1 quote:trade ratio
+ASOF_QUOTES = int(6_000_000 * SF)
+ASOF_TRADES = int(1_150_000 * SF)
+ASOF_SYMBOLS = 100
+
 
 def ensure_data():
-    """Generate-and-cache every table Q1/Q3/Q5 touch; returns {name: path}."""
+    """Generate-and-cache every table Q1/Q3/Q5 touch plus the tick-backtest
+    trades/quotes; returns {name: path}."""
     os.makedirs(CACHE, exist_ok=True)
     paths = {
         t: os.path.join(CACHE, f"{t}_sf{SF}.parquet") for t in BENCH_TABLES
@@ -66,6 +75,28 @@ def ensure_data():
         for t, p in paths.items():
             if not os.path.exists(p):
                 pq.write_table(tables[t], p, row_group_size=1 << 20)
+    for t, n_rows, cols in (
+        ("trades", ASOF_TRADES, "t"),
+        ("quotes", ASOF_QUOTES, "q"),
+    ):
+        p = os.path.join(CACHE, f"{t}_sf{SF}.parquet")
+        paths[t] = p
+        if not os.path.exists(p):
+            import numpy as np
+            import pyarrow as pa
+            import pyarrow.parquet as pq
+
+            r = np.random.default_rng(7 if cols == "t" else 8)
+            span = 86_400_000  # one trading day in ms
+            times = np.sort(r.integers(0, span, n_rows)).astype(np.int64)
+            syms = np.array([f"S{i:03d}" for i in range(ASOF_SYMBOLS)])
+            table = {"time": times,
+                     "symbol": syms[r.integers(0, ASOF_SYMBOLS, n_rows)]}
+            if cols == "t":
+                table["size"] = r.integers(1, 500, n_rows).astype(np.int64)
+            else:
+                table["bid"] = r.uniform(10, 500, n_rows).round(3)
+            pq.write_table(pa.table(table), p, row_group_size=1 << 20)
     return paths
 
 
@@ -201,6 +232,26 @@ def run_q5(paths):
     return dt
 
 
+def run_asof(paths):
+    """Tick backtest core: asof-join trades<-quotes by symbol + grouped sum
+    (BASELINE.json config 4; the reference's apps/time-series headline —
+    blog/orderedstreams.md:51)."""
+    ctx = _ctx()
+    t = ctx.read_sorted_parquet(paths["trades"], sorted_by="time")
+    q = ctx.read_sorted_parquet(paths["quotes"], sorted_by="time")
+    qry = (
+        t.join_asof(q, on="time", by="symbol")
+        .with_columns_sql("bid * size as notional")
+        .groupby("symbol")
+        .agg_sql("sum(notional) as total, count(*) as n")
+    )
+    t0 = time.time()
+    df = qry.collect()
+    dt = time.time() - t0
+    assert 0 < len(df) <= ASOF_SYMBOLS, df
+    return dt
+
+
 QUERIES = {"q1": run_q1, "q3": run_q3, "q5": run_q5}
 
 
@@ -261,6 +312,41 @@ def measure(paths):
                            **per_query[qname]},
             }))
         sys.stdout.flush()
+    # tick backtest: rows/s per chip vs the reference's per-worker rate.
+    # The section carries its OWN alarm so an asof compile overrun/wedge
+    # skips this one line instead of blowing the child's overall timeout
+    # and discarding the already-printed TPC-H lines of record.
+    import signal
+
+    def _asof_alarm(sig, frm):
+        raise TimeoutError("asof benchmark section timed out")
+
+    old_handler = signal.signal(signal.SIGALRM, _asof_alarm)
+    signal.alarm(int(os.environ.get("QUOKKA_BENCH_ASOF_TIMEOUT", "600")))
+    try:
+        run_asof(paths)  # compile warm-up
+        asof_times = sorted(run_asof(paths) for _ in range(3))
+        asof_rows = ASOF_TRADES + ASOF_QUOTES
+        asof_rps = asof_rows / asof_times[0]
+        asof_speedup = asof_rps / REF_ASOF_ROWS_PER_S_PER_WORKER
+        print(json.dumps({
+            "metric": "tick_asof_rows_per_s_per_chip",
+            "value": round(asof_rps),
+            "unit": "rows/s",
+            "vs_baseline": round(asof_speedup, 4),
+            "detail": {
+                "sf": SF, "platform": platform,
+                "trades": ASOF_TRADES, "quotes": ASOF_QUOTES,
+                "seconds_all": [round(x, 4) for x in asof_times],
+                "ref_rows_per_s_per_worker": round(REF_ASOF_ROWS_PER_S_PER_WORKER),
+            },
+        }))
+        sys.stdout.flush()
+    except Exception as e:  # noqa: BLE001 — the TPC-H lines must survive
+        sys.stderr.write(f"bench: asof section skipped: {e}\n")
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old_handler)
     geomean = math.exp(
         sum(math.log(v["speedup_vs_ref_per_chip"]) for v in per_query.values())
         / len(per_query)

@@ -145,6 +145,16 @@ class SortedAsofExecutor(Executor):
     timestamp; the quote buffer is pruned to the last quote per key below the
     frontier plus everything above it."""
 
+    # large streams flush in chunks of at least this many ready trades (the
+    # joint sort per flush covers the whole quote buffer)
+    MIN_FLUSH_ROWS = 1 << 19
+
+    # prune the quote buffer only past this many padded rows: pruning costs
+    # a full-buffer sort, so below the valve it is pure overhead — keeping
+    # already-matched quotes around is semantically harmless for backward
+    # asof (they simply lose to later quotes)
+    PRUNE_ROWS = 1 << 23
+
     def __init__(self, left_on: str, right_on: str, left_by, right_by,
                  suffix: str = "_2", keep_unmatched: bool = False,
                  direction: str = "backward"):
@@ -159,28 +169,57 @@ class SortedAsofExecutor(Executor):
         self.keep_unmatched = keep_unmatched
         self.trades: Optional[DeviceBatch] = None
         self.quotes: Optional[DeviceBatch] = None
+        # incoming batches buffer in LISTS; the quote buffer concats only
+        # when a flush actually runs a join (the flush-throttle gates pass
+        # on watermarks + running VALID counts first) — eager per-append
+        # concats of a growing buffer were the executor's top cost at scale
+        self._t_parts: List[DeviceBatch] = []
+        self._q_parts: List[DeviceBatch] = []
+        # running valid-row counts: gate decisions key on CONTENT (counts),
+        # never on padded lengths — padding is not preserved across
+        # checkpoint/restore, and a padded-length gate would flip emission
+        # decisions during tape replay (the engine asserts re_emitted ==
+        # emitted)
+        self._t_rows = 0
+        self._q_rows = 0
         self.q_watermark: Optional[float] = None
         self.t_watermark: Optional[float] = None
         self.q_done = False
         self.payload: Optional[List[str]] = None
         self.rename: Dict[str, str] = {}
 
-    def _append(self, buf, batches):
-        live = [b for b in batches if b is not None and b.count_valid() > 0]
-        if not live:
-            return buf
-        parts = ([buf] if buf is not None and buf.count_valid() > 0 else []) + live
-        return bridge.concat_batches(parts) if len(parts) > 1 else parts[0]
+    def _materialize_trades(self) -> None:
+        if self._t_parts:
+            parts = ([self.trades] if self.trades is not None else []) + self._t_parts
+            self._t_parts = []
+            self.trades = (
+                bridge.concat_batches(parts) if len(parts) > 1 else parts[0]
+            )
+
+    def _materialize_quotes(self) -> None:
+        if self._q_parts:
+            parts = ([self.quotes] if self.quotes is not None else []) + self._q_parts
+            self._q_parts = []
+            self.quotes = (
+                bridge.concat_batches(parts) if len(parts) > 1 else parts[0]
+            )
 
     def execute(self, batches, stream_id, channel):
+        live = [b for b in batches if b is not None and b.count_valid() > 0]
         if stream_id == 1:
-            self.quotes = self._append(self.quotes, batches)
-            if self.quotes is not None:
-                self.q_watermark = _time_max(self.quotes, self.right_on)
+            for b in live:
+                self._q_parts.append(b)
+                self._q_rows += b.count_valid()
+                wm = _time_max(b, self.right_on)
+                if self.q_watermark is None or wm > self.q_watermark:
+                    self.q_watermark = wm
             return self._flush()
-        self.trades = self._append(self.trades, batches)
-        if self.trades is not None:
-            self.t_watermark = _time_max(self.trades, self.left_on)
+        for b in live:
+            self._t_parts.append(b)
+            self._t_rows += b.count_valid()
+            wm = _time_max(b, self.left_on)
+            if self.t_watermark is None or wm > self.t_watermark:
+                self.t_watermark = wm
         return self._flush()
 
     def source_done(self, stream_id, channel):
@@ -201,14 +240,16 @@ class SortedAsofExecutor(Executor):
             self.payload = [self.rename.get(c, c) for c in payload]
 
     def _flush(self, final: bool = False):
+        self._materialize_trades()
         if self.trades is None or self.trades.count_valid() == 0:
             return None
-        if self.quotes is None:
+        if self.quotes is None and not self._q_parts:
             if self.q_done:
                 out, self.trades = self.trades, None
                 return out if self.keep_unmatched else None
             return None
         if self.direction == "forward":
+            self._materialize_quotes()
             return self._flush_forward()
         if self.q_done:
             safe = float("inf")
@@ -221,11 +262,25 @@ class SortedAsofExecutor(Executor):
         # contain quotes at exactly `safe` (ties must win per backward-asof)
         op = "<=" if safe == float("inf") else "<"
         ready_mask = self.trades.valid & _cmp_time(tcol, safe, op)
+        nready = int(jnp.sum(ready_mask.astype(jnp.int32)))
+        if nready == 0:
+            return None
+        # each flush pays one joint sort of (ready + ENTIRE quote buffer) —
+        # at scale, emitting per event makes that quadratic-ish.  Large
+        # streams accumulate ready trades into big flushes; small streams
+        # (below the threshold) keep per-event emission.  Gates key on
+        # running VALID counts (content-deterministic across replay); the
+        # quote buffer has not been concatenated yet when they bail
+        big = self._t_rows + self._q_rows > 4 * self.MIN_FLUSH_ROWS
+        if big and not self.q_done and nready < self.MIN_FLUSH_ROWS:
+            return None
+        self._materialize_quotes()
         ready = kernels.compact(kernels.apply_mask(self.trades, ready_mask))
         if ready.count_valid() == 0:
             return None
         rest = kernels.compact(kernels.apply_mask(self.trades, self.trades.valid & ~ready_mask))
         self.trades = rest if rest.count_valid() > 0 else None
+        self._t_rows = 0 if self.trades is None else self.trades.count_valid()
         self._setup_payload(ready.names)
         quotes = self.quotes.rename(self.rename) if self.rename else self.quotes
         out = asof_ops.asof_join(
@@ -236,11 +291,15 @@ class SortedAsofExecutor(Executor):
         if not self.keep_unmatched:
             out = kernels.apply_mask(out, matched.data)
         # prune only below what BOTH streams have passed: future trades can
-        # still arrive below the quote watermark when quotes run ahead
-        prune_to = safe
-        if self.t_watermark is not None:
-            prune_to = min(prune_to, self.t_watermark)
-        self._prune_quotes(prune_to)
+        # still arrive below the quote watermark when quotes run ahead —
+        # and only past the memory valve (pruning costs a full-buffer sort;
+        # the count-based gate keys on content, so replay reproduces it)
+        if self.quotes is not None and self._q_rows >= self.PRUNE_ROWS:
+            prune_to = safe
+            if self.t_watermark is not None:
+                prune_to = min(prune_to, self.t_watermark)
+            self._prune_quotes(prune_to)
+            self._q_rows = 0 if self.quotes is None else self.quotes.count_valid()
         return out
 
     def _flush_forward(self):
@@ -263,6 +322,8 @@ class SortedAsofExecutor(Executor):
             )
             self.trades = None
             self.quotes = None
+            self._t_rows = 0
+            self._q_rows = 0
             return result if result.count_valid() > 0 else None
         tcol = self.trades.columns[self.left_on]
         unmatched = self.trades.valid & ~matched
@@ -275,6 +336,7 @@ class SortedAsofExecutor(Executor):
             kernels.apply_mask(self.trades, self.trades.valid & ~emit)
         )
         self.trades = rest if rest.count_valid() > 0 else None
+        self._t_rows = 0 if self.trades is None else self.trades.count_valid()
         # prune quotes below every retained and every possible future trade —
         # forward matches need quote time >= trade time, so those can't match
         bound = self.t_watermark
@@ -286,6 +348,7 @@ class SortedAsofExecutor(Executor):
             keep = q.valid & _cmp_time(q.columns[self.right_on], bound, ">=")
             pruned = kernels.compact(kernels.apply_mask(q, keep))
             self.quotes = pruned if pruned.count_valid() > 0 else None
+            self._q_rows = 0 if self.quotes is None else self.quotes.count_valid()
         return result if result.count_valid() > 0 else None
 
     def _prune_quotes(self, safe):
@@ -335,6 +398,8 @@ class SortedAsofExecutor(Executor):
         self.quotes = pruned if pruned.count_valid() > 0 else None
 
     def checkpoint(self):
+        self._materialize_trades()  # fold pending parts into the buffers
+        self._materialize_quotes()
         return {
             "trades": None if self.trades is None else bridge.device_to_arrow(self.trades),
             "quotes": None if self.quotes is None else bridge.device_to_arrow(self.quotes),
@@ -344,10 +409,14 @@ class SortedAsofExecutor(Executor):
         }
 
     def restore(self, state):
+        self._t_parts = []
+        self._q_parts = []
         if state is None:
             return
         self.trades = None if state["trades"] is None else bridge.arrow_to_device(state["trades"])
         self.quotes = None if state["quotes"] is None else bridge.arrow_to_device(state["quotes"])
+        self._t_rows = 0 if self.trades is None else self.trades.count_valid()
+        self._q_rows = 0 if self.quotes is None else self.quotes.count_valid()
         self.q_watermark = state["q_watermark"]
         self.t_watermark = state.get("t_watermark")
         self.q_done = state["q_done"]
