@@ -339,7 +339,8 @@ class QuokkaContext:
             try:
                 table = MeshExecutor(self.mesh).run_to_arrow(sub, sink_id)
                 ds = ResultDataset()
-                ds.append(0, table)
+                if table is not None:  # None = legitimately empty result
+                    ds.append(0, table)
                 self.last_mesh_fallback = None
                 return ds
             except MeshUnsupported as e:

@@ -47,6 +47,12 @@ class MeshUnsupported(Exception):
     """Plan shape the mesh path doesn't cover — caller falls back."""
 
 
+class _EmptyResult(Exception):
+    """A root stateful operator legitimately produced zero rows: the collect
+    is empty — NOT a fallback (re-running the plan on the engine would
+    duplicate any executor side effects)."""
+
+
 # ---------------------------------------------------------------------------
 # sharding helpers
 # ---------------------------------------------------------------------------
@@ -897,6 +903,7 @@ class MeshExecutor:
         logical.MapNode, logical.DistinctNode, logical.AggNode,
         logical.JoinNode, logical.SortNode, logical.TopKNode, logical.SinkNode,
         logical.AsofJoinNode, logical.WindowAggNode, logical.ShiftNode,
+        logical.StatefulNode,
     )
     MAX_WINDOW_REPLICATION = 16
 
@@ -913,6 +920,13 @@ class MeshExecutor:
                 raise MeshUnsupported("by-less asof join on mesh")
             if isinstance(node, logical.ShiftNode) and not node.by:
                 raise MeshUnsupported("by-less shift on mesh")
+            if type(node) is logical.StatefulNode and len(node.parents) != 1:
+                # generic stateful operators (CEP, user stateful_transform)
+                # run as a single-device tail over the SPMD upstream — only
+                # the single-input shape maps onto that
+                raise MeshUnsupported(
+                    "multi-input stateful operator on mesh"
+                )
             if isinstance(node, logical.WindowAggNode):
                 if isinstance(node.window, W.SessionWindow):
                     if not node.by:
@@ -958,7 +972,11 @@ class MeshExecutor:
         node = sub[sink_id]
         if isinstance(node, logical.SinkNode):
             sink_id = node.parents[0]
-        out = self._exec(sub, sink_id)
+        self._root_nid = sink_id
+        try:
+            out = self._exec(sub, sink_id)
+        except _EmptyResult:
+            return None  # legitimately empty result set
         return bridge.device_to_arrow(out)  # gathers shards host-side
 
     def _compact_reshard(self, batch: DeviceBatch) -> DeviceBatch:
@@ -1015,6 +1033,49 @@ class MeshExecutor:
             if isinstance(node, logical.TopKNode):
                 return kernels.top_k(b, node.by, node.k, node.descending)
             return kernels.sort_batch(b, node.by, node.descending)
+        if type(node) is logical.StatefulNode:
+            # generic stateful operator (CEP pattern recognition, user
+            # stateful_transform): the upstream plan stays SPMD; the
+            # operator itself runs once over the materialized result — the
+            # same single-device-tail discipline as root sort/top-k, and
+            # semantically identical to exec_channels=1 on the engine
+            b = _materialize(self._exec(sub, node.parents[0]))
+            parent_sorted = getattr(sub[node.parents[0]], "sorted_by", None)
+            if parent_sorted:
+                # shuffling upstream ops leave shard-major order; restore
+                # the time-order contract sorted stateful executors get
+                # from the engine's ordered delivery
+                b = kernels.sort_batch(b, list(parent_sorted),
+                                       [False] * len(parent_sorted))
+            executor = node.executor_factory()
+            parts = []
+            # full engine executor-driving contract: execute, then the
+            # source-exhausted hook, then done — each may emit
+            out = executor.execute([b], 0, 0)
+            if out is not None:
+                parts.append(out)
+            sd = (
+                executor.source_done(0, 0)
+                if hasattr(executor, "source_done") else None
+            )
+            if sd is not None:
+                parts.append(sd)
+            fin = executor.done(0)
+            if fin is not None:
+                if isinstance(fin, DeviceBatch):
+                    parts.append(fin)
+                else:
+                    parts.extend(x for x in fin if x is not None)
+            if not parts:
+                if nid == self._root_nid:
+                    # a legitimately empty result (e.g. no CEP matches):
+                    # surface as the empty collect, not an engine re-run
+                    raise _EmptyResult()
+                # mid-plan empties would need typed empty batches; fall
+                # back (rare: an empty stateful feeding further operators)
+                raise MeshUnsupported("empty mid-plan stateful output")
+            out = parts[0] if len(parts) == 1 else bridge.concat_batches(parts)
+            return out.select([c for c in node.schema if c in out.columns])
         raise MeshUnsupported(f"node {type(node).__name__} on mesh")
 
     def _source(self, node: logical.SourceNode) -> DeviceBatch:

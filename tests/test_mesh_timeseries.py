@@ -305,6 +305,52 @@ class TestMeshShift:
         )
 
 
+class TestMeshCEP:
+    def test_pattern_recognize_matches_engine(self):
+        # CEP runs as a single-device tail over the SPMD upstream (the host
+        # NFA walk has no shard_map form); results must equal the engine
+        import pyarrow as pa
+
+        r = np.random.default_rng(9)
+        n = 2000
+        t = pa.table({
+            "time": np.arange(n, dtype=np.int64),
+            "sym": np.array(["A", "B", "C"])[r.integers(0, 3, n)],
+            "px": r.uniform(5, 15, n).round(2),
+        })
+        events = [("low", "px < 7"), ("rise", "px > low.px + 5")]
+        plain, mesh = _contexts()
+        s = plain.from_arrow_sorted(t, sorted_by="time")
+        exp = s.pattern_recognize(events, within=50, by="sym").collect()
+        s = mesh.from_arrow_sorted(t, sorted_by="time")
+        got = s.pattern_recognize(events, within=50, by="sym").collect()
+        assert mesh.last_mesh_fallback is None, mesh.last_mesh_fallback
+        keys = ["sym", "low_time"]
+        exp, got = _norm(exp, keys), _norm(got, keys)
+        assert list(got.columns) == list(exp.columns)
+        pd.testing.assert_frame_equal(got, exp, check_dtype=False)
+
+    def test_empty_match_set_is_empty_not_fallback(self):
+        import pyarrow as pa
+
+        t = pa.table({
+            "time": np.arange(50, dtype=np.int64),
+            "sym": ["A"] * 50,
+            "px": np.full(50, 10.0),
+        })
+        plain, mesh = _contexts()
+        s = mesh.from_arrow_sorted(t, sorted_by="time")
+        got = s.pattern_recognize(
+            [("low", "px < 1"), ("rise", "px > low.px + 5")],
+            within=10, by="sym",
+        ).collect()
+        # a legitimately empty match set collects as an empty frame WITHOUT
+        # re-running the whole plan on the engine
+        assert mesh.last_mesh_fallback is None, mesh.last_mesh_fallback
+        assert len(got) == 0
+        assert list(got.columns) == ["sym", "low_time", "rise_time"]
+
+
 EPOCH_NS = 1_600_000_000_000_000_000  # wide int64: exercises the two-limb path
 
 
