@@ -56,6 +56,24 @@ def bucket_size(n: int) -> int:
 
 
 # ---------------------------------------------------------------------------
+# Kernel strategy
+# ---------------------------------------------------------------------------
+
+
+def use_hash_tables() -> bool:
+    """Whether equality-keyed kernels (group-by, PK-join probe) use the
+    device hash table (ops/hashtable.py) instead of the sort-based paths.
+    Auto: on for CPU/GPU (scatter/gather fast, sorts slow), off for TPU
+    (random scatters serialize; multi-operand sort is the idiom there)."""
+    v = os.environ.get("QUOKKA_HASH_TABLES", "auto").lower()
+    if v in ("1", "true", "yes", "on"):
+        return True
+    if v in ("0", "false", "no", "off"):
+        return False
+    return _platform() != "tpu"
+
+
+# ---------------------------------------------------------------------------
 # Dtype policy
 # ---------------------------------------------------------------------------
 

@@ -245,6 +245,7 @@ class FusedPartialAgg:
             tuple((n, e.sql()) for n, e in pre_exprs),
             tuple((p, op, tmp) for p, op, tmp in self.plan.partials),
             bool(self.keys),
+            config.use_hash_tables(),  # strategy is baked into the program
         )
         fn = _FUSED_PROGRAMS.get(sig)
         if fn is None:
@@ -299,7 +300,7 @@ class FusedPartialAgg:
             )
             ops = tuple(op for (_, op, _) in plan.partials)
             if has_keys:
-                outs, counts, rep, num = kernels.sorted_groupby(
+                outs, counts, rep, num = kernels.groupby_limbs(
                     tuple(limbs), arrays, ops, valid
                 )
             else:

@@ -142,16 +142,28 @@ def hash_join_pk(
     build_payload: Sequence[str] = (),
 ) -> DeviceBatch:
     """Join where build keys are unique.  Probe-aligned, no host sync."""
-    sorted_limbs, perm, n_valid = _build_sorted_cached(build, build_keys)
     probe_limbs = key_limbs(probe, probe_keys)
-    assert len(probe_limbs) == len(sorted_limbs), "join key column types must match"
     probe_ok = _nonnull_valid(probe, probe_keys)
-    steps = max(1, int(np.ceil(np.log2(max(2, build.padded_len)))) + 1)
-    build_idx, matched = _pk_probe_sorted(
-        tuple(sorted_limbs), perm, n_valid,
-        tuple(l.astype(s.dtype) for l, s in zip(probe_limbs, sorted_limbs)),
-        probe_ok, steps,
-    )
+    if config.use_hash_tables():
+        from quokka_tpu.ops import hashtable
+
+        table = hashtable.build_table(
+            build, build_keys, key_limbs,
+            lambda: _nonnull_valid(build, build_keys),
+        )
+        assert len(probe_limbs) == len(table.raw_dtypes), \
+            "join key column types must match"
+        build_idx, matched = hashtable.pk_probe(table, probe_limbs, probe_ok)
+    else:
+        sorted_limbs, perm, n_valid = _build_sorted_cached(build, build_keys)
+        assert len(probe_limbs) == len(sorted_limbs), \
+            "join key column types must match"
+        steps = max(1, int(np.ceil(np.log2(max(2, build.padded_len)))) + 1)
+        build_idx, matched = _pk_probe_sorted(
+            tuple(sorted_limbs), perm, n_valid,
+            tuple(l.astype(s.dtype) for l, s in zip(probe_limbs, sorted_limbs)),
+            probe_ok, steps,
+        )
     if how == "semi":
         return kernels.apply_mask(probe, matched)
     if how == "anti":

@@ -270,6 +270,18 @@ def _min_sentinel(dtype):
     return jnp.array(jnp.iinfo(dtype).min, dtype)
 
 
+def groupby_limbs(limbs: Tuple[jax.Array, ...], arrays: Tuple[jax.Array, ...],
+                  ops: Tuple[str, ...], valid: jax.Array):
+    """Group rows by key limbs: the single strategy-dispatch point for every
+    group-by consumer (here, FusedPartialAgg).  Hash table on CPU/GPU,
+    multi-operand sort on TPU — see config.use_hash_tables()."""
+    if config.use_hash_tables():
+        from quokka_tpu.ops import hashtable
+
+        return hashtable.hash_groupby(tuple(limbs), arrays, ops, valid)
+    return sorted_groupby(tuple(limbs), arrays, ops, valid)
+
+
 def groupby_aggregate(
     batch: DeviceBatch,
     keys: Sequence[str],
@@ -284,7 +296,7 @@ def groupby_aggregate(
     ops = tuple(op for (_, op, _) in aggs)
     if keys:
         limbs = key_limbs(batch, keys)
-        outs, counts, rep, num = sorted_groupby(tuple(limbs), arrays, ops, batch.valid)
+        outs, counts, rep, num = groupby_limbs(tuple(limbs), arrays, ops, batch.valid)
     else:
         ranks = jnp.zeros(n, dtype=jnp.int32)
         num = jnp.minimum(jnp.sum(batch.valid), 1).astype(jnp.int32)
