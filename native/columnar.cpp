@@ -48,3 +48,68 @@ void qk_partition_histogram(const int32_t* ids, int64_t n, int32_t n_parts,
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// As-of merge (the streaming SortedAsofExecutor's CPU hot loop).
+//
+// The reference walks trade/quote frontiers per key inside polars
+// (ts_executors.py:324-383 in the reference tree).  Our TPU kernel is the
+// sort+scan program in quokka_tpu/ops/asof.py; on the CPU backend that
+// program is bottlenecked by XLA:CPU's slow variadic sort (~340 ns/row),
+// while the problem is a textbook O(nt+nq) sequential merge — exactly what
+// a native host helper is for.  Both sides must be time-sorted ascending;
+// the Python wrapper sorts/compacts and maps indices when they are not.
+// ---------------------------------------------------------------------------
+
+#include <unordered_map>
+
+extern "C" {
+
+// Backward as-of: out_idx[i] = index of the LAST quote with
+// q_time <= t_time[i] and q_key == t_key[i], else -1 (ties included,
+// matching polars join_asof backward).
+void qk_asof_backward(const int64_t* t_time, const int64_t* t_key, int64_t nt,
+                      const int64_t* q_time, const int64_t* q_key, int64_t nq,
+                      int32_t* out_idx) {
+    std::unordered_map<int64_t, int32_t> last;
+    last.reserve(1024);
+    int64_t j = 0;
+    for (int64_t i = 0; i < nt; ++i) {
+        while (j < nq && q_time[j] <= t_time[i]) {
+            last[q_key[j]] = (int32_t)j;
+            ++j;
+        }
+        auto it = last.find(t_key[i]);
+        out_idx[i] = it == last.end() ? -1 : it->second;
+    }
+}
+
+// Forward as-of: out_idx[i] = index of the FIRST quote with
+// q_time >= t_time[i] and q_key == t_key[i], else -1.  Walks both sides
+// descending; inserting quotes in descending index order means the last
+// write per key is the smallest qualifying index.
+void qk_asof_forward(const int64_t* t_time, const int64_t* t_key, int64_t nt,
+                     const int64_t* q_time, const int64_t* q_key, int64_t nq,
+                     int32_t* out_idx) {
+    std::unordered_map<int64_t, int32_t> first;
+    first.reserve(1024);
+    int64_t j = nq - 1;
+    for (int64_t i = nt - 1; i >= 0; --i) {
+        while (j >= 0 && q_time[j] >= t_time[i]) {
+            first[q_key[j]] = (int32_t)j;
+            --j;
+        }
+        auto it = first.find(t_key[i]);
+        out_idx[i] = it == first.end() ? -1 : it->second;
+    }
+}
+
+// 1 if a[0..n) is non-decreasing.
+int32_t qk_is_sorted_i64(const int64_t* a, int64_t n) {
+    for (int64_t i = 1; i < n; ++i) {
+        if (a[i] < a[i - 1]) return 0;
+    }
+    return 1;
+}
+
+}  // extern "C"

@@ -73,6 +73,20 @@ def use_hash_tables() -> bool:
     return _platform() != "tpu"
 
 
+def use_host_asof() -> bool:
+    """Whether the as-of match runs as a native sequential merge on host
+    (ops/asof._asof_match_host -> native/columnar.cpp).  On the CPU backend
+    device arrays ARE host memory (np.asarray is zero-copy), so the O(n+m)
+    walk replaces an XLA sort bottleneck for free; on TPU it would mean a
+    d2h round trip, so the sort+scan device kernel stays."""
+    v = os.environ.get("QUOKKA_HOST_ASOF", "auto").lower()
+    if v in ("1", "true", "yes", "on"):
+        return True
+    if v in ("0", "false", "no", "off"):
+        return False
+    return _platform() != "tpu"
+
+
 # ---------------------------------------------------------------------------
 # Dtype policy
 # ---------------------------------------------------------------------------

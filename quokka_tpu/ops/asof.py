@@ -20,6 +20,9 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+import numpy as np
+
+from quokka_tpu import config
 from quokka_tpu.ops import kernels
 from quokka_tpu.ops.batch import DeviceBatch, NumCol, StrCol, key_limbs
 from quokka_tpu.ops.kernels import dense_rank
@@ -39,13 +42,21 @@ def _seg_fill_forward(values: jax.Array, seg_start: jax.Array) -> jax.Array:
     return out
 
 
-@functools.partial(jax.jit, static_argnames=("t",))
+@functools.partial(jax.jit, static_argnames=("t", "forward_ties"))
 def _asof_match(limbs: Tuple[jax.Array, ...], times: Tuple[jax.Array, ...],
-                is_trade: jax.Array, valid: jax.Array, t: int):
+                is_trade: jax.Array, valid: jax.Array, t: int,
+                forward_ties: bool = False):
     """Returns per-trade-row (quote_row_idx, matched) for backward asof.
     Arrays are the concatenation [trades | quotes]; `t` = trade padded len.
     `times` is one array for narrow/float time columns, or (hi, lo) limbs for
-    wide int64/ns timestamps (limb lexicographic order == numeric order)."""
+    wide int64/ns timestamps (limb lexicographic order == numeric order).
+
+    Tie-break among quotes sharing (key, time): the scan takes the quote at
+    the MAX sorted position, so the iota tie key orders equal quotes by
+    original index — ascending for backward (pandas/polars pick the LAST
+    tied quote) and descending (`forward_ties`, on the caller's negated
+    times) so forward picks the FIRST tied quote, matching pandas and the
+    native host merge."""
     n = valid.shape[0]
     ranks, _ = dense_rank(limbs, valid)
     iota = jnp.arange(n, dtype=jnp.int32)
@@ -53,8 +64,10 @@ def _asof_match(limbs: Tuple[jax.Array, ...], times: Tuple[jax.Array, ...],
     # sort by (validity, key rank, time, side): quotes (0) before trades (1)
     # at equal times -> backward asof includes same-timestamp quotes
     side = is_trade.astype(jnp.int32)
+    tie = -iota if forward_ties else iota
     nk = 2 + len(times)
-    sorted_ops = lax.sort([inv, ranks, *times, side, iota], num_keys=nk + 1)
+    sorted_ops = lax.sort([inv, ranks, *times, side, tie, iota],
+                          num_keys=nk + 2)
     perm = sorted_ops[-1]
     valid_s = sorted_ops[0] == 0
     ranks_s = sorted_ops[1]
@@ -69,6 +82,102 @@ def _asof_match(limbs: Tuple[jax.Array, ...], times: Tuple[jax.Array, ...],
     match_orig = jnp.zeros(n, dtype=jnp.int32).at[perm].set(quote_orig)
     matched = jnp.zeros(n, dtype=bool).at[perm].set(matched_s)
     return match_orig[:t], matched[:t]
+
+
+# ---------------------------------------------------------------------------
+# Host fast path (CPU backend): the as-of match is a textbook O(n+m)
+# sequential merge; XLA:CPU's variadic sort makes the device kernel ~340
+# ns/row while the native walk (native/columnar.cpp qk_asof_backward) runs at
+# memory speed.  On the CPU backend np.asarray of a device array is a
+# zero-copy view, so "host" costs no transfer.  TPU keeps the sort+scan
+# kernel (config.use_host_asof() gates, QUOKKA_HOST_ASOF overrides).
+# ---------------------------------------------------------------------------
+
+
+def _np_time64(col: NumCol) -> np.ndarray:
+    """Order-preserving int64 view of a time column on host.  NOTE: float
+    columns map through an IEEE bit trick, so the result is only comparable
+    against another float column's encoding — _asof_match_host bails when
+    the two sides' dtype families differ."""
+    d = np.asarray(col.data)
+    if col.hi is not None:
+        from quokka_tpu.ops import bridge
+
+        return bridge._limbs_to_int64(np.asarray(col.hi), d)
+    if d.dtype.kind == "f":
+        # IEEE total-order bit trick: non-negative floats' bit patterns are
+        # already ordered non-negative ints; negatives flip their low 63
+        # bits (sign kept) to reverse magnitude order while staying below
+        # every positive
+        bits = np.ascontiguousarray(d.astype(np.float64)).view(np.int64)
+        return np.where(bits < 0, bits ^ np.int64(0x7FFFFFFFFFFFFFFF), bits)
+    return d.astype(np.int64)
+
+
+def _time_family(col: NumCol) -> str:
+    if col.hi is not None:
+        return "i"
+    return "f" if np.asarray(col.data).dtype.kind == "f" else "i"
+
+
+def _np_key64(batch: DeviceBatch, by: Sequence[str]) -> "np.ndarray | None":
+    """Exact int64 key per row from <=2 int32 limbs (or one int64 limb).
+    Returns None when the key shape doesn't pack exactly — caller falls back
+    to the device kernel."""
+    if not by:
+        return np.zeros(batch.padded_len, dtype=np.int64)
+    limbs = [np.asarray(l) for l in key_limbs(batch, list(by))]
+    if any(l.dtype.kind == "f" for l in limbs):
+        return None
+    if len(limbs) == 1:
+        return limbs[0].astype(np.int64)
+    if len(limbs) == 2 and all(l.dtype.itemsize <= 4 for l in limbs):
+        return (limbs[0].astype(np.int64) << 32) | limbs[1].astype(
+            np.uint32
+        ).astype(np.int64)
+    return None
+
+
+def _asof_match_host(trades, quotes, left_on, right_on, left_by, right_by,
+                     direction):
+    """(quote_idx, matched) as numpy arrays aligned to trade rows, or None
+    when the native library / key shape doesn't support the fast path."""
+    from quokka_tpu.utils import native
+
+    if not native.has_asof():
+        return None  # skip all host prep when the merge can't run anyway
+    if _time_family(trades.columns[left_on]) != _time_family(
+            quotes.columns[right_on]):
+        return None  # int vs float encodings are not mutually comparable
+    tk = _np_key64(trades, left_by)
+    qk = _np_key64(quotes, right_by)
+    if tk is None or qk is None:
+        return None
+    tt = _np_time64(trades.columns[left_on])
+    qt = _np_time64(quotes.columns[right_on])
+    tv = np.asarray(trades.valid)
+    qv = np.asarray(quotes.valid)
+    tidx = np.flatnonzero(tv)
+    qidx = np.flatnonzero(qv)
+    tt, tk = np.ascontiguousarray(tt[tidx]), np.ascontiguousarray(tk[tidx])
+    qt, qk = np.ascontiguousarray(qt[qidx]), np.ascontiguousarray(qk[qidx])
+    if not native.is_sorted_i64(tt):
+        order = np.argsort(tt, kind="stable")
+        tidx, tt, tk = tidx[order], np.ascontiguousarray(tt[order]), \
+            np.ascontiguousarray(tk[order])
+    if not native.is_sorted_i64(qt):
+        order = np.argsort(qt, kind="stable")
+        qidx, qt, qk = qidx[order], np.ascontiguousarray(qt[order]), \
+            np.ascontiguousarray(qk[order])
+    res = native.asof_merge(tt, tk, qt, qk, direction)
+    if res is None:
+        return None
+    quote_idx = np.zeros(trades.padded_len, dtype=np.int32)
+    matched = np.zeros(trades.padded_len, dtype=bool)
+    hit = res >= 0
+    quote_idx[tidx[hit]] = qidx[res[hit]].astype(np.int32)
+    matched[tidx[hit]] = True
+    return quote_idx, matched
 
 
 def asof_join(
@@ -86,34 +195,46 @@ def asof_join(
     false mask is NOT applied (matches polars join_asof semantics: unmatched
     rows survive with null payload — floats become NaN)."""
     t = trades.padded_len
-    lt = key_limbs(trades, list(left_by)) if left_by else []
-    lq = key_limbs(quotes, list(right_by)) if right_by else []
-    if left_by:
-        limbs = [jnp.concatenate([a, b.astype(a.dtype)]) for a, b in zip(lt, lq)]
-    else:
-        limbs = [jnp.zeros(t + quotes.padded_len, dtype=jnp.int32)]
     if direction not in ("backward", "forward"):
         raise ValueError(direction)
-    tc = trades.columns[left_on]
-    qc = quotes.columns[right_on]
-    if tc.hi is not None or qc.hi is not None:
-        from quokka_tpu.ops import timewide
-
-        tl, ql = timewide.widen_limbs(tc), timewide.widen_limbs(qc)
-        if direction == "forward":
-            tl, ql = timewide.not_limbs(tl), timewide.not_limbs(ql)
-        times = tuple(jnp.concatenate([a, b.astype(a.dtype)]) for a, b in zip(tl, ql))
+    host = None
+    if config.use_host_asof():
+        host = _asof_match_host(
+            trades, quotes, left_on, right_on, left_by, right_by, direction
+        )
+    if host is not None:
+        quote_idx = jnp.asarray(host[0])
+        matched = jnp.asarray(host[1])
     else:
-        t_time, q_time = tc.data, qc.data
-        if direction == "forward":
-            t_time, q_time = -t_time, -q_time
-        times = (jnp.concatenate([t_time, q_time.astype(t_time.dtype)]),)
-    is_trade = jnp.concatenate(
-        [jnp.ones(t, dtype=bool), jnp.zeros(quotes.padded_len, dtype=bool)]
-    )
-    valid = jnp.concatenate([trades.valid, quotes.valid])
-    match_orig, matched = _asof_match(tuple(limbs), times, is_trade, valid, t)
-    quote_idx = jnp.clip(match_orig - t, 0, quotes.padded_len - 1)
+        lt = key_limbs(trades, list(left_by)) if left_by else []
+        lq = key_limbs(quotes, list(right_by)) if right_by else []
+        if left_by:
+            limbs = [jnp.concatenate([a, b.astype(a.dtype)]) for a, b in zip(lt, lq)]
+        else:
+            limbs = [jnp.zeros(t + quotes.padded_len, dtype=jnp.int32)]
+        tc = trades.columns[left_on]
+        qc = quotes.columns[right_on]
+        if tc.hi is not None or qc.hi is not None:
+            from quokka_tpu.ops import timewide
+
+            tl, ql = timewide.widen_limbs(tc), timewide.widen_limbs(qc)
+            if direction == "forward":
+                tl, ql = timewide.not_limbs(tl), timewide.not_limbs(ql)
+            times = tuple(jnp.concatenate([a, b.astype(a.dtype)]) for a, b in zip(tl, ql))
+        else:
+            t_time, q_time = tc.data, qc.data
+            if direction == "forward":
+                t_time, q_time = -t_time, -q_time
+            times = (jnp.concatenate([t_time, q_time.astype(t_time.dtype)]),)
+        is_trade = jnp.concatenate(
+            [jnp.ones(t, dtype=bool), jnp.zeros(quotes.padded_len, dtype=bool)]
+        )
+        valid = jnp.concatenate([trades.valid, quotes.valid])
+        match_orig, matched = _asof_match(
+            tuple(limbs), times, is_trade, valid, t,
+            forward_ties=(direction == "forward"),
+        )
+        quote_idx = jnp.clip(match_orig - t, 0, quotes.padded_len - 1)
     cols = dict(trades.columns)
     from quokka_tpu.ops.batch import with_nulls
 

@@ -199,12 +199,15 @@ class FusedPartialAgg:
         itemsize = 8 if config.x64_enabled() else 4
         if n_buckets > _SMALL_GROUPBY_MAX_BUCKETS:
             return None
-        if batch.padded_len * n_buckets * itemsize > _SMALL_GROUPBY_MAX_BYTES:
-            return None
-        # float32 matmul accumulation is exact only up to 2^24: beyond that,
-        # counts (and integer-valued sums) can silently lose units
-        if not config.x64_enabled() and batch.padded_len > (1 << 24):
-            return None
+        if not config.use_hash_tables():
+            # matmul-strategy gates only: the scatter strategy materializes
+            # no n x B one-hot and accumulates exactly
+            if batch.padded_len * n_buckets * itemsize > _SMALL_GROUPBY_MAX_BYTES:
+                return None
+            # float32 matmul accumulation is exact only up to 2^24: beyond
+            # that, counts (and integer-valued sums) can silently lose units
+            if not config.x64_enabled() and batch.padded_len > (1 << 24):
+                return None
         return dims
 
     def __call__(self, batch: DeviceBatch) -> DeviceBatch:
@@ -321,6 +324,7 @@ class FusedPartialAgg:
             dims,
             tuple((n, e.sql()) for n, e in pre_exprs),
             tuple((p, op, tmp) for p, op, tmp in self.plan.partials),
+            config.use_hash_tables(),  # strategy is baked into the program
         )
         fn = _FUSED_PROGRAMS.get(sig)
         if fn is None:
@@ -339,6 +343,14 @@ class FusedPartialAgg:
             strides.append(s)
             s *= d
         strides = tuple(reversed(strides))
+        if config.use_hash_tables():
+            # CPU/GPU: scatter segment-sums by bucket id — no n x B one-hot,
+            # exact accumulation, and none of the matmul memory gates.  TPU
+            # keeps the one-hot matmul (the MXU reduces all agg columns in
+            # one pass; random scatters serialize there).
+            return self._build_small_scatter(
+                pre_exprs, num_names, bound_names, strides, n_groups, out_pad
+            )
 
         @jax.jit
         def fused(num_arrays, hi_arrays, bound_arrays, codes, valid):
@@ -411,6 +423,55 @@ class FusedPartialAgg:
                         arr = arr.astype(jnp.int32)
                 arr = arr[order]
                 outs.append(_pad_tail(arr, out_pad))
+            rep_d = jnp.minimum(rep_b[order], jnp.int32(n - 1))
+            return (*outs, _pad_tail(rep_d, out_pad), num)
+
+        return fused
+
+    def _build_small_scatter(self, pre_exprs, num_names, bound_names,
+                             strides, n_groups, out_pad):
+        """Scatter strategy of the small-key fast path: identical contract
+        and bucket-id scheme as the matmul strategy, but every aggregate is
+        one segment reduce over (n_groups + 1) buckets."""
+        plan = self.plan
+
+        @jax.jit
+        def fused(num_arrays, hi_arrays, bound_arrays, codes, valid):
+            n = valid.shape[0]
+            cols = {}
+            for name, arr, hi in zip(num_names, num_arrays, hi_arrays):
+                cols[name] = NumCol(
+                    arr, _infer_kind(arr), hi=hi if hi.shape[0] else None
+                )
+            for name, arr in zip(bound_names, bound_arrays):
+                cols[name] = NumCol(arr, _infer_kind(arr))
+            shim = _ShimBatch(cols, n, valid)
+            pre_cols = {}
+            for name, e in pre_exprs:
+                pre_cols[name] = expr_compile.evaluate_to_column(e, shim)
+            gid = jnp.zeros(n, dtype=jnp.int32)
+            for c, st in zip(codes, strides):
+                # code -1 = null -> slot 0 of that key (SQL: nulls form one group)
+                gid = gid + (c.astype(jnp.int32) + 1) * jnp.int32(st)
+            gid = jnp.where(valid, gid, jnp.int32(n_groups))  # dump bucket
+            iota = jnp.arange(n, dtype=jnp.int32)
+            rep_b = jax.ops.segment_min(
+                jnp.where(valid, iota, jnp.int32(n)), gid,
+                num_segments=n_groups + 1,
+            )[:n_groups]
+            live = rep_b < n
+            num = jnp.sum(live.astype(jnp.int32))
+            bidx = jnp.arange(n_groups, dtype=jnp.int32)
+            order = jnp.argsort(jnp.where(live, bidx, jnp.int32(n_groups) + bidx))
+            outs = []
+            for pname, op, tmp in plan.partials:
+                if op == "count":
+                    x = valid.astype(jnp.int32)
+                else:
+                    v = pre_cols[tmp].data
+                    x = jnp.where(valid, v, jnp.zeros((), v.dtype))
+                arr = jax.ops.segment_sum(x, gid, num_segments=n_groups + 1)
+                outs.append(_pad_tail(arr[:n_groups][order], out_pad))
             rep_d = jnp.minimum(rep_b[order], jnp.int32(n - 1))
             return (*outs, _pad_tail(rep_d, out_pad), num)
 

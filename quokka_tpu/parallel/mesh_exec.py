@@ -401,7 +401,10 @@ def mesh_asof(
             [jnp.ones(p, dtype=bool), jnp.zeros(qv.shape[0], dtype=bool)]
         )
         valid = jnp.concatenate([tv, qv])
-        match_orig, matched = _asof_match(limbs, times, is_trade, valid, p)
+        match_orig, matched = _asof_match(
+            limbs, times, is_trade, valid, p,
+            forward_ties=(direction == "forward"),
+        )
         quote_idx = jnp.clip(match_orig - p, 0, qv.shape[0] - 1)
         pay = tuple(c[quote_idx] for c in sqc)
         # drop unmatched (SortedAsofExecutor's keep_unmatched=False default)

@@ -66,6 +66,25 @@ def _find_lib():
                 ]
                 lib.qk_find_newline.restype = ctypes.c_int64
                 lib.qk_find_newline.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+                # newer symbols may be absent from a stale/external .so (no
+                # compiler to rebuild): keep the lib for the old entry points
+                # and let the new consumers fall back
+                try:
+                    for fn in ("qk_asof_backward", "qk_asof_forward"):
+                        f = getattr(lib, fn)
+                        f.restype = None
+                        f.argtypes = [
+                            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+                            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+                            ctypes.c_void_p,
+                        ]
+                    lib.qk_is_sorted_i64.restype = ctypes.c_int32
+                    lib.qk_is_sorted_i64.argtypes = [
+                        ctypes.c_void_p, ctypes.c_int64,
+                    ]
+                    lib._qk_has_asof = True
+                except AttributeError:
+                    lib._qk_has_asof = False
                 _LIB = lib
             except OSError:
                 _LIB = None
@@ -101,6 +120,43 @@ def fnv1a64_many(values: Sequence) -> Optional[np.ndarray]:
         if v is None:
             out[i] = 0
     return out
+
+
+def has_asof() -> bool:
+    """Whether the loaded native library provides the as-of merge symbols."""
+    lib = _find_lib()
+    return lib is not None and getattr(lib, "_qk_has_asof", False)
+
+
+def asof_merge(t_time: np.ndarray, t_key: np.ndarray,
+               q_time: np.ndarray, q_key: np.ndarray,
+               direction: str = "backward") -> Optional[np.ndarray]:
+    """Sequential as-of merge over host arrays (the CPU-backend fast path of
+    ops/asof.asof_join).  All inputs int64 and C-contiguous; each side must
+    be time-sorted ascending — the CALLER sorts/compacts first.  Returns
+    int32 quote indices (-1 = unmatched) per trade, or None when the native
+    library is unavailable (callers fall back to the XLA kernel)."""
+    lib = _find_lib()
+    if lib is None or not getattr(lib, "_qk_has_asof", False):
+        return None
+    nt, nq = len(t_time), len(q_time)
+    out = np.empty(nt, dtype=np.int32)
+    if nt == 0:
+        return out
+    fn = lib.qk_asof_backward if direction == "backward" else lib.qk_asof_forward
+    fn(
+        t_time.ctypes.data, t_key.ctypes.data, nt,
+        q_time.ctypes.data if nq else 0, q_key.ctypes.data if nq else 0, nq,
+        out.ctypes.data,
+    )
+    return out
+
+
+def is_sorted_i64(a: np.ndarray) -> bool:
+    lib = _find_lib()
+    if lib is None or not getattr(lib, "_qk_has_asof", False) or len(a) < 2:
+        return bool(np.all(a[1:] >= a[:-1])) if len(a) >= 2 else True
+    return bool(lib.qk_is_sorted_i64(a.ctypes.data, len(a)))
 
 
 def find_newline(data: bytes) -> int:

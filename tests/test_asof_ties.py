@@ -1,0 +1,100 @@
+"""As-of tie-break semantics: among quotes sharing (key, time), backward
+picks the LAST by original order and forward picks the FIRST — pandas
+merge_asof semantics, which both the native host merge
+(native/columnar.cpp qk_asof_*) and the device sort+scan kernel
+(ops/asof._asof_match tie key) must reproduce identically."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import jax.numpy as jnp
+
+from quokka_tpu.ops import asof as asof_ops
+from quokka_tpu.ops import bridge, kernels
+
+
+def _ticks_with_ties(seed=9, n_trades=300, n_quotes=600):
+    r = np.random.default_rng(seed)
+    # coarse times force many exact collisions on (symbol, time)
+    tt = np.sort(r.integers(0, 40, n_trades)).astype(np.int64)
+    qt = np.sort(r.integers(0, 40, n_quotes)).astype(np.int64)
+    syms = np.array(["A", "B"])
+    import pyarrow as pa
+
+    trades = pa.table({"time": tt, "symbol": syms[r.integers(0, 2, n_trades)],
+                       "size": r.integers(1, 9, n_trades).astype(np.int32)})
+    quotes = pa.table({"time": qt, "symbol": syms[r.integers(0, 2, n_quotes)],
+                       "bid": np.arange(n_quotes, dtype=np.float64)})
+    return trades, quotes
+
+
+@pytest.mark.parametrize("direction", ["backward", "forward"])
+@pytest.mark.parametrize("host", ["1", "0"])
+def test_tie_break_matches_pandas(direction, host, monkeypatch):
+    monkeypatch.setenv("QUOKKA_HOST_ASOF", host)
+    trades, quotes = _ticks_with_ties()
+    tb = bridge.arrow_to_device(trades)
+    qb = bridge.arrow_to_device(quotes)
+    out = asof_ops.asof_join(
+        tb, qb, "time", "time", ["symbol"], ["symbol"], ["bid"],
+        direction=direction,
+    )
+    out = kernels.apply_mask(out, out.columns.pop("__asof_matched__").data)
+    got = bridge.device_to_arrow(kernels.compact(out)).to_pandas()
+    exp = pd.merge_asof(
+        trades.to_pandas(), quotes.to_pandas(), on="time", by="symbol",
+        direction=direction,
+    ).dropna(subset=["bid"])
+    key = ["time", "symbol", "size"]
+    got = got.sort_values(key).reset_index(drop=True)
+    exp = exp.sort_values(key).reset_index(drop=True)
+    assert len(got) == len(exp), (direction, host)
+    # bid doubles as the quote's original index, so equality pins WHICH
+    # tied quote was chosen, not just a value match
+    np.testing.assert_array_equal(got.bid.to_numpy(), exp.bid.to_numpy())
+
+
+def test_host_and_device_paths_agree(monkeypatch):
+    trades, quotes = _ticks_with_ties(seed=123)
+    outs = {}
+    for host in ("1", "0"):
+        monkeypatch.setenv("QUOKKA_HOST_ASOF", host)
+        tb = bridge.arrow_to_device(trades)
+        qb = bridge.arrow_to_device(quotes)
+        for direction in ("backward", "forward"):
+            out = asof_ops.asof_join(
+                tb, qb, "time", "time", ["symbol"], ["symbol"], ["bid"],
+                direction=direction,
+            )
+            m = out.columns.pop("__asof_matched__").data
+            out = kernels.apply_mask(out, m)
+            df = bridge.device_to_arrow(kernels.compact(out)).to_pandas()
+            outs[(host, direction)] = df.sort_values(
+                ["time", "symbol", "size"]).reset_index(drop=True)
+    for direction in ("backward", "forward"):
+        a, b = outs[("1", direction)], outs[("0", direction)]
+        pd.testing.assert_frame_equal(a, b)
+
+
+def test_mixed_time_dtypes_fall_back(monkeypatch):
+    """int trade times vs float quote times: the host path must decline
+    (encodings not comparable) and the device kernel must still answer."""
+    monkeypatch.setenv("QUOKKA_HOST_ASOF", "1")
+    import pyarrow as pa
+
+    trades = pa.table({"time": np.array([1, 5, 9], dtype=np.int64),
+                       "symbol": ["A", "A", "A"]})
+    quotes = pa.table({"time": np.array([0.5, 4.5, 8.5]),
+                       "symbol": ["A", "A", "A"],
+                       "bid": np.array([1.0, 2.0, 3.0])})
+    tb = bridge.arrow_to_device(trades)
+    qb = bridge.arrow_to_device(quotes)
+    assert asof_ops._asof_match_host(
+        tb, qb, "time", "time", ["symbol"], ["symbol"], "backward") is None
+    out = asof_ops.asof_join(
+        tb, qb, "time", "time", ["symbol"], ["symbol"], ["bid"])
+    m = np.asarray(out.columns["__asof_matched__"].data)[:3]
+    assert m.tolist() == [True, True, True]
+    np.testing.assert_allclose(
+        np.asarray(out.columns["bid"].data)[:3], [1.0, 2.0, 3.0])
