@@ -263,13 +263,18 @@ def measure(paths):
     platform = jax.default_backend()
     nbytes = os.path.getsize(paths["lineitem"])
     per_query = {}
+    from quokka_tpu.utils import compilestats
+
     for qname, fn in QUERIES.items():
         ref = REF_SECONDS_SF100_4W[qname] * 4.0 / 100.0 * SF
+        c0 = compilestats.snapshot()
         warm = fn(paths)  # compiles the kernel set for this query shape
         extra = {}
         if qname == "q1":
             # cold = compile warm but scan (buffer-pool) cache empty: pays
-            # parquet decode + host encode + h2d transfer every batch
+            # parquet decode + host encode + h2d transfer every batch.
+            # (Runs before the compile snapshot so any shape first seen on
+            # the cold path counts as warmup, not as timed-run churn.)
             from quokka_tpu.runtime import scancache
 
             scancache.clear()
@@ -281,7 +286,9 @@ def measure(paths):
                     nbytes / cold / 1e9 / BASELINE_GBPS_PER_WORKER, 4
                 ),
             }
+        c1 = compilestats.snapshot()
         times = sorted(fn(paths) for _ in range(3))
+        c2 = compilestats.snapshot()
         t = times[0]
         speedup = ref / t
         per_query[qname] = {
@@ -290,6 +297,14 @@ def measure(paths):
             "warmup_seconds": round(warm, 4),
             "ref_seconds_scaled": round(ref, 4),
             "speedup_vs_ref_per_chip": round(speedup, 4),
+            # kernel-reuse proof: warmup pays the real compiles and/or
+            # persistent-cache loads, the timed runs must not add any
+            "real_compiles_warmup": c1["real_compiles"] - c0["real_compiles"],
+            "real_compiles_timed_runs": c2["real_compiles"] - c1["real_compiles"],
+            "compile_seconds_warmup": round(
+                c1["backend_compile_seconds"] - c0["backend_compile_seconds"], 3
+            ),
+            "cache_hits_warmup": c1["cache_hits"] - c0["cache_hits"],
             **extra,
         }
         if qname == "q1":

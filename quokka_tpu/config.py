@@ -21,20 +21,58 @@ import jax.numpy as jnp
 # Persistent XLA compilation cache: first-compile of the fused kernels is slow
 # (tens of seconds per program over a remote TPU runtime); cache executables on
 # disk so they amortize across processes and queries.
+def _host_fingerprint() -> str:
+    """Per-microarchitecture cache namespace: XLA:CPU AOT executables are
+    compiled for the build host's CPU features and the cache key does NOT
+    include them, so an entry written on one machine can SIGILL on another
+    (observed as cpu_aot_loader 'machine type mismatch' errors when $HOME
+    moves across heterogeneous hosts).  Keying the directory on the CPU
+    flag set makes a foreign host a cache MISS instead of a crash."""
+    import hashlib
+    import platform as _plat
+
+    feat = ""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    feat = line
+                    break
+    except OSError:
+        pass
+    h = hashlib.sha256(feat.encode()).hexdigest()[:10]
+    return f"{_plat.machine()}-{h}"
+
+
 _cache_dir = os.environ.get("QUOKKA_JAX_CACHE_DIR", "")
-if not _cache_dir and os.environ.get("JAX_PLATFORMS", "") in ("axon", "tpu"):
+if not _cache_dir:
+    # Default ON for every backend: a fresh process otherwise recompiles the
+    # whole kernel set (~15-20s per TPC-H query shape even on CPU; minutes
+    # over the remote-TPU compile tunnel).  Opt out with
+    # QUOKKA_JAX_CACHE_DIR=0.
     _cache_dir = os.path.expanduser("~/.cache/quokka_tpu_jax")
 if _cache_dir and _cache_dir != "0":
     try:
+        _cache_dir = os.path.join(_cache_dir, _host_fingerprint())
         os.makedirs(_cache_dir, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", _cache_dir)
-        # 0.5s skips trivial programs on TPU; the test suite sets 0 so its
-        # thousands of small CPU compiles amortize across runs
-        _min_secs = float(os.environ.get("QUOKKA_JAX_CACHE_MIN_SECS", "0.5"))
+        # Cache every program: the engine's per-batch kernels are individually
+        # fast to compile but number in the hundreds per query shape, and the
+        # cache-hit path costs ~ms.  Override with QUOKKA_JAX_CACHE_MIN_SECS.
+        _min_secs = float(os.environ.get("QUOKKA_JAX_CACHE_MIN_SECS", "0"))
         jax.config.update("jax_persistent_cache_min_compile_time_secs", _min_secs)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
     except Exception:
         pass
+
+# Compile counters observe every compilation from process start (listeners
+# must exist before the first jit runs; config is the package's first import).
+try:
+    from quokka_tpu.utils import compilestats as _compilestats
+
+    _compilestats.ensure_registered()
+except Exception:
+    pass
 
 # ---------------------------------------------------------------------------
 # Padding buckets

@@ -134,8 +134,21 @@ _RID_BITS = 24  # rid < 2^24 always holds: config.MAX_BUCKET == 1 << 24
 _RID_MASK = (1 << _RID_BITS) - 1
 
 
-@functools.partial(jax.jit, static_argnames=("capbits",))
-def _insert(limbs: Tuple[jax.Array, ...], valid: jax.Array, capbits: int):
+def _in_trace() -> bool:
+    """True while tracing inside another jit.  The table kernels are called
+    both nested (FusedPartialAgg's fused program, mesh programs) and at top
+    level (executors); routing traced calls to the PLAIN bodies — which
+    trace to the identical jaxpr a nested pjit would inline — sidesteps a
+    jit-dispatch race observed when the engine's threads hit the same pjit
+    object from both contexts (spurious 'Execution supplied N buffers but
+    compiled program expected M buffers' on the 1-core CPU backend)."""
+    try:
+        return not jax.core.trace_state_clean()
+    except Exception:
+        return False
+
+
+def _insert_body(limbs: Tuple[jax.Array, ...], valid: jax.Array, capbits: int):
     """Insert all valid rows; returns (slot_for_row, table).
 
     slot_for_row[i] is the slot holding row i's key (all equal keys share
@@ -183,15 +196,22 @@ def _insert(limbs: Tuple[jax.Array, ...], valid: jax.Array, capbits: int):
     return myslot, tbl
 
 
+_insert_jit = functools.partial(jax.jit, static_argnames=("capbits",))(_insert_body)
+
+
+def _insert(limbs, valid, capbits: int):
+    fn = _insert_body if _in_trace() else _insert_jit
+    return fn(limbs, valid, capbits)
+
+
 def table_rid(tbl: jax.Array) -> jax.Array:
     """Decode a table's packed entries to row ids (EMPTY stays EMPTY)."""
     return jnp.where(tbl == EMPTY, EMPTY, tbl & _RID_MASK)
 
 
-@functools.partial(jax.jit, static_argnames=("capbits",))
-def _probe(table: jax.Array, build_limbs: Tuple[jax.Array, ...],
-           probe_limbs: Tuple[jax.Array, ...], probe_ok: jax.Array,
-           capbits: int):
+def _probe_body(table: jax.Array, build_limbs: Tuple[jax.Array, ...],
+                probe_limbs: Tuple[jax.Array, ...], probe_ok: jax.Array,
+                capbits: int):
     """Walk each probe row's sequence until its key or an empty slot.
     Returns (build_idx clipped to range, matched)."""
     mask = (1 << capbits) - 1
@@ -220,27 +240,40 @@ def _probe(table: jax.Array, build_limbs: Tuple[jax.Array, ...],
     return jnp.clip(res, 0, b - 1), ok & probe_ok
 
 
+_probe_jit = functools.partial(jax.jit, static_argnames=("capbits",))(_probe_body)
+
+
+def _probe(table, build_limbs, probe_limbs, probe_ok, capbits: int):
+    fn = _probe_body if _in_trace() else _probe_jit
+    return fn(table, build_limbs, probe_limbs, probe_ok, capbits)
+
+
 def hash_groupby(limbs: Tuple[jax.Array, ...], arrays: Tuple[jax.Array, ...],
                  ops: Tuple[str, ...], valid: jax.Array):
     """Drop-in for `kernels.sorted_groupby` — same (outs, counts, rep, num)
     contract, except group ids come out in hash order rather than key order
     (no consumer depends on group order; ORDER BY is an explicit node)."""
     capbits = capbits_for(valid.shape[0])
-    return _hash_groupby_impl(tuple(limbs), tuple(arrays), ops, valid, capbits)
+    fn = _hash_groupby_body if _in_trace() else _hash_groupby_jit
+    return fn(tuple(limbs), tuple(arrays), ops, valid, capbits)
 
 
-@functools.partial(jax.jit, static_argnames=("ops", "capbits"))
-def _hash_groupby_impl(limbs, arrays, ops, valid, capbits):
+def _hash_groupby_body(limbs, arrays, ops, valid, capbits):
     from quokka_tpu.ops import kernels
 
     climbs = canonical_limbs(limbs)
-    myslot, tbl = _insert(climbs, valid, capbits)
+    myslot, tbl = _insert_body(climbs, valid, capbits)
     flag = (tbl != EMPTY).astype(jnp.int32)
     rank_of_slot = jnp.cumsum(flag) - flag
     ranks = rank_of_slot[myslot]
     num = jnp.sum(flag)
-    outs, counts, rep = kernels._segment_aggs(ranks, valid, arrays, ops)
+    outs, counts, rep = kernels._segment_aggs_body(ranks, valid, arrays, ops)
     return tuple(outs), counts, rep, num
+
+
+_hash_groupby_jit = functools.partial(
+    jax.jit, static_argnames=("ops", "capbits")
+)(_hash_groupby_body)
 
 
 class _TableCache:

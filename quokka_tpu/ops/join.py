@@ -145,6 +145,8 @@ def hash_join_pk(
     probe_limbs = key_limbs(probe, probe_keys)
     probe_ok = _nonnull_valid(probe, probe_keys)
     if config.use_hash_tables():
+        # hashtable is imported at module scope by kernels (imported above):
+        # a first-import inside an active trace once mis-primed jit dispatch
         from quokka_tpu.ops import hashtable
 
         table = hashtable.build_table(

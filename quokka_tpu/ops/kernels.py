@@ -26,6 +26,7 @@ import numpy as np
 from jax import lax
 
 from quokka_tpu import config
+from quokka_tpu.ops import hashtable
 from quokka_tpu.ops.batch import DeviceBatch, NumCol, StrCol, gather_columns, key_limbs
 
 # ---------------------------------------------------------------------------
@@ -220,8 +221,8 @@ def sorted_groupby(limbs: Tuple[jax.Array, ...], arrays: Tuple[jax.Array, ...],
     return tuple(outs), counts, rep, num
 
 
-@functools.partial(jax.jit, static_argnames=("ops",))
-def _segment_aggs(ranks, valid, arrays: Tuple[jax.Array, ...], ops: Tuple[str, ...]):
+def _segment_aggs_body(ranks, valid, arrays: Tuple[jax.Array, ...],
+                       ops: Tuple[str, ...]):
     n = ranks.shape[0]
     outs = []
     counts = jax.ops.segment_sum(valid.astype(jnp.int32), ranks, num_segments=n)
@@ -258,6 +259,18 @@ def _segment_aggs(ranks, valid, arrays: Tuple[jax.Array, ...], ops: Tuple[str, .
     return outs, counts, rep
 
 
+_segment_aggs_jit = functools.partial(jax.jit, static_argnames=("ops",))(
+    _segment_aggs_body
+)
+
+
+def _segment_aggs(ranks, valid, arrays, ops):
+    """Jitted at top level, plain body while tracing (see
+    hashtable._in_trace for the dispatch-race rationale)."""
+    fn = _segment_aggs_body if hashtable._in_trace() else _segment_aggs_jit
+    return fn(ranks, valid, tuple(arrays), tuple(ops))
+
+
 def _max_sentinel(dtype):
     if jnp.issubdtype(dtype, jnp.floating):
         return jnp.array(jnp.inf, dtype)
@@ -276,8 +289,6 @@ def groupby_limbs(limbs: Tuple[jax.Array, ...], arrays: Tuple[jax.Array, ...],
     group-by consumer (here, FusedPartialAgg).  Hash table on CPU/GPU,
     multi-operand sort on TPU — see config.use_hash_tables()."""
     if config.use_hash_tables():
-        from quokka_tpu.ops import hashtable
-
         return hashtable.hash_groupby(tuple(limbs), arrays, ops, valid)
     return sorted_groupby(tuple(limbs), arrays, ops, valid)
 
@@ -301,6 +312,7 @@ def groupby_aggregate(
         ranks = jnp.zeros(n, dtype=jnp.int32)
         num = jnp.minimum(jnp.sum(batch.valid), 1).astype(jnp.int32)
         outs, counts, rep = _segment_aggs(ranks, batch.valid, arrays, ops)
+
     cols = gather_columns({k: batch.columns[k] for k in keys}, rep)
     for (name, _, _), arr in zip(aggs, outs):
         cols[name] = NumCol(arr, "f" if jnp.issubdtype(arr.dtype, jnp.floating) else "i")

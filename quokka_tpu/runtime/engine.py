@@ -240,15 +240,28 @@ class TaskGraph:
 
     def metrics(self) -> Dict:
         """Per-(actor, channel) progress counters flushed by engines/workers:
-        {(actor, ch): {"tasks": n, "rows": n, "bytes": n}} — the
-        metrics/observability surface VERDICT r1 flagged as missing."""
+        {(actor, ch): {"tasks": n, "rows": n, "bytes": n}}, plus a "compile"
+        entry (utils/compilestats.snapshot()) proving kernel reuse — actor
+        keys are tuples, subsystem keys are strings."""
         out: Dict = {}
+        workers: Dict = {}
         for key, snap in list(self.store.kv.items()):
             if isinstance(key, tuple) and key and key[0] == "metrics":
                 for k, v in snap.items():
+                    if k == "__compile__":
+                        if key[1] != "embedded":  # embedded == this process
+                            workers[key[1]] = v
+                        continue
                     agg = out.setdefault(k, {"tasks": 0, "rows": 0, "bytes": 0})
                     for f in agg:
                         agg[f] += v[f]
+        from quokka_tpu.utils import compilestats
+
+        # kernel-reuse proof: real_compiles flat across runs == no churn;
+        # worker processes report their own counters via the flush channel
+        out["compile"] = compilestats.snapshot()
+        if workers:
+            out["compile"]["workers"] = workers
         return out
 
 
@@ -662,7 +675,13 @@ class Engine:
                     pass  # a dead device buffer must not sink the flush
             self._metrics_pending = []
             wid = getattr(self, "worker_id", "embedded")
-            self.store.set(("metrics", wid), {k: dict(v) for k, v in m.items()})
+            snap = {k: dict(v) for k, v in m.items()}
+            from quokka_tpu.utils import compilestats
+
+            # each worker process has its own counters; ship them with the
+            # flush so metrics() can see worker-side compile churn
+            snap["__compile__"] = compilestats.snapshot()
+            self.store.set(("metrics", wid), snap)
             self._metrics_dirty = 0
 
     def _shutdown_prefetch(self) -> None:

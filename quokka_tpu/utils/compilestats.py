@@ -1,0 +1,69 @@
+"""Process-wide XLA compile counters, fed by jax.monitoring events.
+
+The engine's static-shape discipline means a query shape should compile its
+kernel set once and then reuse it forever — across batches within a run,
+across runs within a process (jit caches), and across processes (the
+persistent compilation cache, config.py).  These counters make reuse
+observable: `snapshot()["backend_compiles"]` staying flat across repeated
+runs IS the proof, and bench.py reports the per-phase deltas.
+
+Counter meanings:
+- backend_compiles / backend_compile_seconds: compile_or_get_cached calls —
+  NOTE this event fires on persistent-cache HITS too (jax wraps the whole
+  lookup-or-compile in one duration event), so real compilations are
+  `real_compiles = backend_compiles - cache_hits` (snapshot derives it).
+- cache_hits: persistent-cache loads that avoided a real backend compile.
+- traces: jaxprs traced (cheap, happens once per in-process signature).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+_lock = threading.Lock()
+_stats = {
+    "backend_compiles": 0,
+    "backend_compile_seconds": 0.0,
+    "cache_hits": 0,
+    "traces": 0,
+}
+_registered = False
+
+
+def _on_event(event: str, **kw) -> None:
+    with _lock:
+        if event == "/jax/compilation_cache/cache_hits":
+            _stats["cache_hits"] += 1
+
+
+def _on_duration(event: str, duration_secs: float, **kw) -> None:
+    with _lock:
+        if event == "/jax/core/compile/backend_compile_duration":
+            _stats["backend_compiles"] += 1
+            _stats["backend_compile_seconds"] += duration_secs
+        elif event == "/jax/core/compile/jaxpr_trace_duration":
+            _stats["traces"] += 1
+
+
+def ensure_registered() -> None:
+    global _registered
+    if _registered:
+        return
+    _registered = True
+    try:
+        from jax import monitoring
+
+        monitoring.register_event_listener(_on_event)
+        monitoring.register_event_duration_secs_listener(_on_duration)
+    except Exception:
+        pass  # older jax: counters stay at zero rather than breaking
+
+
+def snapshot() -> Dict:
+    ensure_registered()
+    with _lock:
+        out = dict(_stats)
+    out["backend_compile_seconds"] = round(out["backend_compile_seconds"], 3)
+    out["real_compiles"] = max(0, out["backend_compiles"] - out["cache_hits"])
+    return out
