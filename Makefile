@@ -1,0 +1,28 @@
+# quokka-tpu developer entry points.  The lint gate also runs inside tier-1
+# (tests/test_lint_clean.py), so `make test` implies `make lint`.
+
+PY ?= python
+export JAX_PLATFORMS ?= cpu
+
+.PHONY: lint lint-baseline test test-slow sanitize-demo
+
+# engine-invariant static analysis; exits nonzero on findings beyond the
+# checked-in baseline (quokka_tpu/analysis/baseline.json)
+lint:
+	$(PY) -m quokka_tpu.analysis.lint quokka_tpu/
+
+# shrink the baseline after fixing findings (never grows it silently: new
+# findings still fail `make lint` until fixed or hand-added with a rationale)
+lint-baseline:
+	$(PY) -m quokka_tpu.analysis.lint quokka_tpu/ --write-baseline
+
+test:
+	$(PY) -m pytest tests/ -q -m 'not slow'
+
+test-slow:
+	$(PY) -m pytest tests/ -q -m slow
+
+# watch the deadlock watchdog shoot a wedged two-worker run (exits nonzero
+# in seconds, with every thread's stack on stderr)
+sanitize-demo:
+	QK_SANITIZE=1 QK_SANITIZE_DEADLINE=5 $(PY) tests/sanitize_deadlock_case.py

@@ -307,6 +307,12 @@ def measure(paths):
             "cache_hits_warmup": c1["cache_hits"] - c0["cache_hits"],
             **extra,
         }
+        # QK_SANITIZE=1: the recompile sentinel fails the run outright when
+        # the timed runs compiled anything — a warmed query shape must reuse
+        # its executables (analysis/sanitize.py)
+        from quokka_tpu.analysis import sanitize
+
+        sanitize.check_no_recompiles(c1, c2, context=f"{qname} timed runs")
         if qname == "q1":
             gbps = nbytes / t / 1e9
             print(json.dumps({

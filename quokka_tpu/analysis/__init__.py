@@ -1,0 +1,29 @@
+"""Engine-invariant tooling: static analysis (lint) + runtime sanitizer.
+
+Quokka-tpu's correctness and liveness story rests on invariants that were
+previously argued by hand (SURVEY.md, the reference's proof.md) and that the
+round-5 multi-process hang showed are violated silently when they slip:
+
+- no module-level ``jax.jit``/``pjit``/``shard_map`` objects (a pjit hit from
+  two dispatch contexts raced on the 1-core CPU backend),
+- no import-time side effects beyond the deliberate ones in ``config.py``,
+- no private JAX API (``jax._src``, ``jax.core.*``) use outside the
+  version-guarded shim (``quokka_tpu.analysis.compat``),
+- no host round-trips inside code reachable from jitted entry points
+  ("Query Processing on Tensor Computation Runtimes": tensor-runtime engines
+  live or die by keeping traced code free of host syncs and recompiles),
+- shared runtime tables only mutated under their owning lock,
+- no silently swallowed exceptions in runtime loops.
+
+Two enforcement layers:
+
+- ``python -m quokka_tpu.analysis.lint quokka_tpu/`` — AST rules QK001-QK006
+  (``rules.py``) with a checked-in baseline (``baseline.json``) that may only
+  shrink; the tier-1 gate is ``tests/test_lint_clean.py``.
+- ``QK_SANITIZE=1`` — runtime sanitizer (``sanitize.py``): a deadlock
+  watchdog that dumps every thread's stack and fails fast when a worker stops
+  making progress, a lock-order recorder on the runtime's shared locks, and a
+  recompile sentinel that fails a benchmarked run on post-warmup compiles.
+"""
+
+from quokka_tpu.analysis import compat, sanitize  # noqa: F401

@@ -1,0 +1,161 @@
+"""Lint driver + baseline workflow.
+
+    python -m quokka_tpu.analysis.lint quokka_tpu/          # gate (exit 1 on
+                                                            # new findings)
+    python -m quokka_tpu.analysis.lint path.py --no-baseline
+    python -m quokka_tpu.analysis.lint quokka_tpu/ --write-baseline
+
+Baseline discipline: ``baseline.json`` (next to this module) holds the
+accepted findings of the shipped tree, each with a rationale.  The gate
+fails on any finding NOT in the baseline — the baseline may only shrink.
+Entries whose code was fixed show up as "stale"; ``--write-baseline``
+rewrites the file from the current tree (preserving rationales of surviving
+entries), which is also how you shrink it.  Growing it requires editing the
+JSON by hand, with a rationale, in a reviewed diff — that is the point.
+
+Keys are line-number-free (see ``rules.Finding.key``), so unrelated edits
+do not churn the baseline.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, Iterable, List, Sequence
+
+from quokka_tpu.analysis.rules import Finding, run_rules
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+# generated/vendored trees never linted
+_SKIP_DIRS = {"__pycache__", ".git", "build", "dist", "retired"}
+
+
+def iter_py_files(paths: Sequence[str]) -> Iterable[str]:
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield p
+        else:
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs if d not in _SKIP_DIRS)
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        yield os.path.join(root, f)
+
+
+def _relpath(path: str) -> str:
+    """Stable baseline path: relative to the repo/package root when the file
+    lives under a 'quokka_tpu' tree, else the basename-anchored path given."""
+    norm = os.path.abspath(path).replace("\\", "/")
+    marker = "/quokka_tpu/"
+    i = norm.rfind(marker)
+    if i >= 0:
+        return "quokka_tpu/" + norm[i + len(marker):]
+    return os.path.relpath(path).replace("\\", "/")
+
+
+def run_lint(paths: Sequence[str]) -> List[Finding]:
+    findings: List[Finding] = []
+    for path in iter_py_files(paths):
+        with open(path, "r", encoding="utf-8") as f:
+            source = f.read()
+        try:
+            findings.extend(run_rules(source, path, _relpath(path)))
+        except SyntaxError as e:
+            # a file the engine cannot even parse is its own finding
+            findings.append(Finding(
+                "QK000", "syntax-error", path, _relpath(path),
+                e.lineno or 0, "<module>", f"syntax error: {e.msg}", ""))
+    return findings
+
+
+def load_baseline(path: str) -> Dict[str, str]:
+    """key -> rationale.  Missing file == empty baseline."""
+    if not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    entries = data.get("findings", {})
+    if isinstance(entries, list):  # tolerate the bare-list form
+        return {k: "" for k in entries}
+    return dict(entries)
+
+
+def write_baseline(path: str, findings: Sequence[Finding],
+                   old: Dict[str, str]) -> None:
+    entries = {}
+    for f in sorted(findings, key=lambda f: (f.rel, f.line, f.rule)):
+        entries[f.key()] = old.get(f.key(), "TODO: rationale")
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({
+            "comment": (
+                "Accepted lint findings of the shipped tree; the gate "
+                "(tests/test_lint_clean.py) fails on findings NOT listed "
+                "here.  This file may only shrink: fix the code and run "
+                "`python -m quokka_tpu.analysis.lint quokka_tpu/ "
+                "--write-baseline`.  Every entry carries a rationale."
+            ),
+            "findings": entries,
+        }, fh, indent=2, sort_keys=False)
+        fh.write("\n")
+
+
+def main(argv: Sequence[str] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m quokka_tpu.analysis.lint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("paths", nargs="+", help="files or directories to lint")
+    p.add_argument("--baseline", default=DEFAULT_BASELINE,
+                   help="baseline JSON (default: the checked-in one)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="report every finding (fixture/dev mode)")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="rewrite the baseline from the current tree "
+                        "(preserves rationales of surviving entries)")
+    p.add_argument("--quiet", action="store_true")
+    args = p.parse_args(argv)
+
+    findings = run_lint(args.paths)
+    baseline = {} if args.no_baseline else load_baseline(args.baseline)
+
+    if args.write_baseline:
+        write_baseline(args.baseline, findings,
+                       load_baseline(args.baseline))
+        print(f"wrote {len(findings)} finding(s) to {args.baseline}")
+        return 0
+
+    new = [f for f in findings if f.key() not in baseline]
+    current_keys = {f.key() for f in findings}
+    stale = sorted(k for k in baseline if k not in current_keys)
+
+    if not args.quiet:
+        for f in new:
+            print(f.render())
+        if stale:
+            print(f"{len(stale)} stale baseline entr"
+                  f"{'y' if len(stale) == 1 else 'ies'} (fixed code — shrink "
+                  "the baseline with --write-baseline):", file=sys.stderr)
+            for k in stale:
+                print(f"  {k}", file=sys.stderr)
+    if new:
+        print(f"{len(new)} new finding(s) "
+              f"({len(findings) - len(new)} baselined)", file=sys.stderr)
+        return 1
+    if stale:
+        # the gate fails on stale entries too (baseline may only shrink, and
+        # it shrinks in the same PR that fixes the finding) — keeps this CLI
+        # and tests/test_lint_clean.py answering identically
+        print(f"{len(stale)} stale baseline entr"
+              f"{'y' if len(stale) == 1 else 'ies'}; run --write-baseline",
+              file=sys.stderr)
+        return 1
+    if not args.quiet:
+        print(f"clean: 0 new findings ({len(findings)} baselined)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -115,14 +115,16 @@ def use_host_asof() -> bool:
     """Whether the as-of match runs as a native sequential merge on host
     (ops/asof._asof_match_host -> native/columnar.cpp).  On the CPU backend
     device arrays ARE host memory (np.asarray is zero-copy), so the O(n+m)
-    walk replaces an XLA sort bottleneck for free; on TPU it would mean a
-    d2h round trip, so the sort+scan device kernel stays."""
+    walk replaces an XLA sort bottleneck for free.  Everywhere else —
+    TPU *and* GPU — the time/key/valid columns would each pay a blocking
+    device-to-host copy first, so the sort+scan device kernel stays; the
+    env override remains for GPU experiments."""
     v = os.environ.get("QUOKKA_HOST_ASOF", "auto").lower()
     if v in ("1", "true", "yes", "on"):
         return True
     if v in ("0", "false", "no", "off"):
         return False
-    return _platform() != "tpu"
+    return _platform() == "cpu"
 
 
 # ---------------------------------------------------------------------------

@@ -36,7 +36,15 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from quokka_tpu.analysis import compat
+
 EMPTY = jnp.int32(2**31 - 1)
+
+
+class HashTableConvergenceError(RuntimeError):
+    """The lockstep insert failed to place every valid row (load factor or
+    probe-chain pathology).  Callers fall back to the sort-based kernels —
+    never proceed: unplaced rows silently alias slot 0's group."""
 
 _M1 = jnp.uint32(0x85EBCA6B)
 _M2 = jnp.uint32(0xC2B2AE35)
@@ -141,20 +149,29 @@ def _in_trace() -> bool:
     trace to the identical jaxpr a nested pjit would inline — sidesteps a
     jit-dispatch race observed when the engine's threads hit the same pjit
     object from both contexts (spurious 'Execution supplied N buffers but
-    compiled program expected M buffers' on the 1-core CPU backend)."""
-    try:
-        return not jax.core.trace_state_clean()
-    except Exception:
-        return False
+    compiled program expected M buffers' on the 1-core CPU backend).
+
+    The probe goes through the version-guarded shim: a jax upgrade that
+    moves the private API fails the package at import (analysis/compat.py)
+    instead of a swallowed exception silently answering False — which would
+    re-enable the dispatch race this helper exists to avoid."""
+    return not compat.trace_state_clean()
 
 
 def _insert_body(limbs: Tuple[jax.Array, ...], valid: jax.Array, capbits: int):
-    """Insert all valid rows; returns (slot_for_row, table).
+    """Insert all valid rows; returns (slot_for_row, table, converged).
 
     slot_for_row[i] is the slot holding row i's key (all equal keys share
     it); table[s] packs (claim_round << 24 | row_id) for the row that
     claimed slot s, or EMPTY.  Use `table_rid` to decode.  Invalid rows get
-    slot 0 — callers mask by `valid`.
+    slot 0 — callers mask by `valid`.  `converged` is a scalar bool: every
+    valid row placed before the round cap — when False the unplaced rows'
+    myslot=0 silently aliases slot 0's group, so untraced callers MUST
+    check it and fall back to the sort path (build_table raises
+    HashTableConvergenceError; hash_groupby reruns sorted_groupby).  With
+    load <= 0.5 and full-cycle double hashing non-convergence is
+    astronomically unlikely — but its failure mode is silent wrong
+    results, which is exactly what must never fail silently.
 
     The scatter must be claim-stable: a plain scatter-min of row ids would
     let a LATER round's smaller rid clobber an earlier claim, breaking the
@@ -192,14 +209,17 @@ def _insert_body(limbs: Tuple[jax.Array, ...], valid: jax.Array, capbits: int):
 
     tbl = jnp.full(cap, EMPTY)
     init = (tbl, slot0, ~valid, jnp.zeros(n, dtype=jnp.int32), jnp.int32(0))
-    tbl, _, _, myslot, _ = lax.while_loop(cond, body, init)
-    return myslot, tbl
+    tbl, _, placed, myslot, _ = lax.while_loop(cond, body, init)
+    return myslot, tbl, placed.all()
 
 
 _insert_jit = functools.partial(jax.jit, static_argnames=("capbits",))(_insert_body)
 
 
 def _insert(limbs, valid, capbits: int):
+    """(myslot, table, converged).  Traced calls cannot host-check the
+    converged flag; it stays an array for the caller's program (build_table,
+    the only untraced consumer, checks it and raises)."""
     fn = _insert_body if _in_trace() else _insert_jit
     return fn(limbs, valid, capbits)
 
@@ -252,23 +272,39 @@ def hash_groupby(limbs: Tuple[jax.Array, ...], arrays: Tuple[jax.Array, ...],
                  ops: Tuple[str, ...], valid: jax.Array):
     """Drop-in for `kernels.sorted_groupby` — same (outs, counts, rep, num)
     contract, except group ids come out in hash order rather than key order
-    (no consumer depends on group order; ORDER BY is an explicit node)."""
+    (no consumer depends on group order; ORDER BY is an explicit node).
+
+    Non-convergence of the insert (silent wrong groups otherwise): untraced
+    calls check the flag on host — one scalar d2h sync per batch, the price
+    of never answering wrong — and rerun through the sort path; traced
+    calls (fused/mesh programs) cannot host-branch, so they accept the
+    residual risk documented on `_insert_body` — the executors' untraced
+    batches are where the table strategy actually runs today."""
     capbits = capbits_for(valid.shape[0])
-    fn = _hash_groupby_body if _in_trace() else _hash_groupby_jit
-    return fn(tuple(limbs), tuple(arrays), ops, valid, capbits)
+    if _in_trace():
+        outs, counts, rep, num, _ = _hash_groupby_body(
+            tuple(limbs), tuple(arrays), ops, valid, capbits)
+        return outs, counts, rep, num
+    outs, counts, rep, num, converged = _hash_groupby_jit(
+        tuple(limbs), tuple(arrays), ops, valid, capbits)
+    if not bool(converged):
+        from quokka_tpu.ops import kernels
+
+        return kernels.sorted_groupby(tuple(limbs), tuple(arrays), ops, valid)
+    return outs, counts, rep, num
 
 
 def _hash_groupby_body(limbs, arrays, ops, valid, capbits):
     from quokka_tpu.ops import kernels
 
     climbs = canonical_limbs(limbs)
-    myslot, tbl = _insert_body(climbs, valid, capbits)
+    myslot, tbl, converged = _insert_body(climbs, valid, capbits)
     flag = (tbl != EMPTY).astype(jnp.int32)
     rank_of_slot = jnp.cumsum(flag) - flag
     ranks = rank_of_slot[myslot]
     num = jnp.sum(flag)
     outs, counts, rep = kernels._segment_aggs_body(ranks, valid, arrays, ops)
-    return tuple(outs), counts, rep, num
+    return tuple(outs), counts, rep, num, converged
 
 
 _hash_groupby_jit = functools.partial(
@@ -291,6 +327,11 @@ class _TableCache:
         self.capbits = capbits
 
 
+# negative-cache sentinel: a diverged build is remembered on the batch so a
+# long probe stream does not re-run the whole failed insert loop per probe
+_DIVERGED = object()
+
+
 def build_table(build, build_keys: Sequence[str], key_limbs_fn,
                 valid_fn) -> _TableCache:
     cache = getattr(build, "_ht_cache", None)
@@ -298,11 +339,22 @@ def build_table(build, build_keys: Sequence[str], key_limbs_fn,
         cache = build._ht_cache = {}
     key = tuple(build_keys)
     hit = cache.get(key)
+    if hit is _DIVERGED:
+        raise HashTableConvergenceError(
+            "hash-table build previously failed to converge for this build "
+            "batch (cached); take the sort-based probe")
     if hit is None:
         raw = key_limbs_fn(build, build_keys)
         limbs = canonical_limbs(raw, nan_unique=False)
         capbits = capbits_for(build.padded_len)
-        _, tbl = _insert(limbs, valid_fn() & ~nan_rows(raw), capbits)
+        _, tbl, converged = _insert(limbs, valid_fn() & ~nan_rows(raw),
+                                    capbits)
+        if not bool(converged):
+            cache[key] = _DIVERGED
+            raise HashTableConvergenceError(
+                f"hash-table build did not place every row "
+                f"(capbits={capbits}, n={build.padded_len}); caller must "
+                "fall back to the sort-based probe")
         hit = cache[key] = _TableCache(
             tbl, limbs, tuple(l.dtype for l in raw), capbits
         )

@@ -141,22 +141,33 @@ def hash_join_pk(
     how: str = "inner",
     build_payload: Sequence[str] = (),
 ) -> DeviceBatch:
-    """Join where build keys are unique.  Probe-aligned, no host sync."""
+    """Join where build keys are unique.  Probe-aligned; the probe path has
+    no host sync.  The cached build pays ONE scalar d2h per build batch (the
+    hash-table convergence check, hashtable.build_table) — a diverged build
+    is remembered on the batch and every probe takes the sort path."""
     probe_limbs = key_limbs(probe, probe_keys)
     probe_ok = _nonnull_valid(probe, probe_keys)
-    if config.use_hash_tables():
+    use_tables = config.use_hash_tables()
+    if use_tables:
         # hashtable is imported at module scope by kernels (imported above):
         # a first-import inside an active trace once mis-primed jit dispatch
         from quokka_tpu.ops import hashtable
 
-        table = hashtable.build_table(
-            build, build_keys, key_limbs,
-            lambda: _nonnull_valid(build, build_keys),
-        )
-        assert len(probe_limbs) == len(table.raw_dtypes), \
-            "join key column types must match"
-        build_idx, matched = hashtable.pk_probe(table, probe_limbs, probe_ok)
-    else:
+        try:
+            table = hashtable.build_table(
+                build, build_keys, key_limbs,
+                lambda: _nonnull_valid(build, build_keys),
+            )
+        except hashtable.HashTableConvergenceError:
+            # unplaced build rows would alias slot 0's key: take the sort
+            # path for this build batch instead of joining wrong
+            use_tables = False
+        else:
+            assert len(probe_limbs) == len(table.raw_dtypes), \
+                "join key column types must match"
+            build_idx, matched = hashtable.pk_probe(
+                table, probe_limbs, probe_ok)
+    if not use_tables:
         sorted_limbs, perm, n_valid = _build_sorted_cached(build, build_keys)
         assert len(probe_limbs) == len(sorted_limbs), \
             "join key column types must match"

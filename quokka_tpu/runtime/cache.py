@@ -39,7 +39,13 @@ def _batch_nbytes(batch) -> int:
 class BatchCache:
     def __init__(self, mem_limit_batches: int = 10_000,
                  mem_limit_bytes: int = 2 << 30):
-        self._lock = threading.Lock()
+        # QK_SANITIZE=1: lock-order recorder (analysis/sanitize.py) — the
+        # cache lock and the control-store lock are the two runtime-shared
+        # locks a data-plane/exec-loop inversion would deadlock on
+        from quokka_tpu.analysis import sanitize
+
+        self._lock = sanitize.maybe_instrument(
+            "batchcache", threading.Lock())
         self._data: Dict[Tuple, object] = {}  # 6-tuple name -> DeviceBatch
         # index: (tgt_actor, tgt_ch) -> (src_actor, src_ch) -> set of seqs
         self._index: Dict[Tuple, Dict[Tuple, Set[int]]] = defaultdict(

@@ -323,6 +323,19 @@ def _coordinate(graph, cs, procs, owned, timeout, kill_after_inputs,
                     # would execute (and tape) the same channels
                     p.kill()
                     p.join(timeout=10)
+                from quokka_tpu.analysis import sanitize
+
+                if (not p.is_alive()
+                        and p.exitcode == sanitize.WATCHDOG_EXIT_CODE):
+                    # the worker's sanitizer watchdog shot it after its main
+                    # loop stopped beating: fail the run loudly, whatever the
+                    # fault-tolerance setting — its stack dump is on stderr
+                    raise RuntimeError(
+                        f"worker {w} was killed by the QK_SANITIZE deadlock "
+                        f"watchdog (exit {sanitize.WATCHDOG_EXIT_CODE}): its "
+                        "main loop made no progress within the deadline; "
+                        "all thread stacks were dumped to the worker's stderr"
+                    )
                 if graph.hbq is None:
                     raise RuntimeError(
                         f"worker {w} died and fault_tolerance is not enabled "

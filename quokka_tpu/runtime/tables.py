@@ -45,7 +45,12 @@ class ControlStore:
     """Embedded transactional KV/table store (single leader semantics)."""
 
     def __init__(self):
-        self._lock = threading.RLock()
+        # QK_SANITIZE=1 wraps the lock in the lock-order recorder
+        # (analysis/sanitize.py); production gets the bare RLock
+        from quokka_tpu.analysis import sanitize
+
+        self._lock = sanitize.maybe_instrument(
+            "controlstore", threading.RLock())
         self.kv: Dict[str, Any] = {}
         self.tables: Dict[str, Dict] = {name: {} for name in TABLE_NAMES}
         # NTT values are deques of tasks

@@ -222,6 +222,11 @@ class Worker(Engine):
 
     # -- main loop ------------------------------------------------------------
     def run_worker(self, heartbeat_every: float = 0.2):
+        # QK_SANITIZE=1: the loop beats a watchdog; a dispatch that wedges
+        # (lock/pipe deadlock) stops the beats, and the watchdog dumps every
+        # thread's stack and kills this process — the coordinator then fails
+        # the run in seconds instead of hanging to its timeout
+        watchdog = getattr(self, "_watchdog", None)
         # startup barrier: wait until every worker's data-plane address is
         # registered, or the first push to a late-starting peer would fail
         expected = self.store.get("expected_workers")
@@ -236,6 +241,8 @@ class Worker(Engine):
             if time.time() - t0 > 120:
                 raise TimeoutError("peer workers never registered")
             self.store.heartbeat(self.worker_id)
+            if watchdog is not None:
+                watchdog.beat()
             time.sleep(0.05)
         last_hb = 0.0
         dbg = os.environ.get("QUOKKA_DEBUG_WORKER")
@@ -243,6 +250,8 @@ class Worker(Engine):
         actors = sorted(self.g.actors.values(), key=lambda a: (a.stage, a.id))
         while True:
             now = time.time()
+            if watchdog is not None:
+                watchdog.beat()
             if now - last_hb >= heartbeat_every:
                 self.store.heartbeat(self.worker_id)
                 last_hb = now
@@ -328,10 +337,15 @@ def worker_main(spec_bytes: bytes, store_addr, worker_id: int, owned):
         # the coordinator merges individual keys into 'worker_addrs' itself
         store.heartbeat(worker_id)
         w = Worker(spec, store, cache, worker_id, owned, hbq=hbq)
+        from quokka_tpu.analysis import sanitize
+
+        w._watchdog = sanitize.start_watchdog(f"worker-{worker_id}")
         try:
             w.run_worker()
             w._flush_emits()
         finally:
+            if w._watchdog is not None:
+                w._watchdog.stop()
             try:
                 w._flush_metrics()
             except Exception:

@@ -1,0 +1,2 @@
+# Seeded lint violations, one file per rule (tests/test_lint_rules.py).
+# These files are PARSED by the analyzer, never imported/executed.

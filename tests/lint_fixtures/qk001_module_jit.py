@@ -1,0 +1,21 @@
+"""Seeded QK001: a jit object built at module scope."""
+
+import functools
+
+import jax
+
+
+def _double(x):
+    return x * 2
+
+
+# the violation: a module-level pjit object shared across engine threads
+_double_jit = jax.jit(_double)
+
+# the partial form must be caught too
+_double_partial = functools.partial(jax.jit, static_argnames=())(_double)
+
+
+@jax.jit
+def _decorated(x):
+    return x + 1

@@ -1,0 +1,17 @@
+"""Seeded QK002: import-time side effects."""
+
+import os
+import threading
+
+from jax import monitoring
+
+
+def _cb(event, **kw):
+    pass  # inside a function: NOT an import-time effect (and QK006 ignores
+    # non-except pass)
+
+
+# violations: all of these run when the module is imported
+monitoring.register_event_listener(_cb)
+os.makedirs("/tmp/qk002_fixture", exist_ok=True)
+_t = threading.Thread(target=_cb, daemon=True)

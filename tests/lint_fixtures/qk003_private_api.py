@@ -1,0 +1,9 @@
+"""Seeded QK003: private JAX API outside the compat shim."""
+
+import jax
+
+
+def in_trace() -> bool:
+    # the violation: private surface used directly instead of
+    # quokka_tpu.analysis.compat
+    return not jax.core.trace_state_clean()

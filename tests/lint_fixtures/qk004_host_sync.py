@@ -1,0 +1,24 @@
+"""Seeded QK004: host syncs + python control flow in jit-reachable code.
+
+The jit wrapper is built inside a function so this fixture does not also
+trip QK001 — each fixture seeds exactly its own rule.
+"""
+
+import jax
+import numpy as np
+
+
+def _helper(x):
+    # violation: reachable from the jitted entry via _kernel
+    return np.asarray(x).sum()
+
+
+def _kernel(x, flip):
+    if flip:  # violation: python branch on a (non-static) parameter
+        x = -x
+    x.block_until_ready()  # violation: host sync inside traced code
+    return _helper(x)
+
+
+def make_kernel():
+    return jax.jit(_kernel)

@@ -1,0 +1,20 @@
+"""Seeded QK005: shared state mutated without the owning lock."""
+
+import threading
+
+
+class SharedTable:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.rows = {}
+        self.pending = []
+
+    def put_locked(self, k, v):
+        with self._lock:
+            self.rows[k] = v  # correct: not flagged
+
+    def put_racy(self, k, v):
+        self.rows[k] = v  # violation: no lock held
+
+    def enqueue_racy(self, task):
+        self.pending.append(task)  # violation: no lock held

@@ -1,0 +1,11 @@
+"""Seeded QK006: a swallowed exception in a runtime-style loop."""
+
+
+def drain(queue):
+    while True:
+        try:
+            item = queue.get_nowait()
+        except Exception:
+            pass  # violation: the loop wedges silently on real failures
+        else:
+            yield item

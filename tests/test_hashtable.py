@@ -116,7 +116,8 @@ def test_insert_claims_are_stable():
             (jnp.array(np.pad(keys, (0, pad - n))),), nan_unique=False)
         valid = jnp.arange(pad) < n
         capbits = H.capbits_for(pad)
-        _, tbl = H._insert(limbs, valid, capbits)
+        _, tbl, converged = H._insert(limbs, valid, capbits)
+        assert bool(converged)
         plimbs = H.canonical_limbs((jnp.array(keys),), nan_unique=False)
         bidx, ok = H._probe(tbl, limbs, plimbs, jnp.ones(n, bool), capbits)
         assert bool(np.asarray(ok).all())
@@ -129,3 +130,108 @@ def test_hash_groupby_empty_and_all_invalid():
     g = kernels.groupby_aggregate(
         b, ["k"], [("c", "count", None)])
     assert g.count_valid() == 0
+
+
+# -- insert non-convergence must never fail silently ------------------------
+# (advisor finding hashtable.py:178: unplaced rows used to keep myslot=0 and
+# silently merge into slot 0's group; now the flag routes untraced callers
+# to the sort path / a loud error.)
+
+
+def test_hash_groupby_falls_back_to_sort_on_nonconvergence(monkeypatch):
+    r = np.random.default_rng(5)
+    n = 2000
+    keys = r.integers(0, 300, n)
+    vals = r.random(n)
+    b = _batch({"k": (keys, "i"), "v": (vals, "f")}, n)
+    aggs = [("o", "sum", b.columns["v"].data)]
+    monkeypatch.setenv("QUOKKA_HASH_TABLES", "0")
+    want = _grouped_to_np(kernels.groupby_aggregate(b, ["k"], aggs),
+                          ["k", "o"])
+
+    # force the jitted body to report non-convergence: hash_groupby must
+    # answer through sorted_groupby, not through the (fake-)broken table
+    real = H._hash_groupby_jit
+
+    def broken(limbs, arrays, ops, valid, capbits):
+        outs, counts, rep, num, _ = real(limbs, arrays, ops, valid, capbits)
+        return (tuple(jnp.zeros_like(o) for o in outs), counts, rep,
+                jnp.int64(1), jnp.array(False))
+
+    monkeypatch.setattr(H, "_hash_groupby_jit", broken)
+    monkeypatch.setenv("QUOKKA_HASH_TABLES", "1")
+    got = _grouped_to_np(kernels.groupby_aggregate(b, ["k"], aggs),
+                         ["k", "o"])
+    np.testing.assert_array_equal(got["k"], want["k"])
+    np.testing.assert_allclose(got["o"], want["o"], rtol=1e-9)
+
+
+def test_build_table_raises_on_nonconvergence(monkeypatch):
+    build = _batch({"k": (np.arange(100), "i")}, 100)
+
+    def broken_insert(limbs, valid, capbits):
+        myslot, tbl, _ = H._insert_jit(limbs, valid, capbits)
+        return myslot, tbl, jnp.array(False)
+
+    calls = []
+
+    def counting_broken_insert(limbs, valid, capbits):
+        calls.append(1)
+        return broken_insert(limbs, valid, capbits)
+
+    monkeypatch.setattr(H, "_insert", counting_broken_insert)
+    with pytest.raises(H.HashTableConvergenceError):
+        H.build_table(build, ["k"],
+                      lambda b, ks: [b.columns[k].data for k in ks],
+                      lambda: build.valid)
+    # non-convergence is negatively cached on the batch: the next probe
+    # batch must NOT re-run the failed insert loop
+    with pytest.raises(H.HashTableConvergenceError):
+        H.build_table(build, ["k"],
+                      lambda b, ks: [b.columns[k].data for k in ks],
+                      lambda: build.valid)
+    assert len(calls) == 1
+
+
+def test_pk_join_survives_nonconvergent_build(monkeypatch):
+    """hash_join_pk must answer THROUGH THE SORT PATH when the table build
+    reports non-convergence — same rows as the sort-only run."""
+    r = np.random.default_rng(9)
+    bk = r.permutation(3000)[:1000]
+    build = _batch({"k": (bk, "i"), "pay": (bk * 2, "i")}, 1000)
+    probe = _batch({"k": (r.integers(0, 3000, 1024), "i")}, 1024)
+    monkeypatch.setenv("QUOKKA_HASH_TABLES", "0")
+    want = J.hash_join_pk(probe, build, ["k"], ["k"], "inner", ["pay"])
+
+    def always_diverges(*a, **kw):
+        raise H.HashTableConvergenceError("forced by test")
+
+    monkeypatch.setattr(H, "build_table", always_diverges)
+    monkeypatch.setenv("QUOKKA_HASH_TABLES", "1")
+    bcopy = DeviceBatch(dict(build.columns), build.valid)
+    probe2 = DeviceBatch(dict(probe.columns), probe.valid)
+    got = J.hash_join_pk(probe2, bcopy, ["k"], ["k"], "inner", ["pay"])
+    np.testing.assert_array_equal(np.asarray(got.valid),
+                                  np.asarray(want.valid))
+    v = np.asarray(want.valid)
+    np.testing.assert_array_equal(
+        np.asarray(got.columns["pay"].data)[v],
+        np.asarray(want.columns["pay"].data)[v])
+
+
+def test_use_host_asof_gated_to_cpu(monkeypatch):
+    """Satellite config.py:114: auto mode enables the host as-of walk ONLY
+    where np.asarray is zero-copy (CPU); GPU/TPU keep the device kernel.
+    The env override still wins everywhere."""
+    from quokka_tpu import config
+
+    monkeypatch.delenv("QUOKKA_HOST_ASOF", raising=False)
+    for plat, want in (("cpu", True), ("gpu", False), ("tpu", False)):
+        monkeypatch.setattr(config, "_platform", lambda p=plat: p)
+        assert config.use_host_asof() is want, plat
+    monkeypatch.setattr(config, "_platform", lambda: "gpu")
+    monkeypatch.setenv("QUOKKA_HOST_ASOF", "1")
+    assert config.use_host_asof() is True
+    monkeypatch.setenv("QUOKKA_HOST_ASOF", "0")
+    monkeypatch.setattr(config, "_platform", lambda: "cpu")
+    assert config.use_host_asof() is False

@@ -1,0 +1,43 @@
+"""Tier-1 lint gate: the shipped tree is clean against the checked-in
+baseline, and the baseline itself is healthy (no stale entries, every entry
+carries a real rationale).  This is the gate every later PR runs under —
+new invariant violations fail here; the baseline may only shrink."""
+
+import json
+import os
+
+from quokka_tpu.analysis.lint import (
+    DEFAULT_BASELINE,
+    load_baseline,
+    run_lint,
+)
+
+PKG = os.path.dirname(os.path.dirname(os.path.abspath(DEFAULT_BASELINE)))
+assert os.path.basename(PKG) == "quokka_tpu", PKG
+
+
+def test_package_is_clean_against_baseline():
+    findings = run_lint([PKG])
+    baseline = load_baseline(DEFAULT_BASELINE)
+    new = [f for f in findings if f.key() not in baseline]
+    assert not new, "new lint findings (fix or baseline with rationale):\n" \
+        + "\n".join(f.render() for f in new)
+
+
+def test_baseline_has_no_stale_entries():
+    """A fixed finding must leave the baseline in the same PR (the file may
+    only shrink; stale keys would hide a regression re-introducing the
+    same code shape elsewhere in the diff noise)."""
+    current = {f.key() for f in run_lint([PKG])}
+    stale = sorted(k for k in load_baseline(DEFAULT_BASELINE)
+                   if k not in current)
+    assert not stale, "stale baseline entries (run --write-baseline):\n" \
+        + "\n".join(stale)
+
+
+def test_baseline_entries_carry_rationales():
+    with open(DEFAULT_BASELINE) as f:
+        entries = json.load(f)["findings"]
+    bad = [k for k, v in entries.items()
+           if not isinstance(v, str) or len(v.strip()) < 10 or "TODO" in v]
+    assert not bad, f"baseline entries without a real rationale: {bad}"

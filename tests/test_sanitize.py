@@ -1,0 +1,156 @@
+"""Runtime sanitizer (QK_SANITIZE=1): watchdog, lock-order recorder,
+recompile sentinel — units plus the end-to-end deadlocked-two-worker
+fixture, which must fail fast with a stack dump instead of wedging to the
+coordinator's 600 s timeout."""
+
+import io
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from quokka_tpu.analysis import sanitize
+
+
+# -- watchdog ---------------------------------------------------------------
+
+
+def test_watchdog_fires_after_deadline_with_stack_dump():
+    shots = []
+    stream = io.StringIO()
+    wd = sanitize.Watchdog("t", deadline=0.3, _exit=shots.append,
+                           stream=stream).start()
+    try:
+        deadline = time.time() + 10
+        while not shots and time.time() < deadline:
+            time.sleep(0.05)
+    finally:
+        wd.stop()
+    assert shots == [sanitize.WATCHDOG_EXIT_CODE]
+    out = stream.getvalue()
+    assert "WATCHDOG" in out and "no progress" in out
+    # faulthandler dump: at least this (beating-test) thread's frames
+    assert "Current thread" in out or "Thread" in out
+    assert "test_sanitize" in out
+
+
+def test_watchdog_beats_keep_it_quiet():
+    shots = []
+    wd = sanitize.Watchdog("t", deadline=0.4, _exit=shots.append,
+                           stream=io.StringIO()).start()
+    try:
+        for _ in range(10):
+            wd.beat()
+            time.sleep(0.1)
+    finally:
+        wd.stop()
+    assert shots == []
+
+
+def test_start_watchdog_disabled_returns_none(monkeypatch):
+    monkeypatch.delenv("QK_SANITIZE", raising=False)
+    assert sanitize.start_watchdog("x") is None
+
+
+# -- lock-order recorder ----------------------------------------------------
+
+
+def test_lock_order_inversion_detected(capsys):
+    sanitize.reset_lock_order()
+    a = sanitize.InstrumentedLock("lockA", threading.Lock())
+    b = sanitize.InstrumentedLock("lockB", threading.Lock())
+    with a:
+        with b:
+            pass
+    assert sanitize.lock_inversions() == []
+    with b:
+        with a:
+            pass
+    assert sanitize.lock_inversions() == [("lockA", "lockB")]
+    assert "LOCK-ORDER INVERSION" in capsys.readouterr().err
+    sanitize.reset_lock_order()
+
+
+def test_lock_order_rlock_reentry_is_not_an_edge():
+    sanitize.reset_lock_order()
+    a = sanitize.InstrumentedLock("re", threading.RLock())
+    with a:
+        with a:
+            pass
+    assert sanitize.lock_inversions() == []
+    sanitize.reset_lock_order()
+
+
+def test_maybe_instrument_passthrough_when_disabled(monkeypatch):
+    monkeypatch.delenv("QK_SANITIZE", raising=False)
+    lk = threading.Lock()
+    assert sanitize.maybe_instrument("x", lk) is lk
+    monkeypatch.setenv("QK_SANITIZE", "1")
+    wrapped = sanitize.maybe_instrument("x", lk)
+    assert isinstance(wrapped, sanitize.InstrumentedLock)
+    sanitize.reset_lock_order()
+
+
+# -- recompile sentinel -----------------------------------------------------
+
+
+def test_recompile_sentinel_raises_on_real_compiles():
+    before = {"backend_compiles": 10, "cache_hits": 4}
+    after = {"backend_compiles": 13, "cache_hits": 5}
+    assert sanitize.real_compiles_delta(before, after) == 2
+    with pytest.raises(sanitize.RecompileError, match="2 real backend"):
+        sanitize.check_no_recompiles(before, after, context="timed runs",
+                                     force=True)
+
+
+def test_recompile_sentinel_ignores_cache_hits():
+    before = {"backend_compiles": 10, "cache_hits": 4}
+    after = {"backend_compiles": 12, "cache_hits": 6}  # hits, not compiles
+    assert sanitize.check_no_recompiles(before, after, force=True) == 0
+
+
+def test_recompile_sentinel_inert_without_flag(monkeypatch):
+    monkeypatch.delenv("QK_SANITIZE", raising=False)
+    before = {"backend_compiles": 0, "cache_hits": 0}
+    after = {"backend_compiles": 5, "cache_hits": 0}
+    assert sanitize.check_no_recompiles(before, after) == 5  # reports, no raise
+
+
+def test_recompile_guard_clean_body(monkeypatch):
+    monkeypatch.setenv("QK_SANITIZE", "1")
+    with sanitize.recompile_guard("noop"):
+        pass  # no compiles between the two snapshots
+
+
+# -- end-to-end: deadlocked two-worker fixture ------------------------------
+
+
+def test_deadlocked_workers_fail_fast_with_stack_dump():
+    """The acceptance criterion: with QK_SANITIZE=1 the deliberately-
+    deadlocked two-worker run produces a full stack dump and a nonzero exit
+    within the watchdog deadline — not a 600 s coordinator timeout."""
+    script = os.path.join(os.path.dirname(__file__),
+                          "sanitize_deadlock_case.py")
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "QK_SANITIZE": "1",
+        "QK_SANITIZE_DEADLINE": "5",
+    }
+    t0 = time.time()
+    r = subprocess.run([sys.executable, script], capture_output=True,
+                       text=True, timeout=240, env=env)
+    elapsed = time.time() - t0
+    out = r.stdout + r.stderr
+    assert r.returncode != 0, out
+    assert "UNEXPECTED-COMPLETION" not in out, out
+    # fail-fast: worker spawn + first batch + 5s deadline + detection, far
+    # under the 600 s coordinator timeout the round-5 wedge burned
+    assert elapsed < 180, f"took {elapsed:.0f}s — watchdog did not fire"
+    # the watchdog banner and a python-level stack dump naming the deadlock
+    assert "WATCHDOG" in out, out
+    assert "deadlock watchdog" in out, out  # coordinator's RuntimeError
+    assert "execute" in out, out  # DeadlockExecutor frame in the dump
