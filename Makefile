@@ -26,3 +26,9 @@ test-slow:
 # in seconds, with every thread's stack on stderr)
 sanitize-demo:
 	QK_SANITIZE=1 QK_SANITIZE_DEADLINE=5 $(PY) tests/sanitize_deadlock_case.py
+
+# watch the stall detector dump the merged flight-recorder timeline for the
+# same wedged run: Chrome trace (Perfetto-loadable) + stall report naming
+# the stuck worker and its in-flight task, in QK_DUMP_DIR
+stall-demo:
+	QK_COORD_TIMEOUT=20 $(PY) tests/sanitize_deadlock_case.py

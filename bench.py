@@ -254,6 +254,43 @@ def run_asof(paths):
 
 QUERIES = {"q1": run_q1, "q3": run_q3, "q5": run_q5}
 
+# span-name prefix -> breakdown bucket (obs/spans.py names)
+_BUCKET_PREFIXES = (
+    (("reader.", "prefetch"), "read_s"),
+    (("bridge.", "emit.", "count_valid"), "transfer_s"),
+    (("exec.", "done.", "push.", "source."), "compute_s"),
+)
+
+
+def _span_breakdown(span_stats):
+    """Collapse a spans.stats() snapshot into read/transfer/compute buckets
+    (compile time is taken from compilestats deltas, not spans)."""
+    buckets = {"read_s": 0.0, "transfer_s": 0.0, "compute_s": 0.0,
+               "other_s": 0.0}
+    for name, st in span_stats.items():
+        for prefixes, bucket in _BUCKET_PREFIXES:
+            if name.startswith(prefixes):
+                buckets[bucket] += st["total_s"]
+                break
+        else:
+            buckets["other_s"] += st["total_s"]
+    return {k: round(v, 4) for k, v in buckets.items()}
+
+
+def _write_obs_summary(obs_per_query):
+    """Per-query span/counter breakdown JSON next to the timing output
+    (BENCH_*.json gains compile-vs-compute-vs-transfer visibility)."""
+    from quokka_tpu import obs
+
+    path = os.environ.get("QUOKKA_BENCH_OBS", "bench_obs.json")
+    try:
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump({"per_query": obs_per_query,
+                       "counters": obs.REGISTRY.snapshot()}, f, indent=2)
+        sys.stderr.write(f"bench: per-query span/counter summary: {path}\n")
+    except OSError as e:
+        sys.stderr.write(f"bench: could not write obs summary {path}: {e}\n")
+
 
 def measure(paths):
     """The full measurement (runs inside the supervised child).  Emits one
@@ -263,10 +300,19 @@ def measure(paths):
     platform = jax.default_backend()
     nbytes = os.path.getsize(paths["lineitem"])
     per_query = {}
+    from quokka_tpu.obs import spans as obs_spans
     from quokka_tpu.utils import compilestats
 
+    # span aggregation ON regardless of QUOKKA_TRACE: the per-query
+    # breakdown JSON is part of the bench contract; QUOKKA_TRACE=1 only
+    # decides whether the human-readable summary prints too (read through
+    # spans.enabled() — the one owner of the env truthiness rule)
+    trace_print = obs_spans.enabled()
+    obs_spans.set_enabled(True)
+    obs_per_query = {}
     for qname, fn in QUERIES.items():
         ref = REF_SECONDS_SF100_4W[qname] * 4.0 / 100.0 * SF
+        obs_spans.reset()
         c0 = compilestats.snapshot()
         warm = fn(paths)  # compiles the kernel set for this query shape
         extra = {}
@@ -287,10 +333,38 @@ def measure(paths):
                 ),
             }
         c1 = compilestats.snapshot()
+        # two span windows so the buckets reconcile with their neighbors:
+        # "warmup" pairs with warmup_seconds/compile_seconds_warmup,
+        # "timed_runs" sums over the 3 runs whose best is `seconds`
+        spans_warmup = obs_spans.stats()
+        if trace_print:
+            sys.stderr.write(f"[spans] {qname} warmup\n"
+                             + obs_spans.summary() + "\n")
+        obs_spans.reset()
         times = sorted(fn(paths) for _ in range(3))
         c2 = compilestats.snapshot()
         t = times[0]
         speedup = ref / t
+        spans_timed = obs_spans.stats()
+        breakdown = {
+            "warmup": {
+                **_span_breakdown(spans_warmup),
+                "compile_s": round(c1["backend_compile_seconds"]
+                                   - c0["backend_compile_seconds"], 3),
+            },
+            "timed_runs": {
+                **_span_breakdown(spans_timed),
+                "runs": 3,
+                "compile_s": round(c2["backend_compile_seconds"]
+                                   - c1["backend_compile_seconds"], 3),
+            },
+        }
+        obs_per_query[qname] = {"spans_warmup": spans_warmup,
+                                "spans_timed": spans_timed,
+                                "breakdown": breakdown}
+        if trace_print:
+            sys.stderr.write(f"[spans] {qname} timed runs (3)\n"
+                             + obs_spans.summary() + "\n")
         per_query[qname] = {
             "seconds": round(t, 4),
             "seconds_all": [round(x, 4) for x in times],
@@ -305,6 +379,7 @@ def measure(paths):
                 c1["backend_compile_seconds"] - c0["backend_compile_seconds"], 3
             ),
             "cache_hits_warmup": c1["cache_hits"] - c0["cache_hits"],
+            "breakdown": breakdown,
             **extra,
         }
         # QK_SANITIZE=1: the recompile sentinel fails the run outright when
@@ -345,6 +420,7 @@ def measure(paths):
     old_handler = signal.signal(signal.SIGALRM, _asof_alarm)
     signal.alarm(int(os.environ.get("QUOKKA_BENCH_ASOF_TIMEOUT", "600")))
     try:
+        obs_spans.reset()
         run_asof(paths)  # compile warm-up
         asof_times = sorted(run_asof(paths) for _ in range(3))
         asof_rows = ASOF_TRADES + ASOF_QUOTES
@@ -363,11 +439,22 @@ def measure(paths):
             },
         }))
         sys.stdout.flush()
+        asof_spans = obs_spans.stats()
+        obs_per_query["asof"] = {
+            "spans": asof_spans,
+            # one window here: warmup + 3 timed runs (the asof line reports
+            # seconds_all, not a single best-run pairing)
+            "breakdown": {**_span_breakdown(asof_spans), "runs": 4},
+        }
+        if trace_print:
+            sys.stderr.write("[spans] asof (warmup + 3 timed runs)\n"
+                             + obs_spans.summary() + "\n")
     except Exception as e:  # noqa: BLE001 — the TPC-H lines must survive
         sys.stderr.write(f"bench: asof section skipped: {e}\n")
     finally:
         signal.alarm(0)
         signal.signal(signal.SIGALRM, old_handler)
+    _write_obs_summary(obs_per_query)
     geomean = math.exp(
         sum(math.log(v["speedup_vs_ref_per_chip"]) for v in per_query.values())
         / len(per_query)
@@ -442,6 +529,10 @@ def _run_child(platform: str, timeout: int):
         sys.stderr.write(f"bench: measurement child rc={r.returncode}:\n"
                          f"{r.stderr[-2000:]}\n")
         return None
+    if r.stderr:
+        # the child's stderr carries the QUOKKA_TRACE span summaries and the
+        # obs-summary path; forward it (stdout stays machine-parseable)
+        sys.stderr.write(r.stderr[-8000:])
     lines = [
         ln.strip() for ln in r.stdout.strip().splitlines()
         if ln.strip().startswith("{")

@@ -19,6 +19,8 @@ Rule ids:
   QK005 unlocked-shared-state   lock-owning classes/modules mutating their
                                 shared containers without holding the lock
   QK006 swallowed-exception     except handlers whose body is only ``pass``
+  QK007 bare-print              print(...) in library code outside CLI entry
+                                points (route through quokka_tpu.obs.diag)
 
 Finding keys (``Finding.key``) are line-number-free — ``rule::relpath::
 scope::snippet[::n]`` — so a baseline survives unrelated edits above the
@@ -685,6 +687,44 @@ def check_swallowed_exceptions(tree: ast.Module, path: str, rel: str,
     return out
 
 
+# ---------------------------------------------------------------------------
+# QK007 — bare print in library code
+# ---------------------------------------------------------------------------
+
+# CLI drivers whose job IS printing (argparse entry points)
+BARE_PRINT_EXEMPT_SUFFIXES = ("analysis/lint.py",)
+# functions that are process entry points: `main`-style CLI drivers
+_BARE_PRINT_EXEMPT_FUNCS = ("main", "_main")
+
+
+def check_bare_print(tree: ast.Module, path: str, rel: str,
+                     src_lines: Sequence[str]) -> List[Finding]:
+    """Library code must not print: stdout lines from a worker process are
+    invisible (spawned children), interleave across processes, and carry no
+    timestamp/ordering.  Diagnostics route through quokka_tpu.obs.diag()
+    (stderr + a flight-recorder event) so they land in merged timelines.
+    Exempt: CLI entry points (``main``/``_main`` functions and the lint
+    driver itself)."""
+    if rel.replace("\\", "/").endswith(BARE_PRINT_EXEMPT_SUFFIXES):
+        return []
+    out: List[Finding] = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "print"):
+            continue
+        scope = _scope_of(tree, node)
+        if scope.rsplit(".", 1)[-1] in _BARE_PRINT_EXEMPT_FUNCS:
+            continue
+        out.append(_mk(
+            "QK007", "bare-print", path, rel, node, scope,
+            "bare 'print(...)' in library code — route diagnostics through "
+            "quokka_tpu.obs.diag() (stderr + flight-recorder event, visible "
+            "in merged timelines) or baseline with a rationale",
+            src_lines))
+    return out
+
+
 RULES = (
     check_module_level_jit,
     check_import_time_side_effects,
@@ -692,6 +732,7 @@ RULES = (
     check_host_sync_in_jit,
     check_unlocked_shared_state,
     check_swallowed_exceptions,
+    check_bare_print,
 )
 
 

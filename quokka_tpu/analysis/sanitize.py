@@ -127,6 +127,13 @@ class Watchdog:
                     f"(deadline {self.deadline:.1f}s) — dumping all thread "
                     f"stacks and exiting {self.exit_code}\n")
                 dump_all_stacks(stream)
+                # the flight recorder's tail + per-thread current activity:
+                # stacks say WHERE the process is stuck, the recorder says
+                # WHAT it was doing on the way there (obs/recorder.py)
+                with contextlib.suppress(Exception):
+                    from quokka_tpu.obs import recorder as _flight
+
+                    _flight.RECORDER.dump_text(stream, last_n=50)
                 inv = lock_inversions()
                 if inv:
                     stream.write(
@@ -208,15 +215,26 @@ def reset_lock_order() -> None:
 
 
 class InstrumentedLock:
-    """Wraps a Lock/RLock recording acquisition order under its name."""
+    """Wraps a Lock/RLock recording acquisition order under its name.
+    Contended acquisitions (wait > _SLOW_ACQUIRE_S) additionally land in
+    the flight recorder as ``lock`` events — the "lock acquire" channel of
+    the merged timeline."""
+
+    _SLOW_ACQUIRE_S = 0.005
 
     def __init__(self, name: str, lock):
         self.name = name
         self._lock = lock
 
     def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        t0 = time.monotonic()
         got = self._lock.acquire(blocking, timeout)
         if got:
+            waited = time.monotonic() - t0
+            if waited > self._SLOW_ACQUIRE_S:
+                from quokka_tpu.obs import recorder as _flight
+
+                _flight.RECORDER.record("lock", self.name, dur=waited)
             _record_acquire(self.name)
         return got
 

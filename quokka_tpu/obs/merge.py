@@ -1,0 +1,240 @@
+"""Coordinator-side timeline merger, Chrome-trace export, stall reports.
+
+Per-worker flight-recorder streams (shipped through the control store as
+incremental snapshots) merge into one wall-clock-ordered timeline.  Two
+renderings:
+
+- Chrome trace-event JSON — ``{"traceEvents": [...]}`` — loadable in
+  Perfetto (ui.perfetto.dev -> "Open trace file") or chrome://tracing.
+  Spans become complete ("X") events with start = end - duration; instants
+  become "i" events.  One Perfetto "process" track per worker plus the
+  coordinator, one thread track per recorded thread.
+- a human-readable stall report: per-worker liveness (heartbeat age, last
+  progress, in-flight task from the coordinator's pop records), pending
+  task-queue depths, each worker's last events, and a one-line verdict
+  naming the stuck worker and its in-flight task.
+
+``dump_flight`` ties them together: on heartbeat silence or coordinator
+timeout the distributed runtime writes both files into ``QK_DUMP_DIR``
+(default ``<tmp>/quokka_tpu_dumps``) instead of dying with a bare timeout.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import sys
+import tempfile
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+# a worker whose heartbeat is older than this while peers stay fresh is
+# presumed wedged (heartbeats flow at 0.2 s between dispatches; only a
+# dispatch that never returns silences them for seconds)
+STUCK_AFTER_S = 2.0
+
+
+def merge_streams(streams: Dict[str, Sequence[tuple]]) -> List[dict]:
+    """{stream_name: [recorder event tuples]} -> one ordered timeline of
+    dicts.  Ordering is (wall-clock ts, stream, seq): recorder timestamps
+    are ``time.time()`` precisely so cross-process streams share an axis;
+    same-process ties break on the ring sequence number, which preserves
+    each stream's own order (monotone by construction)."""
+    merged: List[dict] = []
+    for pid, evs in streams.items():
+        for e in evs:
+            seq, ts, kind, name, dur, thread, args = e
+            merged.append({
+                "pid": str(pid), "seq": int(seq), "ts": float(ts),
+                "kind": kind, "name": name, "dur": float(dur),
+                "tid": thread, "args": dict(args) if args else {},
+            })
+    merged.sort(key=lambda d: (d["ts"], d["pid"], d["seq"]))
+    return merged
+
+
+def to_chrome_trace(merged: Sequence[dict]) -> dict:
+    """Chrome trace-event JSON (https://docs.google.com/document/d/1CvAClvFfyA5R-PhYUmn5OOQtYMH4h6I0nSsKchNAySU)
+    from a merged timeline.  Timestamps are microseconds relative to the
+    earliest event start so Perfetto's viewport lands on the data."""
+    if merged:
+        t0 = min(d["ts"] - d["dur"] for d in merged)
+    else:
+        t0 = 0.0
+    events = []
+    for d in merged:
+        name = d["name"] or d["kind"]
+        base = {
+            "name": name,
+            "cat": d["kind"],
+            "pid": d["pid"],
+            "tid": d["tid"],
+            "args": d["args"],
+        }
+        if d["dur"] > 0:
+            base.update(ph="X", ts=round((d["ts"] - d["dur"] - t0) * 1e6, 1),
+                        dur=round(d["dur"] * 1e6, 1))
+        else:
+            base.update(ph="i", s="t", ts=round((d["ts"] - t0) * 1e6, 1))
+        events.append(base)
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "otherData": {"t0_unix_s": t0}}
+
+
+def write_chrome_trace(path: str, merged: Sequence[dict]) -> str:
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(to_chrome_trace(merged), f)
+    return path
+
+
+# ---------------------------------------------------------------------------
+# Stall analysis
+# ---------------------------------------------------------------------------
+
+
+def find_stuck(heartbeats: Dict[int, float],
+               inflight: Dict[int, tuple],
+               now: Optional[float] = None) -> List[Tuple[int, float, tuple]]:
+    """[(worker_id, heartbeat_age_s, inflight_record_or_None)] for every
+    worker whose heartbeat has been silent past STUCK_AFTER_S, oldest
+    first.  ``inflight`` is the coordinator-side pop record
+    {worker: (actor, channel, task_kind, popped_at)}."""
+    now = time.time() if now is None else now
+    out = []
+    for w, hb in heartbeats.items():
+        age = now - hb
+        if age > STUCK_AFTER_S:
+            out.append((w, age, inflight.get(w)))
+    out.sort(key=lambda x: -x[1])
+    return out
+
+
+def stuck_headline(stuck: List[Tuple[int, float, tuple]],
+                   have_heartbeats: bool = True) -> str:
+    if not stuck:
+        if not have_heartbeats:
+            # embedded run, or workers never got as far as a heartbeat —
+            # claiming "all heartbeats fresh" here would be a false verdict
+            return ("no per-worker heartbeat data (embedded engine, or "
+                    "workers never heartbeated) — see the event tail below")
+        return "no worker looks wedged (all heartbeats fresh)"
+    w, age, rec = stuck[0]
+    if rec is not None:
+        actor, ch, kind, t = rec
+        return (f"stuck worker {w}: in-flight {kind} task "
+                f"(actor {actor}, channel {ch}) — heartbeat silent "
+                f"{age:.1f}s")
+    return f"stuck worker {w}: heartbeat silent {age:.1f}s (no task popped)"
+
+
+def stall_report(reason: str,
+                 merged: Sequence[dict],
+                 heartbeats: Dict[int, float],
+                 states: Dict[int, object],
+                 inflight: Dict[int, tuple],
+                 ntt_depth: Optional[Dict] = None,
+                 now: Optional[float] = None,
+                 last_n: int = 15) -> str:
+    now = time.time() if now is None else now
+    lines = ["==== quokka-tpu stall report ====", f"reason: {reason}",
+             f"wall clock: {now:.3f}"]
+    stuck = find_stuck(heartbeats, inflight, now)
+    lines.append(
+        f"verdict: {stuck_headline(stuck, have_heartbeats=bool(heartbeats))}")
+    workers = sorted(set(heartbeats) | set(states) | set(inflight))
+    lines.append(f"workers ({len(workers)}):")
+    for w in workers:
+        hb = heartbeats.get(w)
+        hb_s = f"heartbeat {now - hb:.1f}s ago" if hb else "no heartbeat yet"
+        flight = inflight.get(w)
+        if flight is not None:
+            actor, ch, kind, t = flight
+            fl_s = (f"last pop: {kind} task (actor {actor}, channel {ch}) "
+                    f"{now - t:.1f}s ago")
+        else:
+            fl_s = "last pop: none"
+        wedged = any(sw == w for sw, _, _ in stuck)
+        lines.append(f"  worker {w}: {hb_s}; {fl_s}"
+                     + ("  <-- WEDGED" if wedged else ""))
+        st = states.get(w)
+        if st is not None:
+            lines.append(f"    state: {_render_state(st, now)}")
+    if ntt_depth:
+        pending = {str(k): v for k, v in sorted(ntt_depth.items()) if v}
+        lines.append(f"pending task queues (actor -> depth): {pending}")
+    by_pid: Dict[str, List[dict]] = {}
+    for d in merged:
+        by_pid.setdefault(d["pid"], []).append(d)
+    for pid in sorted(by_pid):
+        evs = by_pid[pid][-last_n:]
+        lines.append(f"last {len(evs)} event(s) of {pid}:")
+        for d in evs:
+            dur = f" dur={d['dur'] * 1e3:.2f}ms" if d["dur"] else ""
+            args = f" {d['args']}" if d["args"] else ""
+            lines.append(f"  {d['ts']:.6f} [{d['tid']}] "
+                         f"{d['kind']}:{d['name']}{dur}{args}")
+    lines.append("=" * 33)
+    return "\n".join(lines) + "\n"
+
+
+def _render_state(st, now: float) -> str:
+    """WorkerState (runtime/state.py) or any mapping shipped in a heartbeat."""
+    d = getattr(st, "__dict__", None) or (st if isinstance(st, dict) else {})
+    parts = []
+    for k, v in d.items():
+        if k in ("last_progress", "ts") and isinstance(v, (int, float)) and v:
+            parts.append(f"{k}={now - v:.1f}s ago")
+        else:
+            parts.append(f"{k}={v}")
+    return ", ".join(parts) if parts else repr(st)
+
+
+# ---------------------------------------------------------------------------
+# Dump orchestration
+# ---------------------------------------------------------------------------
+
+
+def dump_dir() -> str:
+    return os.environ.get("QK_DUMP_DIR") or os.path.join(
+        tempfile.gettempdir(), "quokka_tpu_dumps")
+
+
+def dump_flight(reason: str,
+                streams: Dict[str, Sequence[tuple]],
+                heartbeats: Optional[Dict[int, float]] = None,
+                states: Optional[Dict[int, object]] = None,
+                inflight: Optional[Dict[int, tuple]] = None,
+                ntt_depth: Optional[Dict] = None,
+                directory: Optional[str] = None,
+                echo: bool = True) -> Tuple[str, str, str]:
+    """Write the merged Chrome trace + stall report; returns
+    (trace_path, report_path, one-line headline).  Never raises: a failed
+    dump must not mask the stall it is describing."""
+    heartbeats = heartbeats or {}
+    try:
+        merged = merge_streams(streams)
+        d = directory or dump_dir()
+        os.makedirs(d, exist_ok=True)
+        stamp = f"{os.getpid()}-{int(time.time())}"
+        trace_path = os.path.join(d, f"flight-{stamp}.trace.json")
+        report_path = os.path.join(d, f"flight-{stamp}.report.txt")
+        write_chrome_trace(trace_path, merged)
+        report = stall_report(reason, merged, heartbeats, states or {},
+                              inflight or {}, ntt_depth)
+        headline = stuck_headline(find_stuck(heartbeats, inflight or {}),
+                                  have_heartbeats=bool(heartbeats))
+        with open(report_path, "w", encoding="utf-8") as f:
+            f.write(report)
+            f.write(f"chrome trace: {trace_path} "
+                    f"(load at ui.perfetto.dev)\n")
+        if echo:
+            sys.stderr.write(report)
+            sys.stderr.write(f"[flight-recorder] merged trace: {trace_path}; "
+                             f"report: {report_path}\n")
+            sys.stderr.flush()
+        return trace_path, report_path, headline
+    except Exception as e:  # noqa: BLE001 — diagnostics must not mask the stall
+        with contextlib.suppress(OSError, ValueError):
+            sys.stderr.write(f"[flight-recorder] dump failed: {e!r}\n")
+        return "", "", f"(flight dump failed: {e!r})"

@@ -1,0 +1,153 @@
+"""Typed counters/gauges + the engine's per-channel task accounting.
+
+Replaces the ad-hoc ``_metrics`` dict that used to live inline in
+runtime/engine.py with two layers:
+
+- a process-wide ``Registry`` of named ``Counter``/``Gauge`` instruments
+  (cache hits, rpc calls, bytes pushed, ...) that bench.py snapshots into
+  its per-query breakdown JSON;
+- ``EngineMetrics``: the per-(actor, channel) {tasks, rows, bytes}
+  accounting every engine/worker flushes through the control store —
+  byte-identical snapshot shape to the old ``_metrics``/``_flush_metrics``
+  (``graph.metrics()`` consumers are oblivious), including the deferred
+  device-row counters (a device count scalar resolves at flush time, when
+  its async host copy has long landed — emit paths must not block on a
+  device round trip for a counter).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Dict, List, Optional, Tuple
+
+
+class Counter:
+    """Monotone counter.  ``inc`` takes the registry lock: increments are
+    read-modify-write and these sit on per-task (not per-row) paths."""
+
+    __slots__ = ("name", "_value", "_lock")
+
+    def __init__(self, name: str, lock: threading.Lock):
+        self.name = name
+        self._value = 0
+        self._lock = lock
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> int:
+        return self._value
+
+
+class Gauge:
+    """Last-write-wins instrument (queue depths, buffer sizes)."""
+
+    __slots__ = ("name", "_value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value = 0.0
+
+    def set(self, v: float) -> None:
+        self._value = float(v)  # single store: atomic under the GIL
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+
+    def counter(self, name: str) -> Counter:
+        c = self._counters.get(name)
+        if c is None:
+            with self._lock:
+                c = self._counters.setdefault(name, Counter(name, self._lock))
+        return c
+
+    def gauge(self, name: str) -> Gauge:
+        g = self._gauges.get(name)
+        if g is None:
+            with self._lock:
+                g = self._gauges.setdefault(name, Gauge(name))
+        return g
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            out: Dict[str, float] = {n: c.value
+                                     for n, c in self._counters.items()}
+            out.update({n: g.value for n, g in self._gauges.items()})
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+
+
+REGISTRY = Registry()
+
+
+class _ChannelCounters:
+    __slots__ = ("tasks", "rows", "bytes")
+
+    def __init__(self):
+        self.tasks = 0
+        self.rows = 0
+        self.bytes = 0
+
+    def as_dict(self) -> Dict[str, int]:
+        return {"tasks": self.tasks, "rows": self.rows, "bytes": self.bytes}
+
+
+class EngineMetrics:
+    """Per-(actor, channel) progress counters an engine/worker flushes to
+    the store under ``("metrics", worker_id)`` — the exact contract
+    TaskGraph.metrics() aggregates."""
+
+    def __init__(self):
+        self._chan: Dict[Tuple[int, int], _ChannelCounters] = {}
+        # (key, device-scalar) pairs resolved lazily at flush time
+        self._pending: List[Tuple[Tuple[int, int], object]] = []
+        self.dirty = 0
+
+    def __bool__(self) -> bool:
+        return bool(self._chan)
+
+    def task(self, actor: int, channel: int, rows, nbytes: int) -> None:
+        """rows: an int, or a device count scalar (resolved at flush)."""
+        key = (actor, channel)
+        e = self._chan.get(key)
+        if e is None:
+            e = self._chan[key] = _ChannelCounters()
+        e.tasks += 1
+        if isinstance(rows, int):
+            e.rows += rows
+        elif rows is not None:
+            self._pending.append((key, rows))
+        e.bytes += nbytes
+        self.dirty += 1
+
+    def snapshot(self) -> Dict:
+        """Resolve deferred device rows and render the store payload:
+        {(actor, ch): {tasks, rows, bytes}, "__compile__": compile stats}."""
+        for key, dev in self._pending:
+            # a dead device buffer must not sink the flush
+            with contextlib.suppress(Exception):
+                self._chan[key].rows += int(dev)
+        self._pending = []
+        snap: Dict = {k: c.as_dict() for k, c in self._chan.items()}
+        from quokka_tpu.utils import compilestats
+
+        # each worker process has its own counters; ship them with the
+        # flush so metrics() can see worker-side compile churn
+        snap["__compile__"] = compilestats.snapshot()
+        self.dirty = 0
+        return snap

@@ -1,0 +1,95 @@
+"""Structured tracing: named spans with aggregate timings + flight events.
+
+The span API that used to live in utils/tracing.py (which now re-exports
+this module).  Two consumers share one ``span(...)`` call site:
+
+- the aggregate summary (``QUOKKA_TRACE=1`` or ``set_enabled(True)``):
+  name -> (count, total seconds), printed by bench.py at run end — the
+  replacement for the reference's print_if_profile timestamp prints
+  (pyquokka/core.py:20-30);
+- the flight recorder: every span lands as a duration event in the ring
+  (obs/recorder.py) so merged timelines show where time went per worker.
+
+When neither consumer is live the span body pays nothing but the two
+``perf_counter`` calls it skipped before this refactor, restored by the
+early-out below.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+from typing import Dict
+
+from quokka_tpu.obs import recorder as _recorder
+
+_enabled = os.environ.get("QUOKKA_TRACE", "0") not in ("0", "", "false")
+
+_lock = threading.Lock()
+_stats = defaultdict(lambda: [0, 0.0])  # name -> [count, total_seconds]
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def set_enabled(on: bool) -> None:
+    """Turn aggregate collection on programmatically (bench.py does this so
+    its breakdown JSON is populated even without QUOKKA_TRACE=1)."""
+    global _enabled
+    _enabled = bool(on)
+
+
+@contextmanager
+def span(name: str):
+    rec = _recorder.RECORDER
+    if not (_enabled or rec.enabled):
+        yield
+        return
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        if _enabled:
+            with _lock:
+                s = _stats[name]
+                s[0] += 1
+                s[1] += dt
+        rec.record("span", name, dur=dt)
+
+
+def add(name: str, seconds: float, count: int = 1):
+    rec = _recorder.RECORDER
+    if not (_enabled or rec.enabled):
+        return
+    if _enabled:
+        with _lock:
+            s = _stats[name]
+            s[0] += count
+            s[1] += seconds
+    rec.record("span", name, dur=seconds, count=count)
+
+
+def stats() -> Dict[str, Dict[str, float]]:
+    """Structured snapshot: name -> {count, total_s} (bench breakdown)."""
+    with _lock:
+        return {name: {"count": n, "total_s": round(total, 6)}
+                for name, (n, total) in _stats.items()}
+
+
+def summary() -> str:
+    with _lock:
+        rows = sorted(_stats.items(), key=lambda kv: -kv[1][1])
+    lines = [f"{'span':<28}{'count':>8}{'total_s':>10}{'avg_ms':>10}"]
+    for name, (n, total) in rows:
+        lines.append(f"{name:<28}{n:>8}{total:>10.3f}{total / max(n,1) * 1e3:>10.2f}")
+    return "\n".join(lines)
+
+
+def reset():
+    with _lock:
+        _stats.clear()

@@ -290,7 +290,7 @@ class DeviceBatch:
 
     def count_valid(self) -> int:
         if self.nrows is None:
-            from quokka_tpu.utils import tracing
+            from quokka_tpu.obs import spans as tracing
 
             src = self.nrows_dev if self.nrows_dev is not None else jnp.sum(self.valid)
             with tracing.span("count_valid.block"):

@@ -89,29 +89,51 @@ class BatchCache:
     ) -> Optional[Tuple[int, List[Tuple]]]:
         """Return (source_actor, [names...]) to consume next, or None."""
         channel_major = channel_major or set()
+        plan = None
         with self._lock:
             idx = self._index.get((tgt_actor, tgt_ch))
-            if not idx:
-                return None
-            candidates = []  # (stage, ready_count, src_actor, [names])
-            for src_actor, chans in input_reqs.items():
-                if src_actor in channel_major:
-                    names = self._plan_channel_major(idx, src_actor, tgt_actor, tgt_ch, chans, max_batches)
-                elif src_actor in sorted_actors:
-                    names = self._plan_sorted(idx, src_actor, tgt_actor, tgt_ch, chans, max_batches)
-                else:
-                    names = self._plan_contiguous(idx, src_actor, tgt_actor, tgt_ch, chans, max_batches)
-                if names:
-                    candidates.append(
-                        (actor_stages.get(src_actor, 0), -len(names), src_actor, names)
-                    )
-            if not candidates:
-                return None
-            candidates.sort()
-            min_stage = candidates[0][0]
-            candidates = [c for c in candidates if c[0] == min_stage]
-            _, _, src_actor, names = candidates[0]
-            return src_actor, names
+            if idx:
+                candidates = []  # (stage, ready_count, src_actor, [names])
+                for src_actor, chans in input_reqs.items():
+                    if src_actor in channel_major:
+                        names = self._plan_channel_major(idx, src_actor, tgt_actor, tgt_ch, chans, max_batches)
+                    elif src_actor in sorted_actors:
+                        names = self._plan_sorted(idx, src_actor, tgt_actor, tgt_ch, chans, max_batches)
+                    else:
+                        names = self._plan_contiguous(idx, src_actor, tgt_actor, tgt_ch, chans, max_batches)
+                    if names:
+                        candidates.append(
+                            (actor_stages.get(src_actor, 0), -len(names), src_actor, names)
+                        )
+                if candidates:
+                    candidates.sort()
+                    min_stage = candidates[0][0]
+                    candidates = [c for c in candidates if c[0] == min_stage]
+                    _, _, src_actor, names = candidates[0]
+                    plan = (src_actor, names)
+        self._account_plan((tgt_actor, tgt_ch), plan)
+        return plan
+
+    def _account_plan(self, tgt: Tuple[int, int], plan) -> None:
+        """Cache hit/miss observability, OUTSIDE the cache lock.  Misses are
+        recorded only on a hit->miss transition per consumer channel: an
+        executor polling for input retries plan_get in a tight loop, and
+        per-retry events would flood the flight ring."""
+        from quokka_tpu import obs
+
+        state = getattr(self, "_plan_state", None)
+        if state is None:
+            state = self._plan_state = {}
+        if plan is not None:
+            obs.REGISTRY.counter("cache.plan_hit").inc()
+            obs.RECORDER.record("cache.hit", f"a{tgt[0]}c{tgt[1]}",
+                                src=plan[0], batches=len(plan[1]))
+            state[tgt] = True
+        else:
+            obs.REGISTRY.counter("cache.plan_miss").inc()
+            if state.get(tgt, True):
+                state[tgt] = False
+                obs.RECORDER.record("cache.miss", f"a{tgt[0]}c{tgt[1]}")
 
     def _plan_contiguous(self, idx, src_actor, tgt_actor, tgt_ch, chans, max_batches):
         names = []

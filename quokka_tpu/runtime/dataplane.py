@@ -10,10 +10,12 @@ and land on-device (bridge.arrow_to_device) at the receiving worker.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Optional, Tuple
 
 import pyarrow as pa
 
+from quokka_tpu import obs
 from quokka_tpu.ops import bridge
 from quokka_tpu.runtime.cache import BatchCache
 from quokka_tpu.runtime.rpc import RpcClient, RpcServer
@@ -44,8 +46,14 @@ class CacheService:
         self._lock = threading.RLock()  # for RpcServer __multi__ (unused)
 
     def put_ipc(self, name: Tuple, ipc: bytes, sorted_by=None):
+        t0 = time.perf_counter()
         batch = bridge.arrow_to_device(ipc_to_table(ipc), sorted_by=sorted_by)
         self.cache.put(tuple(name), batch)
+        # receiving side of a cross-worker push: lands in THIS worker's
+        # flight stream (the RPC handler thread runs here)
+        obs.RECORDER.record("pull.batch", f"a{name[0]}c{name[1]}s{name[2]}",
+                            dur=time.perf_counter() - t0, nbytes=len(ipc))
+        obs.REGISTRY.counter("dataplane.recv_bytes").inc(len(ipc))
 
     def size(self) -> int:
         return self.cache.size()
@@ -74,10 +82,12 @@ class DataPlaneClient:
         self._rpc = RpcClient(address, timeout=timeout)
 
     def put(self, name: Tuple, batch, sorted_by=None) -> None:
-        self._rpc.call(
-            "put_ipc", tuple(name), table_to_ipc(bridge.device_to_arrow(batch)),
-            sorted_by,
-        )
+        t0 = time.perf_counter()
+        ipc = table_to_ipc(bridge.device_to_arrow(batch))
+        self._rpc.call("put_ipc", tuple(name), ipc, sorted_by)
+        obs.RECORDER.record("push.batch", f"a{name[0]}c{name[1]}s{name[2]}",
+                            dur=time.perf_counter() - t0, nbytes=len(ipc))
+        obs.REGISTRY.counter("dataplane.sent_bytes").inc(len(ipc))
 
     def hbq_names_for_target(self, tgt_actor: int, tgt_ch: int):
         return [tuple(n) for n in

@@ -25,9 +25,48 @@ import signal
 import time
 from typing import Dict, List, Optional, Tuple
 
+from quokka_tpu import obs
 from quokka_tpu.runtime.dataplane import ipc_to_table
 from quokka_tpu.runtime.store_service import CoordinatorStore, serve_store
 from quokka_tpu.runtime.worker import worker_main
+
+DEFAULT_RUN_TIMEOUT = 600.0
+
+
+class StallTimeout(TimeoutError):
+    """Coordinator run timeout, enriched with the flight-recorder verdict
+    (stuck worker + in-flight task) and dump paths."""
+
+
+def _resolve_timeout(timeout: Optional[float]) -> float:
+    """Explicit caller value wins; else QK_COORD_TIMEOUT; else 600 s (the
+    historical default) — so tests can observe a hang in seconds instead
+    of minutes without threading a parameter through every entry point."""
+    if timeout is not None:
+        return timeout
+    try:
+        return float(os.environ.get("QK_COORD_TIMEOUT", DEFAULT_RUN_TIMEOUT))
+    except ValueError:
+        return DEFAULT_RUN_TIMEOUT
+
+
+def _flight_streams(cs: CoordinatorStore) -> Dict[str, list]:
+    # the coordinator ring is process-global: scope it to this run (several
+    # run_distributed calls share one process under pytest), or stale
+    # earlier-run events would dominate the report tail and skew the
+    # Chrome-trace time origin minutes before the actual run
+    streams = cs.flight_streams()
+    streams["coordinator"] = obs.RECORDER.snapshot(since=cs.obs_since)
+    return streams
+
+
+def _stall_dump(cs: CoordinatorStore, reason: str):
+    """Merge every worker's shipped flight stream with the coordinator's
+    own, write Chrome trace + stall report into QK_DUMP_DIR, and return
+    (trace_path, report_path, one-line headline naming the stuck worker)."""
+    heartbeats, states, inflight, ntt_depth = cs.stall_snapshot()
+    return obs.dump_flight(reason, _flight_streams(cs), heartbeats, states,
+                           inflight, ntt_depth)
 
 
 def _build_spec(graph) -> Dict:
@@ -73,7 +112,7 @@ def _assign_channels(graph, n_workers: int, worker_tags=None):
 def run_distributed(
     graph,
     n_workers: int = 2,
-    timeout: float = 600.0,
+    timeout: Optional[float] = None,
     kill_after_inputs: Optional[Tuple[int, int]] = None,
     heartbeat_timeout: Optional[float] = None,
     external_workers: int = 0,
@@ -84,6 +123,11 @@ def run_distributed(
     """Execute the graph over worker processes; fills blocking datasets.
     kill_after_inputs=(worker_id, n): SIGKILL that worker once n input seqs
     exist globally — the kill -9 fault-injection path for tests.
+
+    timeout=None resolves to QK_COORD_TIMEOUT (env, seconds) or 600.  On
+    timeout — and on unrecoverable worker death — the coordinator dumps the
+    merged flight-recorder timeline (Chrome trace + stall report naming the
+    stuck worker and its in-flight task) into QK_DUMP_DIR before raising.
 
     external_workers: additionally expect that many externally-launched
     workers (`python -m quokka_tpu.runtime.worker --store host:port
@@ -127,6 +171,9 @@ def run_distributed(
     cs.kv = graph.store.kv
     cs.tables = graph.store.tables
     graph.store = cs
+    # scope this run's coordinator flight stream: dumps/exports include the
+    # start marker and everything after, nothing from earlier runs
+    cs.obs_since = obs.RECORDER.record("coord.start", "run_distributed") - 1
     try:
         server = serve_store(cs, host=bind, port=store_port)
     except OSError:
@@ -138,6 +185,7 @@ def run_distributed(
         # is exposure of the handshake only
         server = serve_store(cs, host="0.0.0.0", port=store_port)
     procs: Dict[int, mp.Process] = {}
+    completed = False
     try:
         total_workers = n_workers + external_workers
         owned = _assign_channels(graph, total_workers, worker_tags)
@@ -179,8 +227,9 @@ def run_distributed(
                 "kill_after_inputs targets an external worker — only locally "
                 "spawned workers (id < n_workers) can be SIGKILLed"
             )
-        _coordinate(graph, cs, procs, owned, timeout, kill_after_inputs,
-                    heartbeat_timeout, external_ids)
+        _coordinate(graph, cs, procs, owned, _resolve_timeout(timeout),
+                    kill_after_inputs, heartbeat_timeout, external_ids)
+        completed = True
     finally:
         cs.set("SHUTDOWN", True)
         time.sleep(0.05)
@@ -188,6 +237,17 @@ def run_distributed(
             p.join(timeout=10)
             if p.is_alive():
                 p.terminate()
+        # export AFTER the joins: each worker ships its final flight events
+        # (task completions, the worker.shutdown marker) when it observes
+        # SHUTDOWN, so exporting earlier would truncate every worker track
+        export = obs.trace_export_path()
+        if completed and export is not None:
+            try:
+                obs.write_chrome_trace(
+                    export, obs.merge_streams(_flight_streams(cs)))
+            except OSError as e:
+                obs.diag(f"[flight-recorder] trace export to {export} "
+                         f"failed: {e}")
         server.close()
     _drain_results(graph, cs)
 
@@ -229,20 +289,22 @@ def _coordinate(graph, cs, procs, owned, timeout, kill_after_inputs,
     dbg_at = t0
     while True:
         if time.time() - t0 > timeout:
-            raise TimeoutError("distributed run exceeded timeout")
+            _, report, headline = _stall_dump(
+                cs, f"distributed run exceeded {timeout:.0f}s timeout")
+            raise StallTimeout(
+                f"distributed run exceeded timeout ({timeout:.0f}s): "
+                f"{headline}"
+                + (f"; flight report: {report}" if report else ""))
         if os.environ.get("QUOKKA_DEBUG_COORD") and time.time() - dbg_at > 20:
             dbg_at = time.time()
-            import sys
-
             # snapshot everything before iterating: RPC handler threads
             # mutate these tables concurrently
             dst = dict(cs.tables.get("DST", {}))
             ntt = {k: len(v) for k, v in dict(cs.tables.get("NTT", {})).items()}
             hbs = dict(cs.heartbeats)
-            print(f"[coord] t={int(dbg_at - t0)}s DST={sorted(dst)} "
-                  f"NTT={ntt} dead={sorted(dead)} "
-                  f"hb={ {w: round(dbg_at - h, 1) for w, h in hbs.items()} }",
-                  file=sys.stderr, flush=True)
+            obs.diag(f"[coord] t={int(dbg_at - t0)}s DST={sorted(dst)} "
+                     f"NTT={ntt} dead={sorted(dead)} "
+                     f"hb={ {w: round(dbg_at - h, 1) for w, h in hbs.items()} }")
         time.sleep(0.05)
         # merge newly registered worker cache addresses for peers to read
         addrs = dict(cs.get("worker_addrs") or {})
@@ -294,9 +356,14 @@ def _coordinate(graph, cs, procs, owned, timeout, kill_after_inputs,
                 )
                 if stale:
                     if graph.hbq is None:
+                        _, report, headline = _stall_dump(
+                            cs, f"external worker {w} heartbeat silent "
+                                f"{now - hb:.1f}s, no fault tolerance")
                         raise RuntimeError(
                             f"external worker {w} went silent and "
                             "fault_tolerance is not enabled"
+                            f" — {headline}"
+                            + (f"; flight report: {report}" if report else "")
                         )
                     dead.add(w)
                     newly_dead.append(w)
@@ -330,24 +397,35 @@ def _coordinate(graph, cs, procs, owned, timeout, kill_after_inputs,
                     # the worker's sanitizer watchdog shot it after its main
                     # loop stopped beating: fail the run loudly, whatever the
                     # fault-tolerance setting — its stack dump is on stderr
+                    _, report, _ = _stall_dump(
+                        cs, f"worker {w} killed by QK_SANITIZE watchdog")
                     raise RuntimeError(
                         f"worker {w} was killed by the QK_SANITIZE deadlock "
                         f"watchdog (exit {sanitize.WATCHDOG_EXIT_CODE}): its "
                         "main loop made no progress within the deadline; "
                         "all thread stacks were dumped to the worker's stderr"
+                        + (f"; flight report: {report}" if report else "")
                     )
                 if graph.hbq is None:
+                    _, report, headline = _stall_dump(
+                        cs, f"worker {w} died without fault tolerance")
                     raise RuntimeError(
                         f"worker {w} died and fault_tolerance is not enabled "
                         "(no HBQ spill to recover from)"
+                        + (f" — {headline}; flight report: {report}"
+                           if report else "")
                     )
                 dead.add(w)
                 newly_dead.append(w)
         if newly_dead:
+            obs.RECORDER.record("recover", f"workers {sorted(newly_dead)}")
             if not _recover_workers(graph, cs, newly_dead, owned, procs, dead,
                                     all_ids):
+                _, report, _ = _stall_dump(
+                    cs, f"workers {sorted(newly_dead)} died, no survivor")
                 raise RuntimeError(
                     f"workers {newly_dead} died and no survivor exists"
+                    + (f"; flight report: {report}" if report else "")
                 )
         if _all_done(graph, cs):
             return

@@ -42,7 +42,8 @@ from quokka_tpu.runtime.task import (
     TapedExecutorTask,
     TapedInputTask,
 )
-from quokka_tpu.utils import tracing
+from quokka_tpu import obs
+from quokka_tpu.obs import spans as tracing
 from quokka_tpu.target_info import (
     BroadcastPartitioner,
     FunctionPartitioner,
@@ -631,6 +632,9 @@ class Engine:
         return True
 
     # -- metrics --------------------------------------------------------------
+    # typed per-channel accounting lives in obs/metrics.py (EngineMetrics);
+    # the flush cadence and the ("metrics", worker_id) store contract are
+    # unchanged from the inline dict this replaced
     _METRICS_FLUSH_EVERY = 64
 
     def _metric(self, actor: int, channel: int, rows, nbytes: int) -> None:
@@ -639,21 +643,9 @@ class Engine:
         block on a device round trip for a counter)."""
         m = getattr(self, "_metrics", None)
         if m is None:
-            m = self._metrics = {}
-            self._metrics_dirty = 0
-            self._metrics_pending = []
-        key = (actor, channel)
-        e = m.get(key)
-        if e is None:
-            e = m[key] = {"tasks": 0, "rows": 0, "bytes": 0}
-        e["tasks"] += 1
-        if isinstance(rows, int):
-            e["rows"] += rows
-        elif rows is not None:
-            self._metrics_pending.append((key, rows))
-        e["bytes"] += nbytes
-        self._metrics_dirty += 1
-        if self._metrics_dirty >= self._METRICS_FLUSH_EVERY:
+            m = self._metrics = obs.EngineMetrics()
+        m.task(actor, channel, rows, nbytes)
+        if m.dirty >= self._METRICS_FLUSH_EVERY:
             self._flush_metrics()
 
     def _rows_of(self, batch):
@@ -668,21 +660,8 @@ class Engine:
     def _flush_metrics(self) -> None:
         m = getattr(self, "_metrics", None)
         if m:
-            for key, dev in getattr(self, "_metrics_pending", []):
-                try:
-                    m[key]["rows"] += int(dev)
-                except Exception:
-                    pass  # a dead device buffer must not sink the flush
-            self._metrics_pending = []
             wid = getattr(self, "worker_id", "embedded")
-            snap = {k: dict(v) for k, v in m.items()}
-            from quokka_tpu.utils import compilestats
-
-            # each worker process has its own counters; ship them with the
-            # flush so metrics() can see worker-side compile churn
-            snap["__compile__"] = compilestats.snapshot()
-            self.store.set(("metrics", wid), snap)
-            self._metrics_dirty = 0
+            self.store.set(("metrics", wid), m.snapshot())
 
     def _shutdown_prefetch(self) -> None:
         """Cancel speculative reads and release the IO threads — without this
@@ -924,12 +903,9 @@ class Engine:
                 now = time.time()
                 if now - getattr(task, "_dbg_at", 0) > 3.0:
                     task._dbg_at = now
-                    import sys
-
-                    print(f"[replay-wait] ({a},{ch}) waiting on {name} "
-                          f"cache={self.cache.get(name) is not None} "
-                          f"hbq={self._hbq_contains(name)}",
-                          file=sys.stderr, flush=True)
+                    obs.diag(f"[replay-wait] ({a},{ch}) waiting on {name} "
+                             f"cache={self.cache.get(name) is not None} "
+                             f"hbq={self._hbq_contains(name)}")
             if time.time() > deadline:
                 raise RuntimeError(
                     f"tape input {name} for channel ({a},{ch}) is in "
@@ -1009,7 +985,32 @@ class Engine:
         return True
 
     def dispatch_task(self, task) -> bool:
-        """Route a popped NTT task to its handler by task kind."""
+        """Route a popped NTT task to its handler by task kind, recording
+        the dispatch in the flight recorder: completed dispatches as
+        duration events, could-not-progress requeues coalesced to one
+        ``task.wait`` instant per (actor, channel) stall episode (the retry
+        loop would otherwise flood the ring and evict the history a stall
+        dump needs)."""
+        rec = obs.RECORDER
+        if not rec.enabled:
+            return self._dispatch(task)
+        label = f"{task.name}:a{task.actor}c{task.channel}"
+        idle = getattr(self, "_obs_idle", None)
+        if idle is None:
+            idle = self._obs_idle = set()
+        key = (task.actor, task.channel, task.name)
+        t0 = time.perf_counter()
+        with rec.activity("task:" + label):
+            ok = self._dispatch(task)
+        if ok:
+            rec.record("task", label, dur=time.perf_counter() - t0)
+            idle.discard(key)
+        elif key not in idle:
+            idle.add(key)
+            rec.record("task.wait", label)
+        return ok
+
+    def _dispatch(self, task) -> bool:
         if task.name == "input":
             return self.handle_input_task(task)
         if task.name == "exec":
@@ -1143,6 +1144,21 @@ class Engine:
                 pass  # a dead store must not block thread shutdown below
             self._shutdown_prefetch()
             self._shutdown_emitter()
+            self._export_trace()
+
+    def _export_trace(self) -> None:
+        """QK_TRACE_EVENTS=<path>: write this process's flight events as
+        Chrome trace JSON at run end (embedded engine only — distributed
+        runs export the MERGED multi-worker timeline from the coordinator,
+        runtime/distributed.py)."""
+        path = obs.trace_export_path()
+        if path is None or getattr(self, "worker_id", None) is not None:
+            return
+        try:
+            obs.write_chrome_trace(
+                path, obs.merge_streams({"engine": obs.RECORDER.snapshot()}))
+        except OSError as e:
+            obs.diag(f"[flight-recorder] trace export to {path} failed: {e}")
 
     def _io_threads(self) -> int:
         n = sum(a.channels for a in self.g.actors.values() if a.kind == "input")
@@ -1185,9 +1201,13 @@ class Engine:
         handled = 0
         while True:
             if time.time() - t0 > timeout:
+                _, report, _ = obs.dump_flight(
+                    f"embedded engine run exceeded {timeout:.0f}s timeout",
+                    {"engine": obs.RECORDER.snapshot()})
                 raise TimeoutError(
                     "engine run exceeded timeout; pending tasks: "
                     f"{self.store.ntt_total()}"
+                    + (f"; flight report: {report}" if report else "")
                 )
             current = stages[stage_idx]
             progress = False
@@ -1214,10 +1234,14 @@ class Engine:
                 stage_idx += 1
                 progress = True
             if not progress:
+                _, report, _ = obs.dump_flight(
+                    "embedded engine stalled: no task progressed",
+                    {"engine": obs.RECORDER.snapshot()})
                 raise RuntimeError(
                     "engine stalled: no task progressed and the stage cannot "
                     f"advance (stage={stages[stage_idx]}, "
                     f"pending={self.store.ntt_total()})"
+                    + (f"; flight report: {report}" if report else "")
                 )
 
     def _stage_undone(self, actors, stage) -> bool:

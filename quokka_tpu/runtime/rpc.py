@@ -31,6 +31,7 @@ import socket
 import socketserver
 import struct
 import threading
+import time
 from typing import Any, Optional, Tuple
 
 _LEN = struct.Struct(">I")
@@ -191,7 +192,13 @@ class RpcServer:
 
 
 class RpcClient:
-    """One persistent connection; thread-safe via a per-client lock."""
+    """One persistent connection; thread-safe via a per-client lock.
+
+    Every call is accounted in the observability layer (quokka_tpu/obs):
+    a per-method counter always, a flight-recorder event when slow, and a
+    per-thread "current activity" marker while blocked in the call — a
+    wedged transport (the round-5 blocked tcp_recvmsg) never produces a
+    completion event, so the marker is what a stall/watchdog dump shows."""
 
     def __init__(self, address: Tuple[str, int], timeout: float = 120.0,
                  token: Optional[str] = None):
@@ -202,18 +209,28 @@ class RpcClient:
         self._lock = threading.Lock()
 
     def call(self, method: str, *args):
-        with self._lock:
-            _send_msg(self._sock, (method, args))
-            ok, out = _recv_msg(self._sock)
+        from quokka_tpu import obs
+
+        t0 = time.perf_counter()
+        with obs.RECORDER.activity(f"rpc:{method}@{self.address[1]}"):
+            with self._lock:
+                _send_msg(self._sock, (method, args))
+                ok, out = _recv_msg(self._sock)
+        obs.rpc_event(method, time.perf_counter() - t0)
         if not ok:
             raise out
         return out
 
     def call_multi(self, calls):
         """[(method, args), ...] applied atomically server-side."""
-        with self._lock:
-            _send_msg(self._sock, ("__multi__", list(calls)))
-            ok, out = _recv_msg(self._sock)
+        from quokka_tpu import obs
+
+        t0 = time.perf_counter()
+        with obs.RECORDER.activity(f"rpc:__multi__@{self.address[1]}"):
+            with self._lock:
+                _send_msg(self._sock, ("__multi__", list(calls)))
+                ok, out = _recv_msg(self._sock)
+        obs.rpc_event("__multi__", time.perf_counter() - t0)
         if not ok:
             raise out
         return out

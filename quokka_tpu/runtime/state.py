@@ -1,4 +1,12 @@
-"""PersistentStateVariable — a spill-backed append-only batch list.
+"""Worker-visible state: heartbeat payloads + PersistentStateVariable.
+
+``WorkerState`` is the structured payload a worker ships with every
+heartbeat (runtime/worker.py -> store_service.CoordinatorStore.heartbeat)
+so the coordinator can distinguish "busy" from "wedged": current task,
+phase, queue depth hint, last-progress timestamp, and the flight-recorder
+sequence number (how far this worker's shipped event stream reaches).
+
+PersistentStateVariable — a spill-backed append-only batch list.
 
 Reference parity: pyquokka/state.py:6 — operators that accumulate unbounded
 batch state (join builds, custom stateful executors) append to this list; past
@@ -14,12 +22,33 @@ from __future__ import annotations
 
 import os
 import tempfile
-from typing import Iterator, List, Optional
+from dataclasses import dataclass, field
+from typing import Iterator, List, Optional, Tuple
 
 import pyarrow as pa
 import pyarrow.ipc as ipc
 
 from quokka_tpu import config
+
+
+@dataclass
+class WorkerState:
+    """One worker's self-reported liveness snapshot (heartbeat payload).
+
+    Pickled across the control-store RPC: fields stay plain primitives.
+    ``task`` is ``(kind, actor, channel)`` of the task being (or last)
+    dispatched, ``last_progress`` the wall-clock time of the last dispatch
+    that made progress, ``events_seq`` the flight-recorder sequence this
+    worker has shipped through (a coordinator seeing ``events_seq`` stall
+    while heartbeats continue knows the worker is idle, not wedged)."""
+
+    worker_id: int = -1
+    phase: str = "init"  # init | barrier | run | idle | adopt | shutdown
+    task: Optional[Tuple[str, int, int]] = None
+    last_progress: float = 0.0
+    queue_hint: int = 0  # locally-known backlog (cached pending batches)
+    events_seq: int = -1
+    ts: float = field(default=0.0)
 
 
 class PersistentStateVariable:

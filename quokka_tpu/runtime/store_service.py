@@ -17,29 +17,83 @@ Coordinator extras carried on the same connection:
 from __future__ import annotations
 
 import time
+from collections import deque
 from contextlib import contextmanager
-from typing import Dict, List, Optional, Tuple
+from typing import Deque, Dict, List, Optional, Tuple
 
 from quokka_tpu.runtime.rpc import RpcClient, RpcServer
 from quokka_tpu.runtime.tables import ControlStore
 
+# per-worker flight-recorder history retained coordinator-side: enough to
+# reconstruct seconds-to-minutes of each worker's recent activity without
+# unbounded growth over a long run
+FLIGHT_KEEP_EVENTS = 4096
+
 
 class CoordinatorStore(ControlStore):
-    """ControlStore + coordinator-side mailboxes (served by RpcServer)."""
+    """ControlStore + coordinator-side mailboxes, heartbeat state, flight
+    streams and in-flight pop records (served by RpcServer)."""
 
     def __init__(self):
         super().__init__()
         self.results: Dict[Tuple[int, int, int], bytes] = {}  # (actor,ch,seq)
         self.heartbeats: Dict[int, float] = {}
+        # worker -> last shipped WorkerState (runtime/state.py)
+        self.worker_states: Dict[int, object] = {}
+        # worker -> deque of flight-recorder event tuples (obs/recorder.py)
+        self.flights: Dict[int, Deque[tuple]] = {}
+        # worker -> (actor, channel, task_kind, popped_at): what each worker
+        # took most recently — recorded AT POP TIME on the coordinator, so a
+        # dispatch that wedges before its next heartbeat is still named
+        self.inflight: Dict[int, Tuple[int, Optional[int], str, float]] = {}
         self.mailboxes: Dict[int, List] = {}
+        # flight-recorder seq at this run's start: run_distributed stamps it
+        # so dumps/exports exclude the process-global ring's earlier runs
+        self.obs_since: int = -1
+
+    def stall_snapshot(self):
+        """(heartbeats, worker_states, inflight, ntt_depth) copied under the
+        store lock — the stall detector's one-call view of worker liveness
+        (RPC handler threads mutate all four concurrently)."""
+        with self._lock:
+            return (
+                dict(self.heartbeats),
+                dict(self.worker_states),
+                dict(self.inflight),
+                {k: len(v) for k, v in self.tables["NTT"].items() if v},
+            )
 
     def result_append(self, actor: int, channel: int, seq: int, ipc: bytes):
         with self._lock:
             self.results[(actor, channel, seq)] = ipc
 
-    def heartbeat(self, worker_id: int):
+    def heartbeat(self, worker_id: int, state=None):
         with self._lock:
             self.heartbeats[worker_id] = time.time()
+            if state is not None:
+                self.worker_states[worker_id] = state
+
+    def flight_append(self, worker_id: int, events: List[tuple]):
+        """Ingest a worker's incremental flight-recorder snapshot."""
+        with self._lock:
+            d = self.flights.get(worker_id)
+            if d is None:
+                d = self.flights[worker_id] = deque(maxlen=FLIGHT_KEEP_EVENTS)
+            d.extend(tuple(e) for e in events)
+
+    def flight_streams(self) -> Dict[str, List[tuple]]:
+        with self._lock:
+            return {f"worker-{w}": list(evs)
+                    for w, evs in self.flights.items()}
+
+    def ntt_pop(self, node, channels=None, worker=None):
+        task = super().ntt_pop(node, channels)
+        if task is not None and worker is not None:
+            with self._lock:
+                self.inflight[worker] = (
+                    node, getattr(task, "channel", None), task.name,
+                    time.time())
+        return task
 
     def mailbox_push(self, worker_id: int, msg):
         with self._lock:
@@ -69,7 +123,7 @@ class ControlStoreClient:
     _WRITES = {
         "set", "ntt_push", "tset", "tappend", "tdel", "sadd",
         "ntt_remove_exec", "ntt_remove_channel", "tape_trim",
-        "result_append", "heartbeat", "mailbox_push",
+        "result_append", "heartbeat", "mailbox_push", "flight_append",
     }
 
     def __init__(self, address: Tuple[str, int]):

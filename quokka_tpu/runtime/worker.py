@@ -18,9 +18,11 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 
+from quokka_tpu import obs
 from quokka_tpu.runtime.cache import BatchCache
 from quokka_tpu.runtime.dataplane import DataPlaneClient, serve_cache, table_to_ipc
 from quokka_tpu.runtime.engine import ActorInfo, Engine
+from quokka_tpu.runtime.state import WorkerState
 from quokka_tpu.runtime.store_service import ControlStoreClient
 
 
@@ -217,8 +219,33 @@ class Worker(Engine):
         """Take over a failed peer's channel: the shared Engine recovery path
         (checkpoint + tape + HBQ replay) against this worker's local cache.
         `choice` is the coordinator's rewind-planner checkpoint selection."""
+        obs.RECORDER.record("adopt", f"a{actor}c{channel}",
+                            choice=repr(choice))
         self.owned.setdefault(actor, set()).add(channel)
         self._recover_channel(actor, channel, choice=choice)
+
+    # -- observability --------------------------------------------------------
+    _FLIGHT_SHIP_EVERY = 0.5  # seconds between incremental event shipments
+
+    def _worker_state(self, phase: str, now: float) -> WorkerState:
+        return WorkerState(
+            worker_id=self.worker_id,
+            phase=phase,
+            task=getattr(self, "_obs_task", None),
+            last_progress=getattr(self, "_obs_last_progress", 0.0),
+            queue_hint=self.cache.size(),
+            events_seq=getattr(self, "_obs_shipped_seq", -1),
+            ts=now,
+        )
+
+    def _ship_flight(self) -> None:
+        """Ship the flight-recorder events recorded since the last shipment
+        (incremental: the full ring would be hundreds of KB at 2 Hz)."""
+        since = getattr(self, "_obs_shipped_seq", -1)
+        evs = obs.RECORDER.snapshot(since=since)
+        if evs:
+            self.store.flight_append(self.worker_id, evs)
+            self._obs_shipped_seq = evs[-1][0]
 
     # -- main loop ------------------------------------------------------------
     def run_worker(self, heartbeat_every: float = 0.2):
@@ -227,6 +254,8 @@ class Worker(Engine):
         # thread's stack and kills this process — the coordinator then fails
         # the run in seconds instead of hanging to its timeout
         watchdog = getattr(self, "_watchdog", None)
+        rec = obs.RECORDER
+        rec.record("worker.start", f"worker-{self.worker_id}")
         # startup barrier: wait until every worker's data-plane address is
         # registered, or the first push to a late-starting peer would fail
         expected = self.store.get("expected_workers")
@@ -235,32 +264,44 @@ class Worker(Engine):
             addrs = self.store.get("worker_addrs") or {}
             if len(addrs) >= expected:
                 self._peer_addrs = {int(k): tuple(v) for k, v in addrs.items()}
+                rec.record("worker.barrier", f"{len(addrs)} peers registered")
                 break
             if self.store.get("SHUTDOWN"):
                 return
             if time.time() - t0 > 120:
                 raise TimeoutError("peer workers never registered")
-            self.store.heartbeat(self.worker_id)
+            self.store.heartbeat(self.worker_id,
+                                 self._worker_state("barrier", time.time()))
             if watchdog is not None:
                 watchdog.beat()
             time.sleep(0.05)
         last_hb = 0.0
+        last_ship = 0.0
         dbg = os.environ.get("QUOKKA_DEBUG_WORKER")
         dbg_at = time.time()
+        self._obs_last_progress = time.time()
         actors = sorted(self.g.actors.values(), key=lambda a: (a.stage, a.id))
+        phase = "run"
         while True:
             now = time.time()
             if watchdog is not None:
                 watchdog.beat()
             if now - last_hb >= heartbeat_every:
-                self.store.heartbeat(self.worker_id)
+                self.store.heartbeat(self.worker_id,
+                                     self._worker_state(phase, now))
+                rec.record("hb", f"worker-{self.worker_id}")
                 last_hb = now
+            if now - last_ship >= self._FLIGHT_SHIP_EVERY:
+                self._ship_flight()
+                last_ship = now
             for msg in self.store.mailbox_drain(self.worker_id):
                 if msg[0] == "adopt":
                     self._refresh_clt()
                     self._adopt(msg[1], msg[2],
                                 choice=msg[3] if len(msg) > 3 else None)
             if self.store.get("SHUTDOWN"):
+                rec.record("worker.shutdown", f"worker-{self.worker_id}")
+                self._ship_flight()
                 return
             stage = self.store.get("STAGE", 0)
             progress = False
@@ -271,26 +312,31 @@ class Worker(Engine):
                     continue
                 if info.kind == "input" and info.stage > stage:
                     continue
-                task = self.store.ntt_pop(info.id, list(chans))
+                task = self.store.ntt_pop(info.id, list(chans),
+                                          self.worker_id)
                 if task is None:
                     continue
                 if dbg:
                     popped.append((info.id, task.name,
                                    getattr(task, "channel", None)))
+                # remembered in the heartbeat payload so the coordinator
+                # can name the in-flight task even mid-dispatch
+                self._obs_task = (task.name, info.id,
+                                  getattr(task, "channel", None))
                 progress |= self.dispatch_task(task)
             if progress:
                 dbg_at = now
+                self._obs_last_progress = now
+                phase = "run"
             else:
+                phase = "idle"
                 if dbg and now - dbg_at > 5.0:
                     dbg_at = now
-                    import sys
-
-                    print(
+                    obs.diag(
                         f"[worker {self.worker_id}] stalled: owned="
                         f"{ {a: sorted(c) for a, c in self.owned.items()} } "
                         f"popped={popped} "
-                        f"cache={self.cache.size()} puttable={self.cache.puttable()}",
-                        file=sys.stderr, flush=True,
+                        f"cache={self.cache.size()} puttable={self.cache.puttable()}"
                     )
                 time.sleep(0.01)
 
@@ -323,6 +369,7 @@ def worker_main(spec_bytes: bytes, store_addr, worker_id: int, owned):
 
         jax.config.update("jax_enable_x64", True)
     store = ControlStoreClient(tuple(store_addr))
+    w = None
     try:
         cache = BatchCache()
         hbq = _worker_hbq(spec, worker_id) if spec["hbq_path"] else None
@@ -360,6 +407,14 @@ def worker_main(spec_bytes: bytes, store_addr, worker_id: int, owned):
         # otherwise invisible and the run would stall until timeout
         try:
             store.set(f"worker_error:{worker_id}", traceback.format_exc())
+            # unshipped flight-recorder events too (only those PAST the
+            # incremental shipper's high-water mark — re-shipping the tail
+            # would duplicate slices in the merged timeline): the stall
+            # dump then shows what this worker did right up to the crash
+            since = getattr(w, "_obs_shipped_seq", -1) if w is not None else -1
+            evs = obs.RECORDER.snapshot(since=since, last_n=256)
+            if evs:
+                store.flight_append(worker_id, evs)
         except Exception:
             pass
         raise

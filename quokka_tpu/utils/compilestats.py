@@ -44,6 +44,20 @@ def _on_duration(event: str, duration_secs: float, **kw) -> None:
             _stats["backend_compile_seconds"] += duration_secs
         elif event == "/jax/core/compile/jaxpr_trace_duration":
             _stats["traces"] += 1
+        else:
+            return
+    # compile activity in the flight recorder: merged timelines show which
+    # worker paid a compile (or a persistent-cache load) and when
+    try:
+        from quokka_tpu.obs import recorder
+
+        recorder.RECORDER.record(
+            "compile",
+            "backend_compile" if event.endswith("backend_compile_duration")
+            else "trace",
+            dur=duration_secs)
+    except Exception:
+        return  # monitoring must never break the compile path
 
 
 def ensure_registered() -> None:

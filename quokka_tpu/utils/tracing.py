@@ -1,58 +1,19 @@
-"""Structured tracing: named spans with aggregate timings.
+"""Back-compat shim: the span API moved to quokka_tpu.obs.spans.
 
-Replaces the reference's print_if_profile timestamp prints (pyquokka/
-core.py:20-30) with accumulated span statistics that any component can emit
-and the engine can report (QUOKKA_TRACE=1 prints a summary at run end).
+Spans now additionally land in the flight recorder (quokka_tpu/obs/
+recorder.py) so merged timelines show where time went; the QUOKKA_TRACE=1
+aggregate-summary behavior is unchanged.  Import from quokka_tpu.obs in
+new code.
 """
 
 from __future__ import annotations
 
-import os
-import threading
-import time
-from collections import defaultdict
-from contextlib import contextmanager
-
-ENABLED = os.environ.get("QUOKKA_TRACE", "0") not in ("0", "", "false")
-
-_lock = threading.Lock()
-_stats = defaultdict(lambda: [0, 0.0])  # name -> [count, total_seconds]
-
-
-@contextmanager
-def span(name: str):
-    if not ENABLED:
-        yield
-        return
-    t0 = time.perf_counter()
-    try:
-        yield
-    finally:
-        dt = time.perf_counter() - t0
-        with _lock:
-            s = _stats[name]
-            s[0] += 1
-            s[1] += dt
-
-
-def add(name: str, seconds: float, count: int = 1):
-    if not ENABLED:
-        return
-    with _lock:
-        s = _stats[name]
-        s[0] += count
-        s[1] += seconds
-
-
-def summary() -> str:
-    with _lock:
-        rows = sorted(_stats.items(), key=lambda kv: -kv[1][1])
-    lines = [f"{'span':<28}{'count':>8}{'total_s':>10}{'avg_ms':>10}"]
-    for name, (n, total) in rows:
-        lines.append(f"{name:<28}{n:>8}{total:>10.3f}{total / max(n,1) * 1e3:>10.2f}")
-    return "\n".join(lines)
-
-
-def reset():
-    with _lock:
-        _stats.clear()
+from quokka_tpu.obs.spans import (  # noqa: F401 — re-export surface
+    add,
+    enabled,
+    reset,
+    set_enabled,
+    span,
+    stats,
+    summary,
+)

@@ -1,0 +1,10 @@
+"""QK007 fixture: bare print in library code (CLI main() is exempt)."""
+
+
+def handle_batch(batch):
+    print("processing", batch)  # QK007: route through obs.diag
+    return batch
+
+
+def main():
+    print("usage: ...")  # exempt: CLI entry point

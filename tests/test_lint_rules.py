@@ -20,6 +20,7 @@ CASES = [
     ("QK004", "qk004_host_sync.py", 3),      # asarray, branch, block_until_ready
     ("QK005", "qk005_unlocked.py", 2),       # dict store, list append
     ("QK006", "qk006_swallow.py", 1),
+    ("QK007", "qk007_print.py", 1),          # library print; main() exempt
 ]
 
 
