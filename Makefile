@@ -4,7 +4,7 @@
 PY ?= python
 export JAX_PLATFORMS ?= cpu
 
-.PHONY: lint lint-baseline test test-slow sanitize-demo
+.PHONY: lint lint-baseline test test-slow sanitize-demo service-smoke
 
 # engine-invariant static analysis; exits nonzero on findings beyond the
 # checked-in baseline (quokka_tpu/analysis/baseline.json)
@@ -32,3 +32,10 @@ sanitize-demo:
 # the stuck worker and its in-flight task, in QK_DUMP_DIR
 stall-demo:
 	QK_COORD_TIMEOUT=20 $(PY) tests/sanitize_deadlock_case.py
+
+# query-service smoke: tiny-SF TPC-H queries submitted 2-way through a
+# persistent QueryService; exits nonzero if the concurrent run wedges, a
+# query fails, or a result comes back empty
+service-smoke:
+	QUOKKA_BENCH_SF=0.01 QUOKKA_BENCH_CACHE=/tmp/quokka_tpu_bench_smoke \
+		$(PY) bench.py --service --smoke

@@ -128,14 +128,18 @@ def _ctx():
     return QuokkaContext(io_channels=3, exec_channels=2)
 
 
-def run_q1(paths):
-    ctx = _ctx()
-    q = (
+def build_q1(paths, ctx=None):
+    ctx = ctx or _ctx()
+    return (
         ctx.read_parquet(paths["lineitem"], columns=Q1_COLS)
         .filter_sql("l_shipdate <= date '1998-12-01' - interval '90' day")
         .groupby(["l_returnflag", "l_linestatus"])
         .agg_sql(Q1_AGGS)
     )
+
+
+def run_q1(paths):
+    q = build_q1(paths)
     t0 = time.time()
     df = q.collect()
     dt = time.time() - t0
@@ -143,10 +147,10 @@ def run_q1(paths):
     return dt
 
 
-def run_q3(paths):
+def build_q3(paths, ctx=None):
     from quokka_tpu.expression import col
 
-    ctx = _ctx()
+    ctx = ctx or _ctx()
     lineitem = ctx.read_parquet(
         paths["lineitem"],
         columns=["l_orderkey", "l_shipdate", "l_extendedprice", "l_discount"],
@@ -158,7 +162,7 @@ def run_q3(paths):
     customer = ctx.read_parquet(
         paths["customer"], columns=["c_custkey", "c_mktsegment"]
     )
-    q = (
+    return (
         lineitem.filter_sql("l_shipdate > date '1995-03-15'")
         .join(
             orders.filter_sql("o_orderdate < date '1995-03-15'"),
@@ -174,6 +178,10 @@ def run_q3(paths):
         .agg_sql("sum(l_extendedprice * (1 - l_discount)) as revenue")
         .top_k(["revenue"], 10, [True])
     )
+
+
+def run_q3(paths):
+    q = build_q3(paths)
     t0 = time.time()
     df = q.collect()
     dt = time.time() - t0
@@ -181,10 +189,10 @@ def run_q3(paths):
     return dt
 
 
-def run_q5(paths):
+def build_q5(paths, ctx=None):
     from quokka_tpu.expression import col
 
-    ctx = _ctx()
+    ctx = ctx or _ctx()
     lineitem = ctx.read_parquet(
         paths["lineitem"],
         columns=["l_orderkey", "l_suppkey", "l_extendedprice", "l_discount"],
@@ -202,7 +210,7 @@ def run_q5(paths):
         paths["nation"], columns=["n_nationkey", "n_name", "n_regionkey"]
     )
     region = ctx.read_parquet(paths["region"], columns=["r_regionkey", "r_name"])
-    q = (
+    return (
         lineitem.join(
             orders.filter_sql(
                 "o_orderdate >= date '1994-01-01' and o_orderdate < date '1995-01-01'"
@@ -225,6 +233,10 @@ def run_q5(paths):
         .groupby("n_name")
         .agg_sql("sum(l_extendedprice * (1 - l_discount)) as revenue")
     )
+
+
+def run_q5(paths):
+    q = build_q5(paths)
     t0 = time.time()
     df = q.collect()
     dt = time.time() - t0
@@ -253,6 +265,114 @@ def run_asof(paths):
 
 
 QUERIES = {"q1": run_q1, "q3": run_q3, "q5": run_q5}
+BUILDERS = {"q1": build_q1, "q3": build_q3, "q5": build_q5}
+
+
+def _quantile(xs, q):
+    xs = sorted(xs)
+    if not xs:
+        return None
+    i = min(len(xs) - 1, int(round(q * (len(xs) - 1))))
+    return xs[i]
+
+
+def measure_service(paths, smoke=False):
+    """``bench.py --service``: submit the TPC-H queries concurrently through
+    a persistent QueryService (2- and 4-way) and report aggregate throughput
+    plus per-query p50/p95 latency next to the serial numbers.
+
+    N-way = N concurrent client streams, each submitting q1, q3, q5 (the
+    TPC-H throughput-test shape); every stream's queries run on ONE shared
+    worker pool with warm scan/compile caches.  The line of record compares
+    the N-way wall clock against the same N passes run serially back-to-back
+    on the equally-warm one-shot path."""
+    from quokka_tpu.service import QueryService
+
+    ways_list = [2] if smoke else [2, 4]
+    qnames = list(BUILDERS)
+    # warm pass (compiles every query shape + fills the scan cache), then
+    # the timed serial pass the concurrent walls compare against
+    for name in qnames:
+        QUERIES[name](paths)
+    serial_seconds = {name: QUERIES[name](paths) for name in qnames}
+    serial_pass_s = sum(serial_seconds.values())
+    lines = []
+    speedups = []
+    for ways in ways_list:
+        # queued submissions legitimately wait ~a full round of query
+        # runtime behind max_concurrent: give admission the same patience
+        # as the measurement itself, or slow hosts die on AdmissionTimeout
+        svc = QueryService(pool_size=ways, max_concurrent=ways,
+                           inflight_per_query=2,
+                           admit_timeout=float(MEASURE_TIMEOUT),
+                           query_timeout=float(MEASURE_TIMEOUT))
+        try:
+            t0 = time.time()
+            handles = []
+            for _stream in range(ways):
+                for name in qnames:
+                    stream = BUILDERS[name](paths)
+                    handles.append((name, svc.submit(stream)))
+            per_query = {}
+            for name, h in handles:
+                ds = h.result(timeout=MEASURE_TIMEOUT)
+                if smoke and ds.to_arrow() is None:
+                    raise RuntimeError(
+                        f"service smoke: {name} returned an empty result")
+                per_query.setdefault(name, []).append(h.timings())
+            wall = time.time() - t0
+        finally:
+            svc.shutdown()
+        n_queries = ways * len(qnames)
+        serial_wall = ways * serial_pass_s
+        speedup = serial_wall / wall if wall > 0 else 0.0
+        speedups.append(speedup)
+        lat_detail = {}
+        for name, ts in per_query.items():
+            runs = [t["run_s"] for t in ts if t["run_s"] is not None]
+            totals = [
+                t["finished_at"] - t["submitted_at"] for t in ts
+                if t["finished_at"] is not None
+            ]
+            lat_detail[name] = {
+                "serial_s": round(serial_seconds[name], 4),
+                "run_p50_s": round(_quantile(runs, 0.5), 4),
+                "run_p95_s": round(_quantile(runs, 0.95), 4),
+                "total_p50_s": round(_quantile(totals, 0.5), 4),
+                "total_p95_s": round(_quantile(totals, 0.95), 4),
+            }
+        lines.append({
+            "metric": f"service_{ways}way_aggregate_speedup",
+            "value": round(speedup, 4),
+            "unit": "x",
+            "vs_baseline": round(speedup, 4),
+            "detail": {
+                "sf": SF,
+                "ways": ways,
+                "cpus": os.cpu_count(),  # 1-core hosts cannot beat serial
+                "queries": n_queries,
+                "wall_s": round(wall, 4),
+                "serial_back_to_back_s": round(serial_wall, 4),
+                "aggregate_qps": round(n_queries / wall, 4),
+                "serial_qps": round(n_queries / serial_wall, 4),
+                "per_query": lat_detail,
+            },
+        })
+    for ln in lines:
+        print(json.dumps(ln))
+    geomean = math.exp(sum(math.log(max(s, 1e-9)) for s in speedups)
+                       / len(speedups))
+    print(json.dumps({
+        "metric": "service_aggregate_speedup_geomean",
+        "value": round(geomean, 4),
+        "unit": "x",
+        "vs_baseline": round(geomean, 4),
+        "detail": {"sf": SF, "ways": ways_list,
+                   "serial_seconds": {k: round(v, 4)
+                                      for k, v in serial_seconds.items()}},
+    }))
+    sys.stdout.flush()
+    return geomean
 
 # span-name prefix -> breakdown bucket (obs/spans.py names)
 _BUCKET_PREFIXES = (
@@ -572,5 +692,12 @@ if __name__ == "__main__":
             except Exception:
                 pass
         measure(ensure_data())
+    elif len(sys.argv) > 1 and sys.argv[1] == "--service":
+        # concurrent-service mode runs in-process (no TPU wedge supervision:
+        # it is the CI smoke + local measurement path; CPU via JAX_PLATFORMS).
+        # Failure mode is an exception (wedge -> QueryStallTimeout, failed
+        # query -> its error, empty smoke result -> RuntimeError): any of
+        # them exits nonzero
+        measure_service(ensure_data(), smoke="--smoke" in sys.argv[2:])
     else:
         main()

@@ -19,3 +19,13 @@ from quokka_tpu.runtime.placement import (
     TaggedCustomChannelsStrategy,
 )
 
+
+def __getattr__(name):
+    # lazy: the query service pulls in threading/admission machinery most
+    # one-shot users never touch
+    if name in ("QueryService", "QueryHandle"):
+        from quokka_tpu import service
+
+        return getattr(service, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+

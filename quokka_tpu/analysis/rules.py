@@ -21,6 +21,11 @@ Rule ids:
   QK006 swallowed-exception     except handlers whose body is only ``pass``
   QK007 bare-print              print(...) in library code outside CLI entry
                                 points (route through quokka_tpu.obs.diag)
+  QK008 global-config-mutation  mutation of process-global configuration
+                                (jax.config.update, os.environ, config.py
+                                module globals) — with the query service
+                                many queries share one process, so a query
+                                mutating globals corrupts its neighbors
 
 Finding keys (``Finding.key``) are line-number-free — ``rule::relpath::
 scope::snippet[::n]`` — so a baseline survives unrelated edits above the
@@ -725,6 +730,75 @@ def check_bare_print(tree: ast.Module, path: str, rel: str,
     return out
 
 
+# ---------------------------------------------------------------------------
+# QK008 — process-global config mutation
+# ---------------------------------------------------------------------------
+
+_ENV_MUTATOR_TAILS = ("pop", "update", "setdefault", "clear")
+# module aliases under which quokka_tpu.config is imported in this codebase
+_CONFIG_MODULE_NAMES = ("config", "qconfig")
+
+
+def _is_environ(node: ast.AST) -> bool:
+    d = _dotted(node)
+    return d in ("os.environ", "environ")
+
+
+def check_global_config_mutation(tree: ast.Module, path: str, rel: str,
+                                 src_lines: Sequence[str]) -> List[Finding]:
+    """With the query service, many queries share one process: jax.config,
+    quokka_tpu.config module globals and os.environ are PROCESS-global, so
+    code reachable inside query execution mutating them corrupts every
+    concurrently-running neighbor (dtype regime flips mid-pipeline, kernel
+    strategy changes between a build and its probe, ...).  Mutations that
+    are genuinely pre-query (import-time setup in config.py, spawned-worker
+    bootstrap) go into the baseline with a rationale."""
+    out: List[Finding] = []
+
+    def flag(node: ast.AST, what: str):
+        out.append(_mk(
+            "QK008", "global-config-mutation", path, rel, node,
+            _scope_of(tree, node),
+            f"{what} mutates process-global configuration; with the query "
+            "service a query doing this mid-flight corrupts its "
+            "concurrently-running neighbors — move it to process startup "
+            "(pre-service), thread it per-query, or baseline with a "
+            "rationale",
+            src_lines))
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            d = _dotted(node.func)
+            if d is None:
+                continue
+            parts = d.split(".")
+            if parts[-2:] == ["config", "update"] and parts[0] != "self":
+                flag(node, f"'{d}(...)' (jax.config.update)")
+            elif d in ("os.putenv", "os.unsetenv"):
+                flag(node, f"'{d}(...)'")
+            elif (isinstance(node.func, ast.Attribute)
+                  and node.func.attr in _ENV_MUTATOR_TAILS
+                  and _is_environ(node.func.value)):
+                flag(node, f"'{d}(...)' (os.environ mutation)")
+        elif isinstance(node, (ast.Assign, ast.AugAssign)):
+            tgts = (node.targets if isinstance(node, ast.Assign)
+                    else [node.target])
+            for t in tgts:
+                if isinstance(t, ast.Subscript) and _is_environ(t.value):
+                    flag(node, "subscript assignment to os.environ")
+                elif (isinstance(t, ast.Attribute)
+                      and isinstance(t.value, ast.Name)
+                      and t.value.id in _CONFIG_MODULE_NAMES):
+                    flag(node,
+                         f"assignment to '{t.value.id}.{t.attr}' "
+                         "(config-module global)")
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript) and _is_environ(t.value):
+                    flag(node, "del on os.environ")
+    return out
+
+
 RULES = (
     check_module_level_jit,
     check_import_time_side_effects,
@@ -733,6 +807,7 @@ RULES = (
     check_unlocked_shared_state,
     check_swallowed_exceptions,
     check_bare_print,
+    check_global_config_mutation,
 )
 
 

@@ -22,7 +22,7 @@ from quokka_tpu.dataset.readers import (
     InputJSONDataset,
     InputParquetDataset,
 )
-from quokka_tpu.runtime.engine import TaskGraph
+from quokka_tpu.runtime.engine import TaskGraph, new_query_id
 
 _log = logging.getLogger("quokka_tpu.mesh")
 
@@ -318,9 +318,10 @@ class QuokkaContext:
         return out
 
     # -- execution -------------------------------------------------------------
-    def execute_node(self, node_id: int):
-        # copy the reachable subgraph so optimizer rewrites don't mutate the
-        # user's plan (df.py:956-979 does the same)
+    def _prepare_plan(self, node_id: int):
+        """Copy the reachable subgraph (so optimizer rewrites don't mutate
+        the user's plan, df.py:956-979), wrap it in a sink, optimize.
+        Returns (sub, sink_id)."""
         sub, mapping = self._copy_subgraph(node_id)
         sink_id = mapping[node_id]
         if not isinstance(sub[sink_id], logical.SinkNode):
@@ -332,6 +333,32 @@ class QuokkaContext:
             from quokka_tpu.optimizer import optimize
 
             sink_id = optimize(sub, sink_id, exec_channels=self.exec_channels)
+        return sub, sink_id
+
+    def _lower_plan(self, sub, sink_id: int, graph: TaskGraph) -> int:
+        """Assign stages and lower the prepared plan into ``graph``;
+        returns the sink's actor id."""
+        self._assign_stages(sub, sink_id)
+        actor_of: Dict[int, int] = {}
+        for nid in self._toposort(sub, sink_id):
+            sub[nid].lower(self, graph, actor_of, nid)
+        for nid, aid in actor_of.items():
+            pl = getattr(sub.get(nid), "placement", None)
+            if pl is not None:
+                graph.actors[aid].placement = pl
+        self.latest_graph = graph
+        return actor_of[sink_id]
+
+    def lower_into(self, node_id: int, graph: TaskGraph) -> int:
+        """Lower ``node_id``'s plan into a caller-provided TaskGraph (the
+        query service's entry point: the graph carries the service's shared
+        store/cache and the query's namespace).  Returns the sink actor id;
+        the caller owns execution and teardown."""
+        sub, sink_id = self._prepare_plan(node_id)
+        return self._lower_plan(sub, sink_id, graph)
+
+    def execute_node(self, node_id: int):
+        sub, sink_id = self._prepare_plan(node_id)
         if self.mesh is not None:
             from quokka_tpu.parallel.mesh_exec import MeshExecutor, MeshUnsupported
             from quokka_tpu.runtime.dataset import ResultDataset
@@ -351,18 +378,17 @@ class QuokkaContext:
                 _log.warning(
                     "mesh execution fell back to the embedded engine: %s", e
                 )
-        self._assign_stages(sub, sink_id)
-        graph = TaskGraph(self.exec_config)
-        actor_of: Dict[int, int] = {}
-        for nid in self._toposort(sub, sink_id):
-            sub[nid].lower(self, graph, actor_of, nid)
-        for nid, aid in actor_of.items():
-            pl = getattr(sub.get(nid), "placement", None)
-            if pl is not None:
-                graph.actors[aid].placement = pl
-        self.latest_graph = graph
         n_workers = getattr(self.cluster, "n_workers", 0) if self.cluster else 0
         ext = getattr(self.cluster, "external_workers", 0) if self.cluster else 0
+        # one-shot embedded runs get a fresh namespace so teardown is an
+        # explicit drop_namespace (same GC discipline the query service
+        # uses); distributed sessions keep the un-namespaced store its
+        # workers expect (one query per served store)
+        graph = TaskGraph(
+            self.exec_config,
+            query_id=None if (n_workers or ext) else new_query_id(),
+        )
+        sink_actor = self._lower_plan(sub, sink_id, graph)
         if n_workers or ext:
             from quokka_tpu.runtime.distributed import run_distributed
 
@@ -387,7 +413,7 @@ class QuokkaContext:
                 graph.cleanup()
         else:
             graph.run()
-        return graph.result(actor_of[sink_id])
+        return graph.result(sink_actor)
 
     def _copy_subgraph(self, node_id: int):
         mapping: Dict[int, int] = {}
@@ -445,19 +471,9 @@ class QuokkaContext:
 
     # -- introspection ---------------------------------------------------------
     def explain(self, node_id: int) -> str:
-        sub, _ = self._copy_subgraph(node_id)
-        sink_id = node_id
-        # wrap in a sink exactly like execute_node: optimizer rewrites assume
-        # the root has a consumer (a root filter would otherwise re-push its
-        # predicate on every fixpoint round)
-        if not isinstance(sub[sink_id], logical.SinkNode):
-            sink = logical.SinkNode([sink_id], sub[sink_id].schema)
-            sink_id = max(sub) + 1
-            sub[sink_id] = sink
-        if self.optimize_plans:
-            from quokka_tpu.optimizer import optimize
-
-            sink_id = optimize(sub, sink_id, exec_channels=self.exec_channels)
+        # same prepare as execute_node: sink wrap (optimizer rewrites assume
+        # the root has a consumer) + optimize
+        sub, sink_id = self._prepare_plan(node_id)
         self._assign_stages(sub, sink_id)
         lines = []
         for nid in self._toposort(sub, sink_id):

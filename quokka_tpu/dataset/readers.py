@@ -63,6 +63,10 @@ class InputArrowDataset:
         start, length = lineage
         return self.table.slice(start, length)
 
+    def size_hint(self) -> int:
+        """Estimated source bytes (query-service admission control)."""
+        return self.table.nbytes
+
 
 def _expand_paths(path) -> List[str]:
     if isinstance(path, (list, tuple)):
@@ -140,6 +144,17 @@ class InputParquetDataset:
             return None
         return ("parquet", f, rg, st.st_mtime_ns, st.st_size,
                 tuple(self.columns) if self.columns else None)
+
+    def size_hint(self) -> int:
+        """Estimated source bytes (query-service admission control): the
+        on-disk footprint of every file this scan touches."""
+        total = 0
+        for f in _expand_paths(self.path):
+            try:
+                total += os.path.getsize(f)
+            except OSError:
+                continue
+        return total
 
     def _dict_columns(self, f) -> List[str]:
         cached = getattr(self, "_dict_cols_cache", None)
@@ -253,6 +268,16 @@ class InputCSVDataset:
                 pieces.append((f, start, end))
                 start = end
         return {ch: pieces[ch::num_channels] for ch in range(num_channels)}
+
+    def size_hint(self) -> int:
+        """Estimated source bytes (query-service admission control)."""
+        total = 0
+        for f in _expand_paths(self.path):
+            try:
+                total += os.path.getsize(f)
+            except OSError:
+                continue
+        return total
 
     def execute(self, channel: int, lineage) -> pa.Table:
         f, start, end = lineage

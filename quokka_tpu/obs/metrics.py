@@ -86,6 +86,14 @@ class Registry:
             out.update({n: g.value for n, g in self._gauges.items()})
         return out
 
+    def remove(self, *names: str) -> None:
+        """Drop named instruments (per-query counters GC with their query —
+        a long-lived service would otherwise grow one pair per query id)."""
+        with self._lock:
+            for n in names:
+                self._counters.pop(n, None)
+                self._gauges.pop(n, None)
+
     def reset(self) -> None:
         with self._lock:
             self._counters.clear()

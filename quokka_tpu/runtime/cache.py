@@ -38,7 +38,8 @@ def _batch_nbytes(batch) -> int:
 
 class BatchCache:
     def __init__(self, mem_limit_batches: int = 10_000,
-                 mem_limit_bytes: int = 2 << 30):
+                 mem_limit_bytes: int = 2 << 30,
+                 owner: Optional[str] = None):
         # QK_SANITIZE=1: lock-order recorder (analysis/sanitize.py) — the
         # cache lock and the control-store lock are the two runtime-shared
         # locks a data-plane/exec-loop inversion would deadlock on
@@ -46,6 +47,9 @@ class BatchCache:
 
         self._lock = sanitize.maybe_instrument(
             "batchcache", threading.Lock())
+        # query id in service mode: tags the plan hit/miss counters and
+        # flight-recorder events so merged timelines separate queries
+        self.owner = owner
         self._data: Dict[Tuple, object] = {}  # 6-tuple name -> DeviceBatch
         # index: (tgt_actor, tgt_ch) -> (src_actor, src_ch) -> set of seqs
         self._index: Dict[Tuple, Dict[Tuple, Set[int]]] = defaultdict(
@@ -124,16 +128,25 @@ class BatchCache:
         state = getattr(self, "_plan_state", None)
         if state is None:
             state = self._plan_state = {}
+        # aggregate counters always; per-query twins when owned (GC'd with
+        # the query namespace, TaskGraph.cleanup)
         if plan is not None:
             obs.REGISTRY.counter("cache.plan_hit").inc()
+            if self.owner:
+                obs.REGISTRY.counter(f"cache.plan_hit.{self.owner}").inc()
             obs.RECORDER.record("cache.hit", f"a{tgt[0]}c{tgt[1]}",
-                                src=plan[0], batches=len(plan[1]))
+                                src=plan[0], batches=len(plan[1]),
+                                **({"q": self.owner} if self.owner else {}))
             state[tgt] = True
         else:
             obs.REGISTRY.counter("cache.plan_miss").inc()
+            if self.owner:
+                obs.REGISTRY.counter(f"cache.plan_miss.{self.owner}").inc()
             if state.get(tgt, True):
                 state[tgt] = False
-                obs.RECORDER.record("cache.miss", f"a{tgt[0]}c{tgt[1]}")
+                obs.RECORDER.record(
+                    "cache.miss", f"a{tgt[0]}c{tgt[1]}",
+                    **({"q": self.owner} if self.owner else {}))
 
     def _plan_contiguous(self, idx, src_actor, tgt_actor, tgt_ch, chans, max_batches):
         names = []

@@ -15,14 +15,51 @@ from typing import Optional
 
 
 class CheckpointStore:
-    def __init__(self, root: str):
+    """``namespace`` (the query service): checkpoints of concurrent queries
+    may share one root; namespaced snapshot names keep a query from ever
+    restoring a neighbor's executor state."""
+
+    def __init__(self, root: str, namespace: Optional[str] = None):
         self.root = root.rstrip("/")
+        self.namespace = namespace
         self._remote = "://" in root
         if not self._remote:
             os.makedirs(root, exist_ok=True)
 
     def _path(self, actor: int, ch: int, state_seq: int) -> str:
-        return f"{self.root}/ckpt-{actor}-{ch}-{state_seq}.pkl"
+        ns = f"{self.namespace}-" if self.namespace is not None else ""
+        return f"{self.root}/ckpt-{ns}{actor}-{ch}-{state_seq}.pkl"
+
+    def wipe_namespace(self) -> None:
+        """Drop every snapshot in this namespace (query teardown) — local
+        dirs and fsspec roots alike; best-effort (GC, not correctness)."""
+        if self.namespace is None:
+            return
+        prefix = f"ckpt-{self.namespace}-"
+        if self._remote:
+            try:
+                import fsspec
+
+                fs, _, paths = fsspec.get_fs_token_paths(self.root)
+                base = paths[0].rstrip("/")
+                for p in fs.glob(f"{base}/{prefix}*.pkl"):
+                    fs.rm(p)
+            except Exception as e:  # noqa: BLE001 — GC must not fail a query
+                from quokka_tpu import obs
+
+                obs.diag(f"[ckptstore] namespace wipe of {self.root} "
+                         f"failed: {e!r}")
+            return
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return
+        for f in names:
+            if f.startswith(prefix) and f.endswith(".pkl"):
+                try:
+                    os.remove(os.path.join(self.root, f))
+                except OSError:
+                    continue
 
     def save(self, actor: int, ch: int, state_seq: int, data: bytes) -> None:
         p = self._path(actor, ch, state_seq)

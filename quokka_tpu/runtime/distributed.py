@@ -95,6 +95,9 @@ def _build_spec(graph) -> Dict:
         "exec_config": graph.exec_config,
         "hbq_path": graph.hbq.path if graph.hbq is not None else None,
         "ckpt_dir": graph.ckpt_dir,
+        # None for today's one-query-per-session distributed runs; workers
+        # thread it into their engine for namespaced tagging when set
+        "query_id": getattr(graph, "query_id", None),
         # spawned children start with default jax config; mirror the parent's
         # x64 mode or float dtypes diverge between the two runtimes
         "x64": qconfig.x64_enabled(),

@@ -25,6 +25,7 @@ from __future__ import annotations
 import copy
 import os
 import pickle
+import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -52,6 +53,14 @@ from quokka_tpu.target_info import (
     RangePartitioner,
     TargetInfo,
 )
+
+
+def new_query_id() -> str:
+    """Fresh query namespace id: short, unique per process lifetime, and
+    alphanumeric (it embeds in HBQ spill and checkpoint filenames)."""
+    import uuid
+
+    return "q" + uuid.uuid4().hex[:10]
 
 
 class LostObjectError(RuntimeError):
@@ -88,11 +97,27 @@ class ActorInfo:
 
 
 class TaskGraph:
-    """Physical plan builder (quokka_runtime.py:18-392 equivalent)."""
+    """Physical plan builder (quokka_runtime.py:18-392 equivalent).
 
-    def __init__(self, exec_config: Optional[dict] = None):
-        self.store = ControlStore()
-        self.cache = BatchCache()
+    ``query_id`` namespaces everything the graph writes — control-store
+    tables (through a NamespacedStore view), HBQ spill filenames, checkpoint
+    names, metrics keys — so many graphs can share one long-lived store and
+    spill dir (the query service).  ``store``/``cache``/``spill_dir`` let
+    the service hand in its shared, already-warm instances; a graph built
+    without them owns fresh ones, exactly as before."""
+
+    def __init__(self, exec_config: Optional[dict] = None, *,
+                 store: Optional[ControlStore] = None,
+                 cache: Optional[BatchCache] = None,
+                 query_id: Optional[str] = None,
+                 spill_dir: Optional[str] = None):
+        self.query_id = query_id
+        self.root_store = store if store is not None else ControlStore()
+        self.store = (
+            self.root_store.namespace(query_id) if query_id is not None
+            else self.root_store
+        )
+        self.cache = cache if cache is not None else BatchCache(owner=query_id)
         self.exec_config = dict(config.DEFAULT_EXEC_CONFIG)
         if exec_config:
             self.exec_config.update(exec_config)
@@ -103,26 +128,64 @@ class TaskGraph:
         self._pending_batch_fns: Dict[int, List[Callable]] = {}
         self.hbq = None
         self.ckpt_dir = None
+        self._private_spill = False  # True -> this graph owns its spill dirs
         if self.exec_config.get("fault_tolerance"):
-            import tempfile
-
             from quokka_tpu.runtime.hbq import HBQ
 
-            base = self.exec_config.get("hbq_path", "/tmp/quokka_tpu_spill/")
-            os.makedirs(base, exist_ok=True)
-            # unique per run: id()-style keys repeat across (and within)
-            # processes and would replay another run's spill files
-            self.hbq = HBQ(tempfile.mkdtemp(prefix="run-", dir=base))
-            self.ckpt_dir = tempfile.mkdtemp(prefix="ckpt-", dir=base)
+            if spill_dir is not None and query_id is not None:
+                # service mode: one SHARED spill dir; filename namespaces
+                # keep concurrent queries' spill + checkpoints apart
+                os.makedirs(spill_dir, exist_ok=True)
+                self.hbq = HBQ(spill_dir, namespace=query_id)
+                self.ckpt_dir = os.path.join(spill_dir, "ckpt")
+                os.makedirs(self.ckpt_dir, exist_ok=True)
+            else:
+                import tempfile
+
+                base = self.exec_config.get("hbq_path",
+                                            "/tmp/quokka_tpu_spill/")
+                os.makedirs(base, exist_ok=True)
+                # unique per run: id()-style keys repeat across (and within)
+                # processes and would replay another run's spill files
+                self.hbq = HBQ(tempfile.mkdtemp(prefix="run-", dir=base),
+                               namespace=query_id)
+                self.ckpt_dir = tempfile.mkdtemp(prefix="ckpt-", dir=base)
+                self._private_spill = True
 
     def cleanup(self) -> None:
         import shutil
 
         if self.hbq is not None:
-            self.hbq.wipe()
-            shutil.rmtree(self.hbq.path, ignore_errors=True)
-        if self.ckpt_dir is not None:
+            self.hbq.wipe()  # namespaced: only this query's files go
+            if self._private_spill:
+                shutil.rmtree(self.hbq.path, ignore_errors=True)
+        if self.ckpt_dir is not None and self._private_spill:
             shutil.rmtree(self.ckpt_dir, ignore_errors=True)
+        if self.query_id is not None:
+            # GC this query's checkpoints from wherever they actually went:
+            # exec_config["checkpoint_store"] (an external/shared root that
+            # outlives the graph) wins over the spill-dir default — a
+            # persistent service would otherwise leak one ckpt-<qid> set
+            # per query into the external store forever
+            ckpt_root = self.exec_config.get("checkpoint_store")
+            if ckpt_root is None and not self._private_spill:
+                ckpt_root = self.ckpt_dir  # private dirs died in the rmtree
+            if ckpt_root is not None:
+                from quokka_tpu.runtime.ckptstore import CheckpointStore
+
+                CheckpointStore(ckpt_root,
+                                namespace=self.query_id).wipe_namespace()
+        if self.query_id is not None:
+            # the one-shot path and the service both land here: a finished
+            # query's tables, queues, metrics and cache accounting all GC
+            self.snapshot_metrics()  # metrics() keeps answering post-GC
+            self.root_store.drop_namespace(self.query_id)
+            from quokka_tpu import obs
+            from quokka_tpu.runtime import scancache
+
+            scancache.GLOBAL.drop_query(self.query_id)
+            obs.REGISTRY.remove(f"cache.plan_hit.{self.query_id}",
+                                f"cache.plan_miss.{self.query_id}")
 
     def _new_actor(self, kind, channels, stage, sorted_actor=False) -> ActorInfo:
         info = ActorInfo(self._next_actor, kind, channels, stage, sorted_actor)
@@ -244,26 +307,45 @@ class TaskGraph:
         {(actor, ch): {"tasks": n, "rows": n, "bytes": n}}, plus a "compile"
         entry (utils/compilestats.snapshot()) proving kernel reuse — actor
         keys are tuples, subsystem keys are strings."""
-        out: Dict = {}
-        workers: Dict = {}
-        for key, snap in list(self.store.kv.items()):
-            if isinstance(key, tuple) and key and key[0] == "metrics":
-                for k, v in snap.items():
-                    if k == "__compile__":
-                        if key[1] != "embedded":  # embedded == this process
-                            workers[key[1]] = v
-                        continue
-                    agg = out.setdefault(k, {"tasks": 0, "rows": 0, "bytes": 0})
-                    for f in agg:
-                        agg[f] += v[f]
+        saved = getattr(self, "_saved_metrics", None)
+        out, workers = self._store_metrics() if saved is None else saved
         from quokka_tpu.utils import compilestats
 
         # kernel-reuse proof: real_compiles flat across runs == no churn;
         # worker processes report their own counters via the flush channel
+        out = dict(out)
         out["compile"] = compilestats.snapshot()
         if workers:
             out["compile"]["workers"] = workers
         return out
+
+    def _store_metrics(self) -> Tuple[Dict, Dict]:
+        """Aggregate the flushed per-worker snapshots from the store.
+        Namespaced graphs flush under ``("metrics", query_id, worker)``,
+        plain graphs under ``("metrics", worker)``."""
+        out: Dict = {}
+        workers: Dict = {}
+        want = 2 if self.query_id is None else 3
+        for key, snap in list(self.root_store.kv.items()):
+            if not (isinstance(key, tuple) and len(key) == want
+                    and key[0] == "metrics"):
+                continue
+            if self.query_id is not None and key[1] != self.query_id:
+                continue
+            for k, v in snap.items():
+                if k == "__compile__":
+                    if key[-1] != "embedded":  # embedded == this process
+                        workers[key[-1]] = v
+                    continue
+                agg = out.setdefault(k, {"tasks": 0, "rows": 0, "bytes": 0})
+                for f in agg:
+                    agg[f] += v[f]
+        return out, workers
+
+    def snapshot_metrics(self) -> None:
+        """Capture the flushed metrics before drop_namespace sweeps them
+        (metrics() keeps answering after cleanup)."""
+        self._saved_metrics = self._store_metrics()
 
 
 def plan_rewinds(store, dead_exec: List[Tuple[int, int]]) -> Dict[Tuple[int, int], Tuple[int, int, int]]:
@@ -314,6 +396,13 @@ def _feeds(partitioner, src_ch: int, tgt_ch: int, n_tgt: int) -> bool:
 
 
 # ---------------------------------------------------------------------------
+
+# Guards lazily-created per-engine state (emit pool, prefetch pool, metrics,
+# service scheduling state) against double-init when the query service drives
+# one Engine from several dispatch threads.  Module-level so the distributed
+# Worker (which bypasses Engine.__init__) is covered too.  Reentrant:
+# _service_prepare holds it across _warm_prefetch -> _ensure_prefetch_pool.
+_LAZY_INIT_LOCK = threading.RLock()
 
 
 class Engine:
@@ -444,7 +533,8 @@ class Engine:
                     tuple(info.sorted_by or ()),
                     config.x64_enabled(),  # dtype regime changes device layout
                 )
-                cached = scancache.GLOBAL.get(ckey)
+                cached = scancache.GLOBAL.get(
+                    ckey, query=getattr(self.g, "query_id", None))
                 if cached is not None:
                     return cached
         with tracing.span("reader.execute"):
@@ -460,12 +550,15 @@ class Engine:
 
     def _ensure_prefetch_pool(self):
         if getattr(self, "_prefetch", None) is None:
-            import concurrent.futures
+            with _LAZY_INIT_LOCK:
+                if getattr(self, "_prefetch", None) is None:
+                    import concurrent.futures
 
-            self._prefetch = {}
-            self._prefetch_pool = concurrent.futures.ThreadPoolExecutor(
-                max_workers=self._io_threads(), thread_name_prefix="quokka-io"
-            )
+                    self._prefetch_pool = (
+                        concurrent.futures.ThreadPoolExecutor(
+                            max_workers=self._io_threads(),
+                            thread_name_prefix="quokka-io"))
+                    self._prefetch = {}
         return self._prefetch
 
     def _take_prefetched(self, info, task, seq):
@@ -637,15 +730,30 @@ class Engine:
     # unchanged from the inline dict this replaced
     _METRICS_FLUSH_EVERY = 64
 
+    def _metrics_guard(self):
+        """Per-ENGINE lock for the EngineMetrics read-modify-write (the
+        query service dispatches one engine's tasks from several threads).
+        Per-engine so concurrent queries never contend on each other's
+        counters; the global lock only guards the lazy creation."""
+        lock = getattr(self, "_metrics_lock", None)
+        if lock is None:
+            with _LAZY_INIT_LOCK:
+                lock = getattr(self, "_metrics_lock", None)
+                if lock is None:
+                    lock = self._metrics_lock = threading.Lock()
+        return lock
+
     def _metric(self, actor: int, channel: int, rows, nbytes: int) -> None:
         """rows: an int, or a device count scalar (resolved lazily at flush
         time, when its async host copy has long landed — emit paths must not
         block on a device round trip for a counter)."""
-        m = getattr(self, "_metrics", None)
-        if m is None:
-            m = self._metrics = obs.EngineMetrics()
-        m.task(actor, channel, rows, nbytes)
-        if m.dirty >= self._METRICS_FLUSH_EVERY:
+        with self._metrics_guard():
+            m = getattr(self, "_metrics", None)
+            if m is None:
+                m = self._metrics = obs.EngineMetrics()
+            m.task(actor, channel, rows, nbytes)
+            dirty = m.dirty >= self._METRICS_FLUSH_EVERY
+        if dirty:
             self._flush_metrics()
 
     def _rows_of(self, batch):
@@ -661,7 +769,11 @@ class Engine:
         m = getattr(self, "_metrics", None)
         if m:
             wid = getattr(self, "worker_id", "embedded")
-            self.store.set(("metrics", wid), m.snapshot())
+            qid = getattr(self.g, "query_id", None)
+            key = ("metrics", wid) if qid is None else ("metrics", qid, wid)
+            with self._metrics_guard():
+                snap = m.snapshot()
+            self.store.set(key, snap)
 
     def _shutdown_prefetch(self) -> None:
         """Cancel speculative reads and release the IO threads — without this
@@ -693,7 +805,7 @@ class Engine:
         TapedExecutorTask discipline, pyquokka/task.py:139, fault-tolerance.md)."""
         if self.g.hbq is None:
             return
-        self.store.tappend("LT", ("tape", actor, ch), event)
+        self.store.tape_append(actor, ch, event)
 
     def _ckpt_store(self):
         """Checkpoints outlive their writer (reference: S3, core.py:678-685):
@@ -704,7 +816,10 @@ class Engine:
             from quokka_tpu.runtime.ckptstore import CheckpointStore
 
             root = self.g.exec_config.get("checkpoint_store") or self.g.ckpt_dir
-            store = self._ckpt_store_obj = CheckpointStore(root)
+            # query-service graphs share one checkpoint root: snapshot names
+            # carry the query namespace so neighbors never restore each other
+            store = self._ckpt_store_obj = CheckpointStore(
+                root, namespace=getattr(self.g, "query_id", None))
         return store
 
     def _checkpoint(self, executor, task: ExecutorTask) -> None:
@@ -994,7 +1109,11 @@ class Engine:
         rec = obs.RECORDER
         if not rec.enabled:
             return self._dispatch(task)
+        qid = getattr(self.g, "query_id", None)
+        qargs = {"q": qid} if qid is not None else {}
         label = f"{task.name}:a{task.actor}c{task.channel}"
+        if qid is not None:
+            label = f"{qid}:{label}"
         idle = getattr(self, "_obs_idle", None)
         if idle is None:
             idle = self._obs_idle = set()
@@ -1003,11 +1122,11 @@ class Engine:
         with rec.activity("task:" + label):
             ok = self._dispatch(task)
         if ok:
-            rec.record("task", label, dur=time.perf_counter() - t0)
+            rec.record("task", label, dur=time.perf_counter() - t0, **qargs)
             idle.discard(key)
         elif key not in idle:
             idle.add(key)
-            rec.record("task.wait", label)
+            rec.record("task.wait", label, **qargs)
         return ok
 
     def _dispatch(self, task) -> bool:
@@ -1098,20 +1217,30 @@ class Engine:
     def _emit_submit(self, fn) -> None:
         pool = getattr(self, "_emit_pool", None)
         if pool is None:
-            from concurrent.futures import ThreadPoolExecutor
+            with _LAZY_INIT_LOCK:
+                pool = getattr(self, "_emit_pool", None)
+                if pool is None:
+                    from concurrent.futures import ThreadPoolExecutor
 
-            pool = self._emit_pool = ThreadPoolExecutor(
-                max_workers=1, thread_name_prefix="quokka-emit"
-            )
-            self._emit_futs = []
-        self._emit_futs.append(pool.submit(fn))
-        while len(self._emit_futs) > self._EMIT_INFLIGHT:
-            self._emit_futs.pop(0).result()
+                    self._emit_futs = []
+                    self._emit_lock = threading.Lock()
+                    pool = self._emit_pool = ThreadPoolExecutor(
+                        max_workers=1, thread_name_prefix="quokka-emit"
+                    )
+        with self._emit_lock:
+            self._emit_futs.append(pool.submit(fn))
+        while True:
+            with self._emit_lock:
+                if len(self._emit_futs) <= self._EMIT_INFLIGHT:
+                    break
+                f = self._emit_futs.pop(0)
+            f.result()  # wait OUTSIDE the lock: conversion is a d2h sync
 
     def _flush_emits(self) -> None:
         futs = getattr(self, "_emit_futs", None)
         if futs:
-            self._emit_futs = []
+            with self._emit_lock:
+                futs, self._emit_futs = self._emit_futs, []
             for f in futs:
                 f.result()  # propagate the first conversion/append error
 
@@ -1243,6 +1372,78 @@ class Engine:
                     f"pending={self.store.ntt_total()})"
                     + (f"; flight report: {report}" if report else "")
                 )
+
+    # -- service stepping (query service, service/server.py) ------------------
+    # The multi-query scheduler round-robins NTT pops ACROSS live query
+    # namespaces; within one query, each call to service_step is one
+    # fair-scheduling quantum: pop and dispatch AT MOST ONE task, honoring
+    # the same stage discipline as run().  Task-granular quanta are what
+    # keep a large query from starving a small one sharing the pool.
+
+    def _service_prepare(self) -> None:
+        if getattr(self, "_svc_ready", False):
+            return
+        with _LAZY_INIT_LOCK:
+            if getattr(self, "_svc_ready", False):
+                return
+            self._svc_actors = sorted(
+                self.g.actors.values(), key=lambda a: (a.stage, a.id))
+            self._svc_stages = sorted({a.stage for a in self._svc_actors})
+            self._svc_stage_idx = 0
+            self._svc_cursor = 0
+            # serializes the stage barrier: a racy `_svc_stage_idx += 1`
+            # from two dispatch threads could advance PAST an unchecked
+            # stage (skipping its _stage_undone barrier)
+            self._svc_stage_lock = threading.Lock()
+            self._warm_prefetch(self._svc_actors)
+            self._svc_ready = True
+
+    def service_step(self) -> str:
+        """Returns 'done' (query complete), 'progress' (a task ran),
+        'wait' (a task popped but could not progress and requeued itself),
+        or 'idle' (nothing poppable at the current stage)."""
+        self._service_prepare()
+        actors = self._svc_actors
+        stages = self._svc_stages
+        # stage barrier: advance when nothing undone remains at the current
+        # stage.  Under the lock so each increment is preceded by its own
+        # _stage_undone check — an unsynchronized += from two dispatch
+        # threads could hop over an unchecked stage.
+        with self._svc_stage_lock:
+            while (self._svc_stage_idx < len(stages) - 1
+                   and not self._stage_undone(actors,
+                                              stages[self._svc_stage_idx])):
+                self._svc_stage_idx += 1
+        if self._all_done(actors):
+            return "done"
+        current = stages[self._svc_stage_idx]
+        n = len(actors)
+        start = self._svc_cursor
+        for i in range(n):
+            info = actors[(start + i) % n]
+            if info.kind == "input" and info.stage > current:
+                continue
+            task = self.store.ntt_pop(info.id)
+            if task is None:
+                continue
+            self._svc_cursor = (start + i + 1) % n
+            ok = self.dispatch_task(task)
+            return "progress" if ok else "wait"
+        return "idle"
+
+    def service_finalize(self) -> None:
+        """Run-end teardown for a service-driven engine: ship pending sink
+        emissions, flush counters, release the IO/emit threads (the
+        shared store and caches stay — they belong to the service)."""
+        try:
+            self._flush_emits()
+        finally:
+            try:
+                self._flush_metrics()
+            except Exception as e:  # torn-down store must not block teardown
+                obs.diag(f"[service] final metrics flush failed: {e!r}")
+            self._shutdown_prefetch()
+            self._shutdown_emitter()
 
     def _stage_undone(self, actors, stage) -> bool:
         for info in actors:

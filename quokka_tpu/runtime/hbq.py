@@ -4,65 +4,106 @@ Reference parity: pyquokka/hbq.py:30-95.  Every object pushed to the data
 plane is also written (post-partition) as an Arrow IPC file named by its
 6-tuple object name, so a ReplayTask can re-push it after a failure without
 recomputing the producer.  GC follows the cemetery table.
+
+Namespacing (the query service): many concurrent queries may share one spill
+directory.  An HBQ constructed with ``namespace=query_id`` prefixes its
+filenames ``hbq-<ns>-...`` and only ever lists/serves/wipes its own
+namespace, so co-resident queries cannot replay each other's spill.
 """
 
 from __future__ import annotations
 
 import os
+import re
 import shutil
 from typing import Optional, Sequence, Tuple
 
 import pyarrow as pa
 import pyarrow.ipc as ipc
 
-
-def _fname(name: Tuple) -> str:
-    src_actor, src_ch, seq, tgt_actor, pfn, tgt_ch = name
-    return f"hbq-{src_actor}-{src_ch}-{seq}-{tgt_actor}-{pfn}-{tgt_ch}.arrow"
+# namespaces embed in filenames between dash-separated integer fields: keep
+# them unambiguous to parse (and filesystem-safe)
+_NS_RE = re.compile(r"^[A-Za-z0-9_]+$")
 
 
 class HBQ:
-    def __init__(self, path: str):
+    def __init__(self, path: str, namespace: Optional[str] = None):
+        if namespace is not None and not _NS_RE.match(namespace):
+            raise ValueError(
+                f"HBQ namespace {namespace!r} must be alphanumeric/underscore "
+                "(it embeds in dash-separated spill filenames)"
+            )
         self.path = path
+        self.namespace = namespace
         os.makedirs(path, exist_ok=True)
 
+    def _fname(self, name: Tuple) -> str:
+        src_actor, src_ch, seq, tgt_actor, pfn, tgt_ch = name
+        ns = f"{self.namespace}-" if self.namespace is not None else ""
+        return (f"hbq-{ns}{src_actor}-{src_ch}-{seq}-{tgt_actor}-{pfn}-"
+                f"{tgt_ch}.arrow")
+
     def put(self, name: Tuple, table: pa.Table) -> None:
-        p = os.path.join(self.path, _fname(name))
+        p = os.path.join(self.path, self._fname(name))
         with ipc.new_file(p + ".tmp", table.schema) as w:
             w.write_table(table)
         os.replace(p + ".tmp", p)  # atomic: readers never see partial spills
 
     def get(self, name: Tuple) -> Optional[pa.Table]:
-        p = os.path.join(self.path, _fname(name))
+        p = os.path.join(self.path, self._fname(name))
         if not os.path.exists(p):
             return None
         with ipc.open_file(p) as r:
             return r.read_all()
 
     def contains(self, name: Tuple) -> bool:
-        return os.path.exists(os.path.join(self.path, _fname(name)))
+        return os.path.exists(os.path.join(self.path, self._fname(name)))
+
+    def _own_files(self):
+        """(filename, parsed 6-tuple name) for every spill file in THIS
+        namespace; foreign-namespace and malformed files are skipped."""
+        ns = self.namespace
+        for f in os.listdir(self.path):
+            if not (f.startswith("hbq-") and f.endswith(".arrow")):
+                continue
+            parts = f[4:-6].split("-")
+            if ns is None:
+                if len(parts) != 6:
+                    continue
+            else:
+                if len(parts) != 7 or parts[0] != ns:
+                    continue
+                parts = parts[1:]
+            try:
+                yield f, tuple(int(x) for x in parts)
+            except ValueError:
+                continue
 
     def names_for_target(self, tgt_actor: int, tgt_ch: int):
         """Spilled object names destined to one consumer channel — the
         enumeration a ReplayTask re-pushes after that consumer is rebuilt."""
         out = []
-        for f in os.listdir(self.path):
-            if not (f.startswith("hbq-") and f.endswith(".arrow")):
-                continue
-            parts = f[4:-6].split("-")
-            if len(parts) != 6:
-                continue
-            sa, sch, seq, ta, pfn, tch = (int(x) for x in parts)
-            if ta == tgt_actor and tch == tgt_ch:
-                out.append((sa, sch, seq, ta, pfn, tch))
+        for _f, name in self._own_files():
+            if name[3] == tgt_actor and name[5] == tgt_ch:
+                out.append(name)
         return sorted(out)
 
     def gc(self, names: Sequence[Tuple]) -> None:
         for name in names:
-            p = os.path.join(self.path, _fname(name))
+            p = os.path.join(self.path, self._fname(name))
             if os.path.exists(p):
                 os.remove(p)
 
     def wipe(self) -> None:
-        shutil.rmtree(self.path, ignore_errors=True)
-        os.makedirs(self.path, exist_ok=True)
+        """Drop this HBQ's spill.  A namespaced HBQ shares its directory
+        with other queries, so only its own files go; an un-namespaced one
+        owns the directory outright."""
+        if self.namespace is None:
+            shutil.rmtree(self.path, ignore_errors=True)
+            os.makedirs(self.path, exist_ok=True)
+            return
+        for f, _name in list(self._own_files()):
+            try:
+                os.remove(os.path.join(self.path, f))
+            except OSError:
+                continue

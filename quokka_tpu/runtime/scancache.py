@@ -17,6 +17,13 @@ Scope: readers opt in by exposing ``cache_key(channel, lineage)``; lineages
 whose bytes are not reproducible (REST pages, ray objects) return None and
 bypass the cache.  Capped by bytes with LRU eviction
 (QUOKKA_SCAN_CACHE_BYTES, 0 disables).
+
+Sharing: ``GLOBAL`` is PROCESS-global and thread-safe — one LRU serves every
+concurrent query in the query service, so a second query scanning the same
+parquet is a warm hit even while the first is still running.  Keys carry the
+file's byte identity, never a query id; accounting is per-query
+(``get(..., query=...)`` feeds ``stats()["by_query"]``) so the service can
+attribute warmth without fragmenting the cache.
 """
 
 from __future__ import annotations
@@ -57,19 +64,33 @@ class ScanCache:
         self._bytes = 0
         self.hits = 0
         self.misses = 0
+        # query_id -> {"hits": n, "misses": n}: per-query attribution for
+        # the service's shared cache (concurrent queries, one LRU)
+        self._by_query: dict = {}
 
     @property
     def enabled(self) -> bool:
         return self.cap > 0
 
-    def get(self, key: Tuple) -> Optional[DeviceBatch]:
+    def _account(self, query: Optional[str], field: str) -> None:
+        if query is None:
+            return
+        q = self._by_query.get(query)
+        if q is None:
+            q = self._by_query[query] = {"hits": 0, "misses": 0}
+        q[field] += 1
+
+    def get(self, key: Tuple,
+            query: Optional[str] = None) -> Optional[DeviceBatch]:
         with self._lock:
             ent = self._data.get(key)
             if ent is None:
                 self.misses += 1
+                self._account(query, "misses")
                 return None
             self._data.move_to_end(key)
             self.hits += 1
+            self._account(query, "hits")
             b, _ = ent
         return DeviceBatch(dict(b.columns), b.valid, b.nrows, b.sorted_by, b.nrows_dev)
 
@@ -97,6 +118,13 @@ class ScanCache:
             self._data.clear()
             self._bytes = 0
 
+    def drop_query(self, query: str) -> None:
+        """Forget a finished query's ACCOUNTING.  Cached batches stay — they
+        are keyed by file identity and are exactly the warmth the next query
+        over the same files wants."""
+        with self._lock:
+            self._by_query.pop(query, None)
+
     def stats(self) -> dict:
         with self._lock:
             return {
@@ -104,6 +132,7 @@ class ScanCache:
                 "bytes": self._bytes,
                 "hits": self.hits,
                 "misses": self.misses,
+                "by_query": {q: dict(c) for q, c in self._by_query.items()},
             }
 
 

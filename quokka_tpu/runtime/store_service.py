@@ -122,7 +122,7 @@ class ControlStoreClient:
 
     _WRITES = {
         "set", "ntt_push", "tset", "tappend", "tdel", "sadd",
-        "ntt_remove_exec", "ntt_remove_channel", "tape_trim",
+        "ntt_remove_exec", "ntt_remove_channel", "tape_trim", "tape_append",
         "result_append", "heartbeat", "mailbox_push", "flight_append",
     }
 

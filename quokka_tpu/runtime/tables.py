@@ -8,6 +8,14 @@ implementation is an embedded in-process store with a global lock providing the
 same serialized-transaction discipline; a networked server can implement the
 same interface later for multi-host deployments without touching the runtime.
 
+Namespacing (the query service): one store can host MANY concurrent queries.
+``store.namespace(query_id)`` returns a ``NamespacedStore`` view that wraps
+every table key as ``(query_id, key)`` (and set members as
+``(query_id, member)``), so two TaskGraphs share one store without their
+NTT/CT/DST/GIT rows colliding; ``drop_namespace(query_id)`` GCs everything a
+finished query wrote.  The view only calls the public store surface, so it
+wraps the embedded store and the RPC client alike.
+
 Table map (name -> role, reference location in pyquokka/tables.py):
   CT   cemetery: objects safe to GC                      (103)
   NOT  node -> object names it must keep                  (121)
@@ -129,9 +137,16 @@ class ControlStore:
         with self._lock:
             return len(self.tables["NTT"][node])
 
-    def ntt_total(self) -> int:
+    def ntt_total(self, ns=None) -> int:
+        """Total queued tasks; with ``ns``, only queues of that namespace
+        (node keys wrapped ``(ns, node)`` by NamespacedStore)."""
         with self._lock:
-            return sum(len(q) for q in self.tables["NTT"].values())
+            if ns is None:
+                return sum(len(q) for q in self.tables["NTT"].values())
+            return sum(
+                len(q) for k, q in self.tables["NTT"].items()
+                if isinstance(k, tuple) and len(k) == 2 and k[0] == ns
+            )
 
     # -- simple keyed tables -------------------------------------------------
     def tset(self, table: str, key, value):
@@ -168,6 +183,12 @@ class ControlStore:
     # Tapes grow per event for a run's whole life; checkpoints make the prefix
     # before the checkpoint position dead.  Positions stay LOGICAL (base +
     # list index) so LCT tape_pos values survive trimming.
+
+    def tape_append(self, actor, ch, event) -> None:
+        """Append one event to a channel's lineage tape.  The single entry
+        point for tape writes — NamespacedStore re-keys it consistently with
+        tape_len/tape_slice."""
+        self.tappend("LT", ("tape", actor, ch), event)
 
     def tape_len(self, actor, ch) -> int:
         with self._lock:
@@ -215,6 +236,40 @@ class ControlStore:
                 return key in t
             return value in t.get(key, ())
 
+    # -- namespaces (multi-query) --------------------------------------------
+    def namespace(self, query_id: str) -> "NamespacedStore":
+        """A view of this store whose table keys are wrapped
+        ``(query_id, key)`` — one store, many concurrent queries."""
+        return NamespacedStore(self, query_id)
+
+    def drop_namespace(self, query_id: str) -> int:
+        """GC every table row, queue and set member a query namespace wrote;
+        returns the number of entries dropped.  kv entries are keyed
+        free-form, so only tuple kv keys carrying the query id anywhere
+        (e.g. ``("metrics", query_id, worker)``) are swept."""
+        dropped = 0
+        with self._lock:
+            for name, t in self.tables.items():
+                if isinstance(t, set):
+                    dead = {m for m in t
+                            if isinstance(m, tuple) and len(m) == 2
+                            and m[0] == query_id}
+                    t -= dead
+                    dropped += len(dead)
+                else:
+                    dead_keys = [k for k in t
+                                 if isinstance(k, tuple) and len(k) == 2
+                                 and k[0] == query_id]
+                    for k in dead_keys:
+                        del t[k]
+                    dropped += len(dead_keys)
+            dead_kv = [k for k in self.kv
+                       if isinstance(k, tuple) and query_id in k]
+            for k in dead_kv:
+                del self.kv[k]
+            dropped += len(dead_kv)
+        return dropped
+
     # -- debug ---------------------------------------------------------------
     def dump(self) -> Dict[str, Any]:
         """Snapshot of all control tables (the debugger.py:6-41 equivalent)."""
@@ -228,3 +283,121 @@ class ControlStore:
                 else:
                     out[name] = dict(t)
             return out
+
+
+class NamespacedStore:
+    """Per-query view of a shared store: every TABLE key goes through
+    ``(query_id, key)`` (set members ``(query_id, member)``), so the engine's
+    scheduling/recovery code runs unchanged against a store hosting many
+    concurrent queries.  kv get/set, transactions and the coordinator extras
+    (heartbeat, mailboxes, results, flight streams) pass through un-wrapped —
+    they are worker/session-global, not per-query.
+
+    Only the PUBLIC store surface is called, so the same view wraps the
+    embedded ControlStore, a CoordinatorStore, or a ControlStoreClient."""
+
+    def __init__(self, root, query_id: str):
+        self._root = root
+        self.query_id = query_id
+
+    def __getattr__(self, name):
+        # kv set/get, transaction, close, heartbeat, mailbox_*, result_append,
+        # flight_append, drop_namespace, ... — namespace-independent surface
+        if name.startswith("__"):
+            raise AttributeError(name)
+        return getattr(self._root, name)
+
+    def _k(self, key):
+        return (self.query_id, key)
+
+    # -- NTT -----------------------------------------------------------------
+    def ntt_push(self, node, task):
+        return self._root.ntt_push(self._k(node), task)
+
+    def ntt_pop(self, node, *args, **kwargs):
+        return self._root.ntt_pop(self._k(node), *args, **kwargs)
+
+    def ntt_remove_exec(self, node, channel):
+        return self._root.ntt_remove_exec(self._k(node), channel)
+
+    def ntt_remove_channel(self, node, channel):
+        return self._root.ntt_remove_channel(self._k(node), channel)
+
+    def ntt_peek_all(self, node):
+        return self._root.ntt_peek_all(self._k(node))
+
+    def ntt_len(self, node):
+        return self._root.ntt_len(self._k(node))
+
+    def ntt_total(self):
+        return self._root.ntt_total(self.query_id)
+
+    # -- keyed tables --------------------------------------------------------
+    def tset(self, table, key, value):
+        return self._root.tset(table, self._k(key), value)
+
+    def tget(self, table, key, default=None):
+        return self._root.tget(table, self._k(key), default)
+
+    def titems(self, table):
+        return [
+            (k[1], v) for k, v in self._root.titems(table)
+            if isinstance(k, tuple) and len(k) == 2 and k[0] == self.query_id
+        ]
+
+    def tappend(self, table, key, value):
+        return self._root.tappend(table, self._k(key), value)
+
+    def tlen(self, table, key):
+        return self._root.tlen(table, self._k(key))
+
+    def tdel(self, table, key):
+        return self._root.tdel(table, self._k(key))
+
+    # -- lineage tape --------------------------------------------------------
+    # Reimplemented over the generic LT ops (not delegated to the root's
+    # tape_* helpers) so the composed keys land under this namespace's
+    # ``(query_id, ...)`` wrapping — one consistent prefix drop_namespace
+    # can sweep.  Single-writer-per-channel discipline makes the non-atomic
+    # base+list reads safe (the only appender is the channel's own task).
+    def tape_append(self, actor, ch, event):
+        self.tappend("LT", ("tape", actor, ch), event)
+
+    def tape_len(self, actor, ch) -> int:
+        base = self.tget("LT", ("tape_base", actor, ch), 0)
+        return base + self.tlen("LT", ("tape", actor, ch))
+
+    def tape_slice(self, actor, ch, from_logical: int) -> List:
+        base = self.tget("LT", ("tape_base", actor, ch), 0)
+        tape = self.tget("LT", ("tape", actor, ch)) or []
+        return list(tape[max(0, from_logical - base):])
+
+    def tape_trim(self, actor, ch, upto_logical: int) -> None:
+        base = self.tget("LT", ("tape_base", actor, ch), 0)
+        tape = self.tget("LT", ("tape", actor, ch))
+        if tape is None:
+            return
+        drop = max(0, min(upto_logical - base, len(tape)))
+        if drop:
+            self.tset("LT", ("tape", actor, ch), list(tape[drop:]))
+            self.tset("LT", ("tape_base", actor, ch), base + drop)
+
+    # -- set-valued tables ---------------------------------------------------
+    def sadd(self, table, key, value=None):
+        return self._root.sadd(table, self._k(key), value)
+
+    def smembers(self, table, key=None):
+        if key is None:
+            return {
+                m[1] for m in self._root.smembers(table)
+                if isinstance(m, tuple) and len(m) == 2
+                and m[0] == self.query_id
+            }
+        return self._root.smembers(table, self._k(key))
+
+    def scontains(self, table, key, value=None) -> bool:
+        return self._root.scontains(table, self._k(key), value)
+
+    def drop(self) -> int:
+        """GC this namespace from the shared store."""
+        return self._root.drop_namespace(self.query_id)

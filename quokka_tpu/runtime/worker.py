@@ -30,13 +30,17 @@ from quokka_tpu.runtime.store_service import ControlStoreClient
 class WorkerGraph:
     """Duck-typed TaskGraph for Engine: store client + local cache + actors."""
 
-    def __init__(self, store, cache, actors, exec_config, hbq, ckpt_dir):
+    def __init__(self, store, cache, actors, exec_config, hbq, ckpt_dir,
+                 query_id=None):
         self.store = store
         self.cache = cache
         self.actors = actors
         self.exec_config = exec_config
         self.hbq = hbq
         self.ckpt_dir = ckpt_dir
+        # distributed sessions run one query per served store today, so this
+        # stays None there; the engine's query tagging/namespacing keys off it
+        self.query_id = query_id
 
 
 def _actors_from_spec(spec: Dict) -> Dict[int, ActorInfo]:
@@ -65,7 +69,7 @@ class Worker(Engine):
         if hbq is None and spec["hbq_path"]:
             hbq = _worker_hbq(spec, worker_id)
         g = WorkerGraph(store, cache, actors, spec["exec_config"], hbq,
-                        spec["ckpt_dir"])
+                        spec["ckpt_dir"], query_id=spec.get("query_id"))
         self.worker_id = worker_id
         self.owned = {a: set(chs) for a, chs in owned.items()}
         self._peers: Dict[int, DataPlaneClient] = {}

@@ -1,0 +1,31 @@
+"""Query service: persistent multi-query execution over one warm runtime.
+
+``QueryService`` keeps a worker pool, a shared namespaced ControlStore, the
+process-global device scan cache and the jit compile caches alive across
+queries; ``submit(stream)`` runs many queries concurrently against them
+with byte-budgeted admission control and fair round-robin scheduling.
+"""
+
+from quokka_tpu.service.admission import (
+    AdmissionController,
+    AdmissionQueueFull,
+    AdmissionTimeout,
+    estimate_working_set,
+)
+from quokka_tpu.service.server import (
+    QueryService,
+    QueryStallTimeout,
+    ServiceShutdown,
+)
+from quokka_tpu.service.session import QueryHandle
+
+__all__ = [
+    "AdmissionController",
+    "AdmissionQueueFull",
+    "AdmissionTimeout",
+    "QueryHandle",
+    "QueryService",
+    "QueryStallTimeout",
+    "ServiceShutdown",
+    "estimate_working_set",
+]

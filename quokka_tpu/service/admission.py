@@ -1,0 +1,180 @@
+"""Admission control for the query service: a byte-budgeted gate.
+
+A query's estimated working set (reader size hints + a pipeline allowance
+mirroring the BatchCache byte accounting in runtime/cache.py) is charged
+against the service's memory budget (``QK_SERVICE_MEM_BUDGET``).  Queries
+that fit start immediately; queries that would overshoot wait in a bounded
+FIFO queue (``QK_SERVICE_QUEUE_DEPTH``) and are admitted head-of-line as
+finishing queries return budget.  Waiters that outlive the admission
+timeout (``QK_SERVICE_ADMIT_TIMEOUT``) fail with a named
+``AdmissionTimeout``; a full queue rejects at submit time with
+``AdmissionQueueFull``.
+
+Head-of-line (no barging): a small query never jumps a large one that was
+queued first — the starvation-freedom half of the fairness story (the
+scheduler's round-robin across running queries is the other half).  A query
+whose estimate alone exceeds the whole budget is not rejected: it is
+admitted when it can run ALONE (budget elasticity, not a hard wall).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+
+class AdmissionTimeout(TimeoutError):
+    """A queued query waited past the admission timeout without fitting
+    under the service memory budget."""
+
+
+class AdmissionQueueFull(RuntimeError):
+    """The admission queue is at QK_SERVICE_QUEUE_DEPTH; the submit is
+    rejected outright (bounded queue — no unbounded submit backlog)."""
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+# a query with no usable reader hints still charges something: admitting
+# "free" queries without bound would make the gate vacuous
+MIN_ESTIMATE_BYTES = 16 << 20
+# decoded/device-resident data + in-flight partitions run larger than the
+# on-disk bytes the hints report (dictionary decode, padding buckets, the
+# pipeline's max_pipeline batches in the BatchCache)
+PIPELINE_OVERHEAD = 1.25
+
+
+def estimate_working_set(graph) -> int:
+    """Estimated peak bytes a query holds across the scan cache + batch
+    cache while running: reader size hints where available (readers.py
+    ``size_hint``), floored and scaled for decode/pipeline overhead."""
+    total = 0
+    for info in graph.actors.values():
+        if info.kind != "input" or info.reader is None:
+            continue
+        hint = None
+        fn = getattr(info.reader, "size_hint", None)
+        if fn is not None:
+            try:
+                hint = fn()
+            except (OSError, ValueError, TypeError):
+                hint = None
+        if hint:
+            total += int(hint)
+    return max(int(total * PIPELINE_OVERHEAD), MIN_ESTIMATE_BYTES)
+
+
+class AdmissionController:
+    """Budget ledger + bounded FIFO wait queue.  Driven by the service
+    scheduler: ``offer`` at submit, ``poll`` each scheduling round (returns
+    newly admitted ids), ``release`` at query end."""
+
+    def __init__(self,
+                 mem_budget: Optional[int] = None,
+                 queue_depth: Optional[int] = None,
+                 max_concurrent: Optional[int] = None,
+                 admit_timeout: Optional[float] = None):
+        self.mem_budget = (
+            _env_int("QK_SERVICE_MEM_BUDGET", 4 << 30)
+            if mem_budget is None else mem_budget
+        )
+        self.queue_depth = (
+            _env_int("QK_SERVICE_QUEUE_DEPTH", 16)
+            if queue_depth is None else queue_depth
+        )
+        self.max_concurrent = (
+            _env_int("QK_SERVICE_MAX_QUERIES", 8)
+            if max_concurrent is None else max_concurrent
+        )
+        self.admit_timeout = (
+            _env_float("QK_SERVICE_ADMIT_TIMEOUT", 120.0)
+            if admit_timeout is None else admit_timeout
+        )
+        self._lock = threading.Lock()
+        self._admitted: Dict[str, int] = {}  # query_id -> charged bytes
+        self._used = 0
+        self._waiting: deque = deque()  # (query_id, est_bytes, enqueued_at)
+
+    # -- submit side ---------------------------------------------------------
+    def offer(self, query_id: str, est_bytes: int) -> None:
+        """Enqueue a query for admission; raises AdmissionQueueFull."""
+        with self._lock:
+            if len(self._waiting) >= self.queue_depth:
+                raise AdmissionQueueFull(
+                    f"admission queue is full ({self.queue_depth} waiting); "
+                    "raise QK_SERVICE_QUEUE_DEPTH or retry later"
+                )
+            self._waiting.append((query_id, int(est_bytes), time.time()))
+
+    # -- scheduler side ------------------------------------------------------
+    def _fits(self, est: int) -> bool:
+        if len(self._admitted) >= self.max_concurrent:
+            return False
+        if self._used + est <= self.mem_budget:
+            return True
+        # oversized query: may run alone rather than never
+        return not self._admitted
+
+    def poll(self) -> Tuple[List[str], List[Tuple[str, float]]]:
+        """One admission round.  Returns (admitted ids, timed-out
+        (id, waited_s) pairs).  FIFO: admission stops at the first waiter
+        that does not fit — later waiters cannot barge past it."""
+        admitted: List[str] = []
+        timed_out: List[Tuple[str, float]] = []
+        now = time.time()
+        with self._lock:
+            while self._waiting:
+                qid, est, t0 = self._waiting[0]
+                if self._fits(est):
+                    self._waiting.popleft()
+                    self._admitted[qid] = est
+                    self._used += est
+                    admitted.append(qid)
+                    continue
+                if now - t0 > self.admit_timeout:
+                    self._waiting.popleft()
+                    timed_out.append((qid, now - t0))
+                    continue
+                break  # head-of-line blocks: no barging
+        return admitted, timed_out
+
+    def cancel(self, query_id: str) -> bool:
+        """Drop a still-waiting query from the queue (submit error paths)."""
+        with self._lock:
+            for i, (qid, _est, _t0) in enumerate(self._waiting):
+                if qid == query_id:
+                    del self._waiting[i]
+                    return True
+        return False
+
+    def release(self, query_id: str) -> None:
+        with self._lock:
+            est = self._admitted.pop(query_id, None)
+            if est is not None:
+                self._used -= est
+
+    def stats(self) -> Dict:
+        with self._lock:
+            return {
+                "budget_bytes": self.mem_budget,
+                "used_bytes": self._used,
+                "admitted": dict(self._admitted),
+                "waiting": [(q, e) for q, e, _t in self._waiting],
+                "queue_depth": self.queue_depth,
+                "max_concurrent": self.max_concurrent,
+            }

@@ -1,0 +1,401 @@
+"""QueryService: a persistent multi-query engine over one warm runtime.
+
+One long-lived process hosts many concurrent queries:
+
+- **shared, warm state** — one ControlStore (each query in its own
+  namespace), the process-global device scan cache, and the process-global
+  jit/XLA compile caches all outlive any single query, so the second query
+  over the same files/kernel shapes starts hot;
+- **a worker pool** — ``QK_SERVICE_WORKERS`` dispatch threads multiplex
+  every running query.  Scheduling is round-robin ACROSS query namespaces
+  at task granularity with a per-query in-flight cap
+  (``QK_SERVICE_INFLIGHT``), so a heavy TPC-H Q5 cannot starve a
+  concurrent Q1;
+- **admission control** — a byte-budgeted gate (service/admission.py):
+  queries whose estimated working set would overshoot
+  ``QK_SERVICE_MEM_BUDGET`` wait in a bounded FIFO queue and fail with
+  ``AdmissionTimeout`` if they never fit;
+- **isolation** — per-query BatchCache, namespaced store tables, namespaced
+  HBQ spill filenames and checkpoint names in ONE shared spill dir, and an
+  explicit ``drop_namespace`` GC at query end.
+
+Usage::
+
+    svc = QueryService(pool_size=2)
+    h1 = svc.submit(ctx.read_parquet(p).groupby("k").agg_sql("sum(v) as s"))
+    h2 = svc.submit(other_stream)
+    df1, df2 = h1.to_df(), h2.to_df()
+    svc.shutdown()
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import tempfile
+import threading
+import time
+from typing import Dict, List, Optional
+
+from quokka_tpu import obs
+from quokka_tpu.runtime.cache import BatchCache
+from quokka_tpu.runtime.engine import TaskGraph, new_query_id
+from quokka_tpu.runtime.tables import ControlStore
+from quokka_tpu.service.admission import (
+    AdmissionController,
+    AdmissionTimeout,
+    _env_float,
+    _env_int,
+    estimate_working_set,
+)
+from quokka_tpu.service.session import (
+    DONE,
+    FAILED,
+    RUNNING,
+    QueryHandle,
+    QuerySession,
+)
+
+
+class ServiceShutdown(RuntimeError):
+    """submit() after shutdown(), or a query torn down by shutdown()."""
+
+
+class QueryStallTimeout(TimeoutError):
+    """A running query made no progress within QK_SERVICE_QUERY_TIMEOUT."""
+
+
+class QueryService:
+    """Persistent multi-query engine: ``submit(stream) -> QueryHandle``."""
+
+    def __init__(self,
+                 pool_size: Optional[int] = None,
+                 exec_config: Optional[dict] = None,
+                 *,
+                 mem_budget: Optional[int] = None,
+                 queue_depth: Optional[int] = None,
+                 max_concurrent: Optional[int] = None,
+                 admit_timeout: Optional[float] = None,
+                 inflight_per_query: Optional[int] = None,
+                 query_timeout: Optional[float] = None,
+                 spill_dir: Optional[str] = None):
+        from quokka_tpu import config as qconfig
+
+        self.exec_config = dict(qconfig.DEFAULT_EXEC_CONFIG)
+        if exec_config:
+            self.exec_config.update(exec_config)
+        self.pool_size = (
+            _env_int("QK_SERVICE_WORKERS", 2) if pool_size is None
+            else max(1, pool_size)
+        )
+        self.inflight_per_query = (
+            _env_int("QK_SERVICE_INFLIGHT", 2)
+            if inflight_per_query is None else max(1, inflight_per_query)
+        )
+        self.query_timeout = (
+            _env_float("QK_SERVICE_QUERY_TIMEOUT", 600.0)
+            if query_timeout is None else query_timeout
+        )
+        self.store = ControlStore()
+        self.admission = AdmissionController(
+            mem_budget=mem_budget, queue_depth=queue_depth,
+            max_concurrent=max_concurrent, admit_timeout=admit_timeout)
+        if spill_dir is not None:
+            os.makedirs(spill_dir, exist_ok=True)
+            self._spill_dir = spill_dir
+            self._own_spill = False
+        else:
+            base = self.exec_config.get("hbq_path", "/tmp/quokka_tpu_spill/")
+            os.makedirs(base, exist_ok=True)
+            self._spill_dir = tempfile.mkdtemp(prefix="service-", dir=base)
+            self._own_spill = True
+        self._lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+        self._sessions: Dict[str, QuerySession] = {}  # LIVE queries only
+        self._queued: Dict[str, QuerySession] = {}
+        self._running: List[str] = []  # round-robin order
+        self._rr = 0
+        self._finished = 0
+        self._shutdown = False
+        self._threads = [
+            threading.Thread(target=self._worker_loop, daemon=True,
+                             name=f"qksvc-{i}")
+            for i in range(self.pool_size)
+        ]
+        for t in self._threads:
+            t.start()
+        obs.RECORDER.record("service.start", f"pool={self.pool_size}")
+
+    # -- client surface ------------------------------------------------------
+    def submit(self, stream, *, working_set_bytes: Optional[int] = None,
+               exec_config: Optional[dict] = None) -> QueryHandle:
+        """Lower a DataStream's plan into this service's shared runtime and
+        queue it for admission.  Returns immediately with a QueryHandle;
+        raises AdmissionQueueFull when the wait queue is at capacity."""
+        with self._lock:
+            if self._shutdown:
+                raise ServiceShutdown("QueryService is shut down")
+        ctx = stream.ctx
+        cfg = dict(self.exec_config)
+        # overlay only the context's NON-default keys: every QuokkaContext
+        # carries the full default dict, so a blind update() would silently
+        # revert the service-level exec_config to defaults on every submit
+        from quokka_tpu import config as qconfig
+
+        defaults = qconfig.DEFAULT_EXEC_CONFIG
+        for k, v in ctx.exec_config.items():
+            if k not in defaults or defaults[k] != v:
+                cfg[k] = v
+        if exec_config:
+            cfg.update(exec_config)
+        qid = new_query_id()
+        graph = TaskGraph(cfg, store=self.store,
+                          cache=BatchCache(owner=qid), query_id=qid,
+                          spill_dir=self._spill_dir)
+        try:
+            sink_actor = ctx.lower_into(stream.node_id, graph)
+            est = (int(working_set_bytes) if working_set_bytes is not None
+                   else estimate_working_set(graph))
+            session = QuerySession(qid, graph, sink_actor, est,
+                                   self.inflight_per_query)
+            with self._lock:
+                if self._shutdown:
+                    raise ServiceShutdown("QueryService is shut down")
+                self.admission.offer(qid, est)
+                self._sessions[qid] = session
+                self._queued[qid] = session
+                self._wake.notify_all()
+        except BaseException:
+            graph.cleanup()
+            raise
+        # admit synchronously when it fits: the caller's next submit must
+        # see this query CHARGED against the budget, not still in the queue
+        self._admit_pending()
+        obs.RECORDER.record("service.submit", qid, q=qid, est_bytes=est)
+        return session.handle
+
+    def stats(self) -> Dict:
+        from quokka_tpu.runtime import scancache
+
+        with self._lock:
+            sessions = {
+                qid: {"status": s.status, "est_bytes": s.est_bytes,
+                      "inflight": s.inflight, "handled": s.handled}
+                for qid, s in self._sessions.items()
+            }
+        return {
+            "pool_size": self.pool_size,
+            "admission": self.admission.stats(),
+            "sessions": sessions,  # live only; finished sessions are GC'd
+            "finished": self._finished,
+            "scan_cache": scancache.GLOBAL.stats(),
+        }
+
+    def shutdown(self, timeout: float = 30.0) -> None:
+        """Stop the pool; unfinished queries fail with ServiceShutdown."""
+        with self._lock:
+            if self._shutdown:
+                return
+            self._shutdown = True
+            self._wake.notify_all()
+        for t in self._threads:
+            t.join(timeout)
+        for s in list(self._sessions.values()):
+            if not s.finished:
+                self.admission.cancel(s.query_id)
+                s.finish(ServiceShutdown(
+                    f"service shut down with query {s.query_id} unfinished"))
+                self.admission.release(s.query_id)
+        if self._own_spill:
+            shutil.rmtree(self._spill_dir, ignore_errors=True)
+        obs.RECORDER.record("service.stop", "")
+
+    close = shutdown
+
+    def __enter__(self) -> "QueryService":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()
+
+    # -- scheduler -----------------------------------------------------------
+    def _admit_pending(self) -> None:
+        admitted, timed_out = self.admission.poll()
+        if not admitted and not timed_out:
+            return
+        to_fail: List = []
+        with self._lock:
+            now = time.time()
+            for qid in admitted:
+                s = self._queued.pop(qid, None)
+                if s is None:
+                    continue
+                s.status = RUNNING
+                s.started_at = now
+                s.last_progress = now
+                self._running.append(qid)
+                obs.RECORDER.record("service.admit", qid, q=qid)
+            for qid, waited in timed_out:
+                s = self._queued.pop(qid, None)
+                if s is not None:
+                    to_fail.append((s, waited))
+        for s, waited in to_fail:
+            obs.RECORDER.record("service.admit_timeout", s.query_id,
+                                q=s.query_id)
+            s.finish(AdmissionTimeout(
+                f"query {s.query_id} (est {s.est_bytes >> 20} MiB) waited "
+                f"{waited:.1f}s for admission under the "
+                f"QK_SERVICE_MEM_BUDGET byte budget"))
+            with self._lock:
+                self._sessions.pop(s.query_id, None)
+                self._finished += 1
+
+    def _next_slot(self) -> Optional[QuerySession]:
+        """Round-robin pick of a running session with a free in-flight slot;
+        takes the slot (caller MUST release via _release_slot)."""
+        with self._lock:
+            n = len(self._running)
+            for i in range(n):
+                idx = (self._rr + i) % n
+                s = self._sessions.get(self._running[idx])
+                if (s is None or s.status != RUNNING or s.want_exclusive
+                        or s.inflight >= s.inflight_cap):
+                    continue
+                s.inflight += 1
+                self._rr = (idx + 1) % max(1, n)
+                return s
+        return None
+
+    def _release_slot(self, session: QuerySession) -> None:
+        with self._lock:
+            session.inflight -= 1
+
+    def _worker_loop(self) -> None:
+        fruitless = 0  # consecutive non-progress quanta on THIS thread
+        while True:
+            with self._lock:
+                if self._shutdown:
+                    return
+                n_running = len(self._running)
+            self._admit_pending()
+            session = self._next_slot()
+            if session is None:
+                with self._wake:
+                    if not self._shutdown:
+                        self._wake.wait(0.005)
+                continue
+            err: Optional[BaseException] = None
+            outcome = None
+            try:
+                outcome = session.engine.service_step()
+            except BaseException as e:  # noqa: BLE001 — fail THIS query only
+                err = e
+            finally:
+                self._release_slot(session)
+            if err is not None:
+                self._finish(session, err)
+                continue
+            if outcome == "done":
+                fruitless = 0
+                self._finish(session, None)
+            elif outcome == "progress":
+                fruitless = 0
+                session.last_progress = time.time()
+                due = False
+                with self._lock:
+                    session.handled += 1
+                    inj = session.inject
+                    due = (inj is not None
+                           and session.handled >= inj["after_tasks"])
+                if due:
+                    self._maybe_inject(session)
+            else:  # "wait" / "idle": the query is blocked on its own pipeline
+                if time.time() - session.last_progress > self.query_timeout:
+                    self._finish(session, QueryStallTimeout(
+                        f"query {session.query_id} made no progress for "
+                        f"{self.query_timeout:.0f}s "
+                        f"(pending tasks: {session.graph.store.ntt_total()})"))
+                    continue
+                # back off only once every running query got a fruitless
+                # quantum from this thread — a single blocked query must
+                # neither hot-spin the pool nor throttle its neighbors
+                fruitless += 1
+                if fruitless >= max(2, 2 * n_running):
+                    fruitless = 0
+                    time.sleep(0.002)
+
+    def _maybe_inject(self, session: QuerySession) -> None:
+        """Run the query's configured fault injection (the
+        test_fault_tolerance.py ``inject_failure`` discipline) with the
+        session held EXCLUSIVELY — recovery rewrites executor state and
+        queues, which must not race a concurrent dispatch of the same
+        query.  Other queries keep running throughout."""
+        with self._lock:
+            inj = session.inject
+            if inj is None or session.want_exclusive:
+                return
+            session.want_exclusive = True  # scheduler stops granting slots
+        deadline = time.time() + 30.0
+        while True:
+            with self._lock:
+                if session.inflight == 0:
+                    session.inflight = 1
+                    break
+                if time.time() > deadline:
+                    session.want_exclusive = False
+                    return  # retry after the next progress quantum
+            time.sleep(0.001)
+        err = None
+        try:
+            obs.RECORDER.record("service.inject", session.query_id,
+                                q=session.query_id,
+                                channels=repr(inj["channels"]))
+            session.engine.simulate_failure_and_recover(inj["channels"])
+            session.inject = None
+        except BaseException as e:  # noqa: BLE001
+            err = e
+        finally:
+            with self._lock:
+                session.inflight -= 1
+                session.want_exclusive = False
+        if err is not None:
+            self._finish(session, err)
+
+    def _finish(self, session: QuerySession,
+                err: Optional[BaseException]) -> None:
+        qid = session.query_id
+        # stop granting slots, then wait for in-flight quanta to drain so
+        # teardown never races a live dispatch.  The drain window is the
+        # query-stall timeout: a quantum still running past it is the same
+        # wedged-dispatch judgment the stall detector makes — log loudly
+        # and tear down anyway rather than leak the session forever.
+        with self._lock:
+            if session.status in (DONE, FAILED):
+                return
+            session.want_exclusive = True
+        deadline = time.time() + self.query_timeout
+        while time.time() < deadline:
+            with self._lock:
+                if session.inflight == 0:
+                    break
+            time.sleep(0.001)
+        else:
+            obs.diag(f"[service] tearing down {qid} with "
+                     f"{session.inflight} dispatch quantum(s) still live "
+                     f"after {self.query_timeout:.0f}s drain")
+        first = session.finish(err)
+        with self._lock:
+            if qid in self._running:
+                self._running.remove(qid)
+            # drop the service-side reference: a persistent service would
+            # otherwise retain every finished query's Engine/graph/results
+            # forever (the client's QueryHandle keeps the session alive for
+            # exactly as long as the client cares)
+            self._sessions.pop(qid, None)
+            self._finished += 1
+            self._wake.notify_all()
+        if first:
+            self.admission.release(qid)
+            kind = "service.fail" if err is not None else "service.done"
+            obs.RECORDER.record(kind, qid, q=qid,
+                                **({"error": repr(err)} if err else {}))

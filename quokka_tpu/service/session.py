@@ -1,0 +1,178 @@
+"""Per-query session state + the client-facing QueryHandle.
+
+A session is one query's life inside the service: its namespaced TaskGraph,
+its Engine (executors + partition fns + per-query BatchCache), scheduling
+state (in-flight count, round-robin bookkeeping, injection hooks), and the
+completion plumbing the handle waits on.  The handle is the only object
+clients hold; it stays valid after the service GCs the query's namespace
+(results, metrics and scan-cache attribution are snapshotted at finish).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+# status values a session moves through (strictly forward)
+QUEUED = "queued"
+RUNNING = "running"
+DONE = "done"
+FAILED = "failed"
+
+
+class QuerySession:
+    """Internal per-query record.  The service's scheduler lock guards the
+    scheduling fields (inflight, want_exclusive); the session's own lock
+    guards the one-shot finish transition."""
+
+    def __init__(self, query_id: str, graph, sink_actor: int, est_bytes: int,
+                 inflight_cap: int):
+        from quokka_tpu.runtime.engine import Engine
+
+        self.query_id = query_id
+        self.graph = graph
+        self.sink_actor = sink_actor
+        self.est_bytes = est_bytes
+        self.engine = Engine(graph)
+        self.status = QUEUED
+        self.error: Optional[BaseException] = None
+        self.handle = QueryHandle(self)
+        self._done = threading.Event()
+        self._finish_lock = threading.Lock()
+        # scheduling state (guarded by the SERVICE lock, not this session's)
+        self.inflight = 0
+        self.inflight_cap = max(1, inflight_cap)
+        self.want_exclusive = False
+        self.handled = 0  # successfully dispatched tasks (injection trigger)
+        self.submitted_at = time.time()
+        self.started_at: Optional[float] = None
+        self.finished_at: Optional[float] = None
+        # the service's stall detector measures QK_SERVICE_QUERY_TIMEOUT
+        # against this (server._worker_loop)
+        self.last_progress = time.time()
+        # fault-injection hook (the test_fault_tolerance.py discipline):
+        # {"after_tasks": n, "channels": [(actor, ch), ...]} — consumed once
+        self.inject = dict(graph.exec_config.get("inject_failure") or {}) or None
+        # snapshotted at finish, before the namespace GC
+        self.scan_stats: Optional[Dict] = None
+
+    # -- finish (exactly once) ----------------------------------------------
+    def finish(self, error: Optional[BaseException] = None) -> bool:
+        """Transition to DONE/FAILED; returns False if already finished.
+        Tears the query down: flush emitters/metrics, snapshot per-query
+        stats, then GC the namespace (store tables, spill, checkpoints)."""
+        with self._finish_lock:
+            if self.status in (DONE, FAILED):
+                return False
+            self.status = FAILED if error is not None else DONE
+            self.error = error
+        try:
+            try:
+                self.engine.service_finalize()
+            except Exception as e:  # noqa: BLE001 — keep first error
+                if error is None:
+                    self.status = FAILED
+                    self.error = error = e
+            from quokka_tpu.runtime import scancache
+
+            stats = scancache.GLOBAL.stats()["by_query"].get(self.query_id)
+            self.scan_stats = dict(stats) if stats else {"hits": 0,
+                                                         "misses": 0}
+            try:
+                self.graph.cleanup()  # metrics snapshot + drop_namespace
+            except Exception as e:  # noqa: BLE001 — teardown must not kill
+                from quokka_tpu import obs  # the pool thread running it
+
+                obs.diag(f"[service] cleanup of {self.query_id} failed: "
+                         f"{e!r}")
+        finally:
+            self.finished_at = time.time()
+            self._done.set()
+        return True
+
+    @property
+    def finished(self) -> bool:
+        return self._done.is_set()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self._done.wait(timeout)
+
+
+class QueryHandle:
+    """What ``QueryService.submit`` returns: completion waiting, the
+    (incrementally filling) ResultDataset, per-query metrics and scan-cache
+    attribution.  Safe to use from any thread."""
+
+    def __init__(self, session: QuerySession):
+        self._s = session
+
+    @property
+    def query_id(self) -> str:
+        return self._s.query_id
+
+    @property
+    def status(self) -> str:
+        return self._s.status
+
+    @property
+    def done(self) -> bool:
+        return self._s.finished
+
+    @property
+    def error(self) -> Optional[BaseException]:
+        return self._s.error
+
+    @property
+    def dataset(self):
+        """The LIVE ResultDataset — partial while the query streams, the
+        full result once ``done``."""
+        return self._s.graph.result(self._s.sink_actor)
+
+    def wait(self, timeout: Optional[float] = None) -> "QueryHandle":
+        if not self._s.wait(timeout):
+            raise TimeoutError(
+                f"query {self.query_id} did not finish within {timeout}s "
+                f"(status={self.status})"
+            )
+        return self
+
+    def result(self, timeout: Optional[float] = None):
+        """Block until the query finishes and return its ResultDataset;
+        re-raises the query's error if it failed."""
+        self.wait(timeout)
+        if self._s.error is not None:
+            raise self._s.error
+        return self.dataset
+
+    def to_arrow(self, timeout: Optional[float] = None):
+        return self.result(timeout).to_arrow()
+
+    def to_df(self, timeout: Optional[float] = None):
+        return self.result(timeout).to_df()
+
+    def metrics(self) -> Dict:
+        """Per-(actor, channel) progress counters (TaskGraph.metrics shape)
+        — answered from the finish-time snapshot after teardown."""
+        return self._s.graph.metrics()
+
+    def scan_cache_stats(self) -> Optional[Dict]:
+        """This query's shared-scan-cache attribution ({hits, misses}) —
+        live while running, snapshotted at finish."""
+        if self._s.scan_stats is not None:
+            return dict(self._s.scan_stats)
+        from quokka_tpu.runtime import scancache
+
+        return scancache.GLOBAL.stats()["by_query"].get(self.query_id)
+
+    def timings(self) -> Dict[str, Optional[float]]:
+        s = self._s
+        return {
+            "submitted_at": s.submitted_at,
+            "started_at": s.started_at,
+            "finished_at": s.finished_at,
+            "queue_s": (s.started_at - s.submitted_at)
+            if s.started_at else None,
+            "run_s": (s.finished_at - s.started_at)
+            if s.started_at and s.finished_at else None,
+        }

@@ -1,0 +1,22 @@
+"""QK008 fixture: process-global config mutation reachable from query
+execution — each of the three mutation families fires once."""
+
+import os
+
+import jax
+
+
+def mutate_backend_config(flag):
+    # QK008: jax.config is process-global; flipping x64 mid-query changes
+    # every concurrent query's dtype regime
+    jax.config.update("jax_enable_x64", flag)
+
+
+def mutate_environment(value):
+    # QK008: env vars feed config.use_hash_tables()/use_host_asof() lazily
+    os.environ["QUOKKA_HASH_TABLES"] = value
+
+
+def mutate_config_module_global(config, rows):
+    # QK008: quokka_tpu.config module globals (spill thresholds, buckets)
+    config.SPILL_SORT_ROWS = rows

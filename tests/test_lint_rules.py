@@ -21,6 +21,7 @@ CASES = [
     ("QK005", "qk005_unlocked.py", 2),       # dict store, list append
     ("QK006", "qk006_swallow.py", 1),
     ("QK007", "qk007_print.py", 1),          # library print; main() exempt
+    ("QK008", "qk008_global_config.py", 3),  # jax.config, environ, module
 ]
 
 
