@@ -4,7 +4,7 @@
 PY ?= python
 export JAX_PLATFORMS ?= cpu
 
-.PHONY: lint lint-baseline test test-slow sanitize-demo service-smoke
+.PHONY: lint lint-baseline test test-slow sanitize-demo service-smoke chaos-smoke
 
 # engine-invariant static analysis; exits nonzero on findings beyond the
 # checked-in baseline (quokka_tpu/analysis/baseline.json)
@@ -39,3 +39,11 @@ stall-demo:
 service-smoke:
 	QUOKKA_BENCH_SF=0.01 QUOKKA_BENCH_CACHE=/tmp/quokka_tpu_bench_smoke \
 		$(PY) bench.py --service --smoke
+
+# chaos plane soak: >= 20 seeded mixed-fault runs (RPC drops/delays, flaky
+# store calls, worker kills, spill + checkpoint corruption) each asserting
+# BIT-EXACT results vs an undisturbed baseline; every injected corruption
+# must be detected via checksum.  A failing run prints its QK_CHAOS spec
+# and an exact replay command.  Bounded for the 1-core CI box (~1 min).
+chaos-smoke:
+	QK_COORD_TIMEOUT=240 $(PY) -m quokka_tpu.chaos.soak --runs 20

@@ -699,5 +699,12 @@ if __name__ == "__main__":
         # query -> its error, empty smoke result -> RuntimeError): any of
         # them exits nonzero
         measure_service(ensure_data(), smoke="--smoke" in sys.argv[2:])
+    elif len(sys.argv) > 1 and sys.argv[1] == "--chaos":
+        # seeded mixed-fault soak (the chaos plane, quokka_tpu/chaos):
+        # bit-exact-under-injection is a robustness benchmark, so it rides
+        # the bench entry point too; extra args pass through (--runs/--seed)
+        from quokka_tpu.chaos.soak import main as chaos_main
+
+        sys.exit(chaos_main(sys.argv[2:]))
     else:
         main()

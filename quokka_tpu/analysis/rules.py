@@ -26,6 +26,11 @@ Rule ids:
                                 module globals) — with the query service
                                 many queries share one process, so a query
                                 mutating globals corrupts its neighbors
+  QK009 unbounded-io-timeout    network/socket/fsspec calls without an
+                                explicit timeout — a wedged socket or
+                                object-store request hangs a worker to the
+                                stall timeout instead of failing fast into
+                                the retry/recovery path
 
 Finding keys (``Finding.key``) are line-number-free — ``rule::relpath::
 scope::snippet[::n]`` — so a baseline survives unrelated edits above the
@@ -799,6 +804,94 @@ def check_global_config_mutation(tree: ast.Module, path: str, rel: str,
     return out
 
 
+# ---------------------------------------------------------------------------
+# QK009 — network/socket/fsspec IO without an explicit timeout
+# ---------------------------------------------------------------------------
+
+# dotted-call tails that open a network connection and accept a timeout
+_NET_CALLS_NEED_TIMEOUT = ("create_connection",)
+# fsspec AbstractFileSystem methods that perform remote IO; flagged when
+# called on an fs-named receiver (`fs`, `self._fs`, ...), since the bound-
+# filesystem idiom `fs = fsspec...; fs.open(...)` never spells "fsspec."
+_FS_METHODS = ("open", "cat_file", "pipe_file", "mv", "copy", "rm", "glob",
+               "exists", "makedirs", "info", "ls", "get", "put")
+
+
+def check_unbounded_io(tree: ast.Module, path: str, rel: str,
+                       src_lines: Sequence[str]) -> List[Finding]:
+    """Runtime code must never block unboundedly on network/remote IO: a
+    wedged socket or object-store request otherwise hangs a worker until
+    the coordinator's stall timeout instead of failing fast into the
+    retry/backoff/recovery path the chaos plane exercises.  Flags:
+
+    - ``socket.create_connection(...)`` with neither a ``timeout=`` kwarg
+      nor a positional timeout;
+    - explicit ``.settimeout(None)`` (unbounded by declaration);
+    - any ``fsspec.*`` call, and any ``_FS_METHODS`` call on an fs-named
+      receiver (``fs.open``, ``self._fs.mv``, ...), without a ``timeout=``
+      kwarg — fsspec has no portable timeout parameter, so every site is
+      flagged and the deliberate ones carry baseline rationales (bounded
+      by caller-side deadlines/retries/watchdogs instead).
+    """
+    out: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = _dotted(node.func)
+        if d is None:
+            continue
+        tail = d.rsplit(".", 1)[-1]
+        # timeout=None is the unbounded pattern itself, not a bound
+        has_timeout_kw = any(
+            kw.arg == "timeout"
+            and not (isinstance(kw.value, ast.Constant)
+                     and kw.value.value is None)
+            for kw in node.keywords)
+        if tail in _NET_CALLS_NEED_TIMEOUT:
+            if not has_timeout_kw and len(node.args) < 2:
+                out.append(_mk(
+                    "QK009", "unbounded-io-timeout", path, rel, node,
+                    _scope_of(tree, node),
+                    f"'{d}(...)' without an explicit timeout blocks forever "
+                    "on a wedged peer — pass timeout= so the call fails "
+                    "fast into the retry/recovery path",
+                    src_lines))
+        elif (isinstance(node.func, ast.Attribute)
+              and node.func.attr == "settimeout"
+              and len(node.args) == 1
+              and isinstance(node.args[0], ast.Constant)
+              and node.args[0].value is None):
+            out.append(_mk(
+                "QK009", "unbounded-io-timeout", path, rel, node,
+                _scope_of(tree, node),
+                "'settimeout(None)' makes the socket block unboundedly — "
+                "use a finite timeout, or baseline with the rationale for "
+                "why this wait is legitimately unbounded",
+                src_lines))
+        elif d.startswith("fsspec.") and not has_timeout_kw:
+            out.append(_mk(
+                "QK009", "unbounded-io-timeout", path, rel, node,
+                _scope_of(tree, node),
+                f"'{d}(...)' (remote filesystem IO) has no timeout — bound "
+                "it with a caller-side deadline/retry and baseline with "
+                "that rationale",
+                src_lines))
+        elif (isinstance(node.func, ast.Attribute)
+              and node.func.attr in _FS_METHODS
+              and not has_timeout_kw):
+            recv = _dotted(node.func.value)
+            base = recv.rsplit(".", 1)[-1] if recv else ""
+            if base == "fs" or base.endswith("_fs"):
+                out.append(_mk(
+                    "QK009", "unbounded-io-timeout", path, rel, node,
+                    _scope_of(tree, node),
+                    f"'{d}(...)' (bound-filesystem remote IO) has no "
+                    "timeout — bound it with a caller-side deadline/retry "
+                    "and baseline with that rationale",
+                    src_lines))
+    return out
+
+
 RULES = (
     check_module_level_jit,
     check_import_time_side_effects,
@@ -808,6 +901,7 @@ RULES = (
     check_swallowed_exceptions,
     check_bare_print,
     check_global_config_mutation,
+    check_unbounded_io,
 )
 
 

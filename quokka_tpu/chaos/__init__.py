@@ -1,0 +1,18 @@
+"""Chaos plane: seeded multi-layer fault injection (see chaos/plane.py).
+
+Import surface::
+
+    from quokka_tpu.chaos import CHAOS          # the process switchboard
+    CHAOS.configure("seed=42,rpc=0.05,corrupt=0.02,kill=1")
+    CHAOS.disable()
+
+The soak driver lives in ``quokka_tpu.chaos.soak`` (``make chaos-smoke``).
+"""
+
+from quokka_tpu.chaos.plane import (  # noqa: F401
+    CHAOS,
+    ChaosConfig,
+    ChaosPlane,
+    ChaosSpecError,
+    publish_env,
+)

@@ -1,0 +1,349 @@
+"""The chaos plane: seeded, probabilistic, multi-layer fault injection.
+
+The reference validated its lineage recovery protocol by MANUALLY killing
+instances (fault-tolerance.md); our port's scripted injection
+(``inject_failure`` / ``kill_after_inputs``) is deterministic but narrow.
+This plane makes the ugly failures — dropped RPC connections, flaky store
+calls, truncated/bit-flipped spill and checkpoint files, workers killed at
+random task boundaries — continuous, probabilistic, and exactly
+reproducible from one spec string:
+
+    QK_CHAOS="seed=42,rpc=0.02,delay=0.05,store=0.05,corrupt=0.01,kill=1"
+
+Grammar (comma-separated ``key=value``; unknown keys are an error so a
+typo'd soak never silently runs fault-free):
+
+    seed=N            base seed; every site derives its own RNG stream
+    rpc=P             P(drop the connection) per RPC request, pre- OR
+                      post-send (post-send exercises server-side dedup)
+    delay=P           P(inject a 1-20 ms stall) per RPC request
+    store=P           P(TransientStoreError) per control-store op, raised
+                      BEFORE the request leaves the client (retry-safe)
+    corrupt=P         P(truncate or bit-flip) per artifact write
+    corrupt_spill=P   override for HBQ spill files only
+    corrupt_ckpt=P    override for checkpoint files only
+    kill=N            kill N workers (distributed: SIGKILL at an input
+                      boundary; embedded: lose random exec channels at a
+                      task boundary).  Requires fault_tolerance.
+    kill_after=N      earliest task/input boundary for the first kill
+                      (default 6)
+
+Determinism: each injection site draws from its own ``random.Random``
+seeded by ``(seed, site, role)`` — ``role`` is "main" in the coordinator/
+embedded process and "worker-K" in spawned workers (set by worker_main).
+Same spec => same fault plan per process role, so a failing soak run
+replays by exporting the printed ``QK_CHAOS`` string.  Thread interleaving
+is not controlled (it never is), but every fault is recorded in the flight
+recorder (``chaos.*`` events) so a replayed run is diffable.
+
+The plane is inert (zero overhead beyond one attribute check) unless
+``QK_CHAOS`` is set or ``configure()`` is called.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_PROB_KEYS = ("rpc", "delay", "store", "corrupt", "corrupt_spill",
+              "corrupt_ckpt")
+_INT_KEYS = ("seed", "kill", "kill_after")
+_DELAY_RANGE = (0.001, 0.020)
+
+
+class ChaosSpecError(ValueError):
+    """Malformed QK_CHAOS spec (unknown key, unparsable value)."""
+
+
+class ChaosConfig:
+    """Parsed, validated QK_CHAOS spec."""
+
+    def __init__(self, seed: int = 0, kill: int = 0, kill_after: int = 6,
+                 **probs: float):
+        self.seed = int(seed)
+        self.kill = int(kill)
+        self.kill_after = int(kill_after)
+        self.probs: Dict[str, float] = {k: 0.0 for k in _PROB_KEYS}
+        # keys the spec set EXPLICITLY: corrupt_spill=0 must override a
+        # nonzero corrupt= (a falsy-0.0 `or` fallback would silently ignore
+        # the override)
+        self._explicit = frozenset(probs)
+        for k, v in probs.items():
+            if k not in _PROB_KEYS:
+                raise ChaosSpecError(f"unknown chaos key {k!r}")
+            if not 0.0 <= float(v) <= 1.0:
+                raise ChaosSpecError(f"chaos probability {k}={v} not in [0,1]")
+            self.probs[k] = float(v)
+
+    @classmethod
+    def parse(cls, spec: str) -> "ChaosConfig":
+        kw: Dict[str, float] = {}
+        seed = kill = 0
+        kill_after = 6
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if "=" not in part:
+                raise ChaosSpecError(f"chaos spec item {part!r} is not k=v")
+            k, _, v = part.partition("=")
+            k = k.strip()
+            v = v.strip()
+            try:
+                if k == "seed":
+                    seed = int(v)
+                elif k == "kill":
+                    kill = int(v)
+                elif k == "kill_after":
+                    kill_after = int(v)
+                elif k in _PROB_KEYS:
+                    kw[k] = float(v)
+                else:
+                    raise ChaosSpecError(f"unknown chaos key {k!r}")
+            except ValueError as e:
+                if isinstance(e, ChaosSpecError):
+                    raise
+                raise ChaosSpecError(
+                    f"bad chaos value {part!r}: {e}") from None
+        return cls(seed=seed, kill=kill, kill_after=kill_after, **kw)
+
+    def prob(self, site: str) -> float:
+        if site == "spill":
+            return (self.probs["corrupt_spill"]
+                    if "corrupt_spill" in self._explicit
+                    else self.probs["corrupt"])
+        if site == "ckpt":
+            return (self.probs["corrupt_ckpt"]
+                    if "corrupt_ckpt" in self._explicit
+                    else self.probs["corrupt"])
+        return self.probs.get(site, 0.0)
+
+    def render(self) -> str:
+        """Canonical spec string (what a failing soak prints for replay)."""
+        out = [f"seed={self.seed}"]
+        for k in _PROB_KEYS:
+            if self.probs[k] or k in self._explicit:
+                out.append(f"{k}={self.probs[k]:g}")
+        if self.kill:
+            out.append(f"kill={self.kill}")
+            out.append(f"kill_after={self.kill_after}")
+        return ",".join(out)
+
+
+class ChaosPlane:
+    """Process-wide injection switchboard.  All sites consult this one
+    instance (``quokka_tpu.chaos.CHAOS``); sites draw from independent
+    seeded streams so adding a draw at one site never shifts another's."""
+
+    def __init__(self):
+        self._cfg: Optional[ChaosConfig] = None
+        self._role = "main"
+        self._rngs: Dict[str, random.Random] = {}
+        self._lock = threading.Lock()
+        self._loaded_env = False
+
+    # -- configuration -------------------------------------------------------
+    @property
+    def enabled(self) -> bool:
+        if self._cfg is None and not self._loaded_env:
+            self._load_env()
+        return self._cfg is not None
+
+    @property
+    def config(self) -> Optional[ChaosConfig]:
+        if self._cfg is None and not self._loaded_env:
+            self._load_env()
+        return self._cfg
+
+    def _load_env(self) -> None:
+        with self._lock:
+            if self._loaded_env:
+                return
+            self._loaded_env = True
+            spec = os.environ.get("QK_CHAOS", "").strip()
+            if spec and spec != "0":
+                self._cfg = ChaosConfig.parse(spec)
+
+    def configure(self, spec) -> None:
+        """Enable from a spec string or ChaosConfig (tests, the soak)."""
+        with self._lock:
+            self._cfg = (spec if isinstance(spec, ChaosConfig)
+                         else ChaosConfig.parse(spec))
+            self._rngs.clear()
+            self._loaded_env = True
+
+    def disable(self) -> None:
+        with self._lock:
+            self._cfg = None
+            self._rngs.clear()
+            self._loaded_env = True
+
+    def set_role(self, role: str) -> None:
+        """Per-process stream identity ("main", "worker-3", ...); spawned
+        workers call this so their fault plan differs from (but is as
+        reproducible as) the coordinator's."""
+        with self._lock:
+            self._role = role
+            self._rngs.clear()
+
+    def describe(self) -> str:
+        cfg = self.config
+        return "off" if cfg is None else cfg.render()
+
+    def _rng(self, site: str) -> random.Random:
+        r = self._rngs.get(site)
+        if r is None:
+            with self._lock:
+                r = self._rngs.get(site)
+                if r is None:
+                    cfg = self._cfg
+                    seed = 0 if cfg is None else cfg.seed
+                    r = random.Random(f"{seed}:{self._role}:{site}")
+                    self._rngs[site] = r
+        return r
+
+    def _record(self, site: str, label: str, **args) -> None:
+        from quokka_tpu import obs
+
+        obs.REGISTRY.counter(f"chaos.{site}").inc()
+        obs.RECORDER.record(f"chaos.{site}", label, **args)
+
+    def _roll(self, site: str, prob_site: Optional[str] = None) -> bool:
+        cfg = self.config
+        if cfg is None:
+            return False
+        p = cfg.prob(prob_site or site)
+        if p <= 0.0:
+            return False
+        return self._rng(site).random() < p
+
+    # -- RPC faults ----------------------------------------------------------
+    def rpc_fault(self) -> Optional[str]:
+        """Per-request verdict for the RPC client: None (healthy), "pre"
+        (drop the connection before the request is sent) or "post" (drop it
+        after send, before the response — the retried request must dedup
+        server-side).  May also sleep a few ms (``delay``)."""
+        if not self.enabled:
+            return None
+        if self._roll("delay"):
+            import time
+
+            d = self._rng("delay").uniform(*_DELAY_RANGE)
+            self._record("delay", f"{d * 1e3:.1f}ms")
+            time.sleep(d)
+        if self._roll("rpc"):
+            mode = "post" if self._rng("rpc").random() < 0.5 else "pre"
+            self._record("rpc", f"drop-{mode}")
+            return mode
+        return None
+
+    # -- store faults --------------------------------------------------------
+    def store_fault(self, method: str) -> None:
+        """Raise TransientStoreError (before the request is sent) with
+        probability ``store`` — the caller's bounded retry absorbs it."""
+        if self.enabled and self._roll("store"):
+            from quokka_tpu.runtime.errors import TransientStoreError
+
+            self._record("store", method)
+            raise TransientStoreError(
+                f"chaos: injected transient store failure on {method!r}")
+
+    # -- artifact corruption -------------------------------------------------
+    def corrupt_artifact(self, data: bytes, site: str = "spill"
+                         ) -> Optional[bytes]:
+        """With probability ``corrupt_{site}`` (or ``corrupt``), return a
+        truncated or bit-flipped copy of the framed artifact bytes; else
+        None.  The mangled bytes MUST fail integrity verification — the
+        whole point is that the reader detects, quarantines and recovers."""
+        if not self.enabled or not self._roll(f"corrupt-{site}", site):
+            return None
+        rng = self._rng(f"corrupt-{site}")
+        if rng.random() < 0.5 and len(data) > 1:
+            cut = rng.randrange(0, len(data) - 1)
+            self._record("corrupt", f"{site}:truncate@{cut}/{len(data)}")
+            return data[:cut]
+        i = rng.randrange(0, len(data))
+        flipped = data[:i] + bytes([data[i] ^ (1 << rng.randrange(8))]) \
+            + data[i + 1:]
+        self._record("corrupt", f"{site}:bitflip@{i}/{len(data)}")
+        return flipped
+
+    def corrupt_file(self, path: str, site: str) -> None:
+        """File-level corruption for streamed artifacts: truncate or
+        bit-flip the on-disk file in place (same probability/streams as
+        ``corrupt_artifact``, without buffering the payload)."""
+        if not self.enabled or not self._roll(f"corrupt-{site}", site):
+            return
+        rng = self._rng(f"corrupt-{site}")
+        size = os.path.getsize(path)
+        if size < 2:
+            return
+        if rng.random() < 0.5:
+            cut = rng.randrange(0, size - 1)
+            self._record("corrupt", f"{site}:truncate@{cut}/{size}")
+            os.truncate(path, cut)
+            return
+        i = rng.randrange(0, size)
+        with open(path, "r+b") as f:
+            f.seek(i)
+            byte = f.read(1)[0]
+            f.seek(i)
+            f.write(bytes([byte ^ (1 << rng.randrange(8))]))
+        self._record("corrupt", f"{site}:bitflip@{i}/{size}")
+
+    # -- worker / channel kills ----------------------------------------------
+    def plan_worker_kills(self, worker_ids: Sequence[int]
+                          ) -> List[Tuple[int, int]]:
+        """Distributed runs: ``[(input_seq_threshold, worker_id), ...]`` —
+        SIGKILL plan over locally-spawned workers, always leaving at least
+        one survivor.  Sorted by threshold."""
+        cfg = self.config
+        if cfg is None or cfg.kill <= 0 or len(worker_ids) < 2:
+            return []
+        rng = self._rng("kill")
+        n = min(cfg.kill, len(worker_ids) - 1)
+        victims = rng.sample(list(worker_ids), n)
+        plan = sorted(
+            (cfg.kill_after + rng.randrange(0, 25), w) for w in victims
+        )
+        self._record("kill", f"plan={plan}")
+        return plan
+
+    def plan_embedded_failures(self, exec_channels: Sequence[Tuple[int, int]]
+                               ) -> List[Tuple[int, List[Tuple[int, int]]]]:
+        """Embedded engine: ``[(after_tasks, [(actor, ch), ...]), ...]`` —
+        at each task-count boundary, lose those exec channels (state, queued
+        tasks, cached inputs) and run the recovery protocol."""
+        cfg = self.config
+        if cfg is None or cfg.kill <= 0 or not exec_channels:
+            return []
+        rng = self._rng("kill")
+        plan = []
+        after = cfg.kill_after
+        for _ in range(cfg.kill):
+            after += rng.randrange(0, 20)
+            k = min(len(exec_channels), 1 + int(rng.random() < 0.3))
+            plan.append((after, sorted(rng.sample(list(exec_channels), k))))
+            after += 5  # recovery gets a few tasks of headroom between kills
+        self._record("kill", f"embedded plan={plan}")
+        return plan
+
+    def record_kill(self, label: str) -> None:
+        self._record("kill", label)
+
+
+CHAOS = ChaosPlane()
+
+
+def publish_env(spec: Optional[str]) -> None:
+    """Publish (or clear) the chaos spec in this process's environment so
+    mp-spawned worker children inherit the same seeded plan, and configure
+    the local plane to match.  The soak driver is the only caller."""
+    if spec:
+        os.environ["QK_CHAOS"] = spec
+        CHAOS.configure(spec)
+    else:
+        os.environ.pop("QK_CHAOS", None)
+        CHAOS.disable()

@@ -1,0 +1,312 @@
+"""chaos-smoke: seeded mixed-fault soak with end-to-end integrity checks.
+
+    python -m quokka_tpu.chaos.soak [--runs 20] [--seed BASE] [--only I]
+
+Each run picks a fault mode (cycled deterministically), composes a QK_CHAOS
+spec from its seed, executes a fixed workload under injection, and asserts
+the result is BIT-EXACT against an undisturbed baseline computed once with
+chaos off.  Workload values are integer-valued float64s, so sums are exact
+under any execution order — "bit-exact" is a real claim, not a tolerance.
+
+Fault modes (cycled; ``--runs 20`` covers every mode at least twice):
+
+  mixed        embedded engine; corrupt=0.3 on every artifact write plus a
+               seeded chaos kill of random exec channels
+  spill-storm  EVERY spill write corrupted (corrupt_spill=1.0), no
+               checkpoints, scripted kill of the consuming channels — full
+               tape replay must detect every corruption (checksum), then
+               recover via input-lineage re-read + live-producer rewind
+  ckpt-storm   EVERY checkpoint write corrupted (corrupt_ckpt=1.0) + kill —
+               restore must detect, quarantine, and rewind to an older
+               checkpoint (ultimately state 0)
+  service      two concurrent queries on one QueryService under
+               corrupt_ckpt + per-query scripted kills — both bit-exact,
+               neighbors unaffected
+  distributed  2 spawned workers; RPC drops/delays + flaky store calls +
+               a chaos SIGKILL of a random worker at an input boundary
+
+Every injected fault and every recovery action is a flight-recorder event
+(``chaos.*``, ``integrity.corrupt``, ``recover.*``, ``rpc.retry``,
+``store.retry``); per-run deltas of the corresponding counters are printed.
+A failing run prints its QK_CHAOS spec and an exact replay command, then
+the soak exits nonzero.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import tempfile
+import time
+from contextlib import contextmanager
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+
+from quokka_tpu.chaos import publish_env
+
+_COUNTERS = ("integrity.corrupt", "chaos.corrupt", "chaos.rpc",
+             "chaos.delay", "chaos.store", "chaos.kill", "rpc.reconnect",
+             "rpc.dedup_hit", "store.retry", "recover.ckpt_fallback",
+             "recover.producer_rewind")
+
+
+def _snap():
+    from quokka_tpu import obs
+
+    return {n: obs.REGISTRY.counter(n).value for n in _COUNTERS}
+
+
+def _delta(before):
+    now = _snap()
+    return {n: now[n] - before[n] for n in _COUNTERS if now[n] != before[n]}
+
+
+@contextmanager
+def _chaos(spec):
+    publish_env(spec)
+    try:
+        yield
+    finally:
+        publish_env(None)
+
+
+# -- workloads (integer-valued floats: order-independent exact sums) --------
+
+
+def _tables():
+    r = np.random.default_rng(20260804)
+    n = 20_000
+    agg = pa.table({
+        "k": r.integers(0, 50, n).astype(np.int64),
+        "v": r.integers(0, 100, n).astype(np.float64),
+    })
+    left = pa.table({
+        "key": r.integers(0, 200, 8000).astype(np.int64),
+        "x": r.integers(0, 50, 8000).astype(np.float64),
+    })
+    right = pa.table({
+        "key": np.arange(0, 150, dtype=np.int64),
+        "y": r.integers(0, 50, 150).astype(np.float64),
+    })
+    return agg, left, right
+
+
+def _ctx(opt=True, **cfg):
+    # the scripted inject_failure channel ids assume the same plan shapes
+    # the fault-tolerance tests pin: default optimizer for the agg query
+    # (actor 1 = partial agg), optimize=False for the join (actor 2 = join)
+    from quokka_tpu import QuokkaContext
+
+    ctx = QuokkaContext(optimize=opt)
+    for k, v in cfg.items():
+        ctx.set_config(k, v)
+    return ctx
+
+
+def _q_agg(ctx, table):
+    from quokka_tpu.dataset.readers import InputArrowDataset
+
+    s = ctx.read_dataset(InputArrowDataset(table, batch_rows=1024))
+    return (s.groupby("k").agg_sql("sum(v) as sv, count(*) as n")
+            .collect().sort_values("k").reset_index(drop=True))
+
+
+def _q_join(ctx, left, right):
+    from quokka_tpu.dataset.readers import InputArrowDataset
+
+    ls = ctx.read_dataset(InputArrowDataset(left, batch_rows=512))
+    rs = ctx.read_dataset(InputArrowDataset(right, batch_rows=64))
+    return (ls.join(rs, on="key").groupby("key")
+            .agg_sql("sum(x * y) as t, count(*) as n")
+            .collect().sort_values("key").reset_index(drop=True))
+
+
+def _exact(got, want, what):
+    pd.testing.assert_frame_equal(got, want, check_exact=True,
+                                  check_dtype=False, obj=what)
+
+
+# -- fault modes -------------------------------------------------------------
+# each mode: (name, expect_detection, fn(seed, tables, baselines) -> None)
+
+
+def _spec_mixed(seed):
+    return f"seed={seed},corrupt=0.3,kill=1,kill_after={8 + seed % 12}"
+
+
+def _mode_mixed(seed, spec, tabs, base):
+    with _chaos(spec), tempfile.TemporaryDirectory() as d:
+        ctx = _ctx(fault_tolerance=True, hbq_path=d,
+                   checkpoint_interval=(None, 3)[seed % 2])
+        _exact(_q_agg(ctx, tabs[0]), base[0], "mixed agg")
+
+
+def _spec_storm(seed):
+    return f"seed={seed},corrupt_spill=1.0"
+
+
+def _mode_spill_storm(seed, spec, tabs, base):
+    # every spill corrupt + the partial agg loses both channels with no
+    # checkpoint: the full-tape replay reads (and must reject) every spill
+    with _chaos(spec), tempfile.TemporaryDirectory() as d:
+        ctx = _ctx(fault_tolerance=True, hbq_path=d, checkpoint_interval=None,
+                   inject_failure={"after_tasks": 15 + seed % 8,
+                                   "channels": [(1, 0), (1, 1)]})
+        _exact(_q_agg(ctx, tabs[0]), base[0], "spill-storm agg")
+
+
+def _mode_spill_storm_join(seed, spec, tabs, base):
+    with _chaos(spec), tempfile.TemporaryDirectory() as d:
+        ctx = _ctx(opt=False, fault_tolerance=True, hbq_path=d,
+                   checkpoint_interval=None,
+                   inject_failure={"after_tasks": 14 + seed % 6,
+                                   "channels": [(2, 0)]})
+        _exact(_q_join(ctx, tabs[1], tabs[2]), base[1], "spill-storm join")
+
+
+def _spec_ckpt_storm(seed):
+    return f"seed={seed},corrupt_ckpt=1.0"
+
+
+def _mode_ckpt_storm(seed, spec, tabs, base):
+    with _chaos(spec), tempfile.TemporaryDirectory() as d:
+        ctx = _ctx(fault_tolerance=True, hbq_path=d, checkpoint_interval=3,
+                   inject_failure={"after_tasks": 10 + seed % 8,
+                                   "channels": [(1, seed % 2)]})
+        _exact(_q_agg(ctx, tabs[0]), base[0], "ckpt-storm agg")
+
+
+def _spec_service(seed):
+    return f"seed={seed},corrupt_ckpt=0.5"
+
+
+def _mode_service(seed, spec, tabs, base):
+    from quokka_tpu.service import QueryService
+
+    with _chaos(spec), tempfile.TemporaryDirectory() as d:
+        svc = QueryService(pool_size=2, spill_dir=d,
+                           exec_config={"fault_tolerance": True,
+                                        "checkpoint_interval": 3})
+        try:
+            ctx1 = _ctx(fault_tolerance=True, checkpoint_interval=3,
+                        inject_failure={"after_tasks": 10 + seed % 5,
+                                        "channels": [(1, 0)]})
+            ctx2 = _ctx(opt=False, fault_tolerance=True,
+                        checkpoint_interval=3)
+            from quokka_tpu.dataset.readers import InputArrowDataset
+
+            s1 = (ctx1.read_dataset(InputArrowDataset(tabs[0],
+                                                      batch_rows=1024))
+                  .groupby("k").agg_sql("sum(v) as sv, count(*) as n"))
+            ls = ctx2.read_dataset(InputArrowDataset(tabs[1], batch_rows=512))
+            rs = ctx2.read_dataset(InputArrowDataset(tabs[2], batch_rows=64))
+            s2 = (ls.join(rs, on="key").groupby("key")
+                  .agg_sql("sum(x * y) as t, count(*) as n"))
+            h1, h2 = svc.submit(s1), svc.submit(s2)
+            got1 = h1.to_df().sort_values("k").reset_index(drop=True)
+            got2 = h2.to_df().sort_values("key").reset_index(drop=True)
+            _exact(got1, base[0], "service agg")
+            _exact(got2, base[1], "service join")
+        finally:
+            svc.shutdown()
+
+
+def _spec_distributed(seed):
+    return (f"seed={seed},rpc=0.03,delay=0.05,store=0.05,"
+            f"kill=1,kill_after={6 + seed % 6}")
+
+
+def _mode_distributed(seed, spec, tabs, base):
+    from quokka_tpu.utils.cluster import LocalCluster
+
+    with _chaos(spec):
+        from quokka_tpu import QuokkaContext
+
+        ctx = QuokkaContext(
+            cluster=LocalCluster(n_workers=2),
+            exec_config={"fault_tolerance": True, "checkpoint_interval": 2},
+        )
+        _exact(_q_agg(ctx, tabs[0]), base[0], "distributed agg")
+
+
+# name, spec_fn (pure: the replay line must exist BEFORE the run can
+# fail), run_fn, expect_corruption_detections
+MODES = [
+    ("mixed", _spec_mixed, _mode_mixed, False),
+    ("spill-storm", _spec_storm, _mode_spill_storm, True),
+    ("ckpt-storm", _spec_ckpt_storm, _mode_ckpt_storm, True),
+    ("service", _spec_service, _mode_service, False),
+    ("mixed", _spec_mixed, _mode_mixed, False),
+    ("spill-storm-join", _spec_storm, _mode_spill_storm_join, True),
+    ("ckpt-storm", _spec_ckpt_storm, _mode_ckpt_storm, True),
+    ("mixed", _spec_mixed, _mode_mixed, False),
+    ("distributed", _spec_distributed, _mode_distributed, False),
+    ("spill-storm", _spec_storm, _mode_spill_storm, True),
+]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--runs", type=int, default=20)
+    ap.add_argument("--seed", type=int, default=20260804,
+                    help="base seed; run i uses seed base+i")
+    ap.add_argument("--only", type=int, default=None,
+                    help="replay a single run index (failure triage)")
+    args = ap.parse_args(argv)
+
+    publish_env(None)  # baselines run undisturbed
+    tabs = _tables()
+    t0 = time.time()
+    base = (_q_agg(_ctx(), tabs[0]), _q_join(_ctx(), tabs[1], tabs[2]))
+    print(f"[chaos-smoke] baselines in {time.time() - t0:.1f}s; "
+          f"{args.runs} seeded runs, base seed {args.seed}", flush=True)
+
+    indices = [args.only] if args.only is not None else range(args.runs)
+    failures = 0
+    total_detected = 0
+    for i in indices:
+        name, spec_fn, fn, expect_detect = MODES[i % len(MODES)]
+        seed = args.seed + i
+        before = _snap()
+        t0 = time.time()
+        spec = spec_fn(seed)
+        try:
+            fn(seed, spec, tabs, base)
+            d = _delta(before)
+            detected = d.get("integrity.corrupt", 0)
+            total_detected += detected
+            if expect_detect and detected == 0:
+                raise AssertionError(
+                    "corruption was injected on every artifact write but "
+                    "ZERO corruptions were detected on read — the "
+                    "integrity check is not being exercised")
+            print(f"[chaos-smoke] run {i:>2} {name:<16} seed={seed} "
+                  f"ok in {time.time() - t0:5.1f}s  {d}", flush=True)
+        except Exception as e:  # noqa: BLE001 — report, count, continue
+            failures += 1
+            print(f"[chaos-smoke] run {i:>2} {name:<16} seed={seed} "
+                  f"FAILED in {time.time() - t0:5.1f}s: {e!r}", flush=True)
+            # the replay command re-derives this exact spec from the seed
+            # (no env prefix: the soak sets QK_CHAOS itself per run)
+            print(f"[chaos-smoke]   spec was QK_CHAOS=\"{spec}\"; replay: "
+                  f"python -m quokka_tpu.chaos.soak --only {i} "
+                  f"--seed {args.seed}", flush=True)
+        finally:
+            publish_env(None)
+    if args.only is None and total_detected == 0:
+        print("[chaos-smoke] FAIL: no corruption was ever detected across "
+              "the soak — integrity checks are dead", flush=True)
+        return 1
+    if failures:
+        print(f"[chaos-smoke] {failures}/{len(list(indices))} runs FAILED",
+              flush=True)
+        return 1
+    print(f"[chaos-smoke] all runs bit-exact; "
+          f"{total_detected} corruptions detected and recovered", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

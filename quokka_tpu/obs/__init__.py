@@ -27,6 +27,11 @@ Env vars (the full table is in README "Observability"):
   ``<tmp>/quokka_tpu_dumps``).
 - ``QUOKKA_TRACE=1``: print the span summary at bench end (unchanged).
 - ``QK_COORD_TIMEOUT``: coordinator run timeout seconds (default 600).
+- ``QK_CHAOS``: seeded fault-injection spec (quokka_tpu/chaos).  Every
+  injected fault lands here as a ``chaos.*`` event, every checksum
+  rejection as ``integrity.corrupt``, and every recovery escalation as a
+  ``recover.*`` event — a chaos soak is triaged from the same merged
+  timeline as a production stall.
 """
 
 from __future__ import annotations

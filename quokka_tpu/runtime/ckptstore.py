@@ -4,14 +4,30 @@ The reference writes checkpoints to an S3 bucket (pyquokka/core.py:678-685)
 precisely because a node's local disk dies with the node; only the HBQ spill
 is node-local (hbq.py).  Same discipline here: checkpoints go to a root that
 all workers can reach — a shared directory, or any fsspec URL (s3://, gs://)
-via exec_config["checkpoint_store"].  Writes are atomic (tmp + rename) on
-local paths so a reader never sees a torn snapshot.
+via exec_config["checkpoint_store"].
+
+Durability discipline (the chaos plane hardened this):
+
+- **atomic everywhere**: local saves are tmp + rename (as before); REMOTE
+  saves now write a tmp key then move it into place (copy+delete when the
+  backend has no rename), so a writer that dies mid-upload leaves a stale
+  tmp key — never a partial object under the final name that ``load``
+  would happily return.
+- **checksum-framed** (runtime/integrity.py): every snapshot is verified
+  on read AND re-read after a remote upload (length + checksum).  A frame
+  mismatch on load raises ``CorruptArtifactError`` after quarantining the
+  object; the engine treats that as LOSS and rewinds to an older
+  checkpoint (engine.handle_exectape_task) instead of trusting the bytes.
 """
 
 from __future__ import annotations
 
 import os
+import secrets
 from typing import Optional
+
+from quokka_tpu.runtime import integrity
+from quokka_tpu.runtime.errors import CorruptArtifactError
 
 
 class CheckpointStore:
@@ -26,23 +42,30 @@ class CheckpointStore:
         if not self._remote:
             os.makedirs(root, exist_ok=True)
 
+    def _fs(self):
+        """(filesystem, base path) for a remote root — resolved per call:
+        fsspec filesystems cache connections internally, and a store object
+        crosses process boundaries via pickle in worker specs."""
+        import fsspec
+
+        fs, _, paths = fsspec.get_fs_token_paths(self.root)
+        return fs, paths[0].rstrip("/")
+
     def _path(self, actor: int, ch: int, state_seq: int) -> str:
         ns = f"{self.namespace}-" if self.namespace is not None else ""
         return f"{self.root}/ckpt-{ns}{actor}-{ch}-{state_seq}.pkl"
 
     def wipe_namespace(self) -> None:
         """Drop every snapshot in this namespace (query teardown) — local
-        dirs and fsspec roots alike; best-effort (GC, not correctness)."""
+        dirs and fsspec roots alike; best-effort (GC, not correctness).
+        Stale tmp keys from crashed writers go with it."""
         if self.namespace is None:
             return
         prefix = f"ckpt-{self.namespace}-"
         if self._remote:
             try:
-                import fsspec
-
-                fs, _, paths = fsspec.get_fs_token_paths(self.root)
-                base = paths[0].rstrip("/")
-                for p in fs.glob(f"{base}/{prefix}*.pkl"):
+                fs, base = self._fs()
+                for p in fs.glob(f"{base}/{prefix}*.pkl*"):
                     fs.rm(p)
             except Exception as e:  # noqa: BLE001 — GC must not fail a query
                 from quokka_tpu import obs
@@ -55,7 +78,7 @@ class CheckpointStore:
         except OSError:
             return
         for f in names:
-            if f.startswith(prefix) and f.endswith(".pkl"):
+            if f.startswith(prefix):
                 try:
                     os.remove(os.path.join(self.root, f))
                 except OSError:
@@ -63,27 +86,86 @@ class CheckpointStore:
 
     def save(self, actor: int, ch: int, state_seq: int, data: bytes) -> None:
         p = self._path(actor, ch, state_seq)
-        if self._remote:
-            import fsspec
-
-            with fsspec.open(p, "wb") as f:
-                f.write(data)
+        if not self._remote:
+            integrity.write_framed_atomic(p, data, site="ckpt")
             return
-        with open(p + ".tmp", "wb") as f:
-            f.write(data)
-        os.replace(p + ".tmp", p)
+        framed = integrity.maybe_corrupt(integrity.frame(data), "ckpt")
+        # remote: never write the final key directly — a crash mid-write
+        # would leave a partial object that load() trusts.  Write a unique
+        # tmp key, move it into place, then verify what actually landed.
+        fs, base = self._fs()
+        rel = p[len(self.root) + 1:]
+        tmp = f"{base}/{rel}.tmp-{secrets.token_hex(4)}"
+        final = f"{base}/{rel}"
+        try:
+            with fs.open(tmp, "wb") as f:
+                f.write(framed)
+            try:
+                fs.mv(tmp, final)
+            except (NotImplementedError, OSError):
+                fs.copy(tmp, final)
+                fs.rm(tmp)
+        except BaseException:
+            try:
+                if fs.exists(tmp):
+                    fs.rm(tmp)
+            except OSError as e:
+                from quokka_tpu import obs
+
+                obs.diag(f"[ckptstore] tmp-key cleanup of {tmp} failed: {e!r}")
+            raise
+        # read-after-write verification against the bytes we UPLOADED
+        # (object stores can and do surface torn/duplicated uploads).
+        # Deliberately NOT unframe(): chaos-injected corruption simulates
+        # at-rest damage that a real read-after-write would not see — it
+        # must surface at LOAD time as quarantine-and-rewind, not crash the
+        # checkpointing query here
+        landed = fs.cat_file(final)
+        if landed != framed:
+            fs.rm(final)
+            raise CorruptArtifactError(
+                final, f"read-after-write mismatch (uploaded {len(framed)}B,"
+                       f" landed {len(landed)}B) — torn upload removed")
 
     def load(self, actor: int, ch: int, state_seq: int) -> Optional[bytes]:
+        """Verified snapshot bytes, None when absent.  Raises
+        ``CorruptArtifactError`` (after quarantining the object) when the
+        snapshot exists but fails its integrity check — the caller must
+        treat that as loss, never as data."""
         p = self._path(actor, ch, state_seq)
         if self._remote:
-            import fsspec
-
-            fs, _, paths = fsspec.get_fs_token_paths(p)
-            if not fs.exists(paths[0]):
+            fs, base = self._fs()
+            final = f"{base}/{p[len(self.root) + 1:]}"
+            if not fs.exists(final):
                 return None
-            with fsspec.open(p, "rb") as f:
-                return f.read()
+            data = fs.cat_file(final)
+            try:
+                return integrity.unframe(data, source=final)
+            except CorruptArtifactError as e:
+                self._quarantine_remote(fs, final, e)
+                raise
         if not os.path.exists(p):
             return None
-        with open(p, "rb") as f:
-            return f.read()
+        try:
+            return integrity.read_framed(p)
+        except CorruptArtifactError as e:
+            integrity.quarantine(p, e)
+            raise
+        except OSError:
+            return None  # raced a wipe: same as absent
+
+    def _quarantine_remote(self, fs, path: str, err: BaseException) -> None:
+        from quokka_tpu import obs
+
+        obs.REGISTRY.counter("integrity.corrupt").inc()
+        obs.RECORDER.record("integrity.corrupt", path.rsplit("/", 1)[-1],
+                            reason=str(err)[:200])
+        obs.diag(f"[ckptstore] quarantining corrupt checkpoint {path}: {err}")
+        try:
+            fs.mv(path, path + ".corrupt")
+        except Exception:  # noqa: BLE001 — quarantine is best-effort
+            try:
+                fs.rm(path)
+            except Exception:  # noqa: BLE001
+                obs.diag(f"[ckptstore] could not quarantine or remove "
+                         f"{path}; recovery proceeds treating it as lost")

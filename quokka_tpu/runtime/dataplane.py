@@ -103,4 +103,8 @@ class DataPlaneClient:
 
 def serve_cache(cache: BatchCache, host: str = "127.0.0.1",
                 hbq=None) -> RpcServer:
-    return RpcServer(CacheService(cache, hbq=hbq), host=host)
+    # hbq_get_ipc responses are whole serialized tables: declare them
+    # re-executable so a retried request re-reads the (idempotent) spill
+    # instead of pinning megabytes in the server's dedup cache
+    return RpcServer(CacheService(cache, hbq=hbq), host=host,
+                     reexecutable=frozenset({"hbq_get_ipc"}))

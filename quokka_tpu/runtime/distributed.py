@@ -282,7 +282,16 @@ def _all_done(graph, cs) -> bool:
 
 def _coordinate(graph, cs, procs, owned, timeout, kill_after_inputs,
                 heartbeat_timeout, external_ids=()) -> None:
+    from quokka_tpu.chaos import CHAOS
+
     all_ids = list(procs) + list(external_ids)
+    # chaos plane (QK_CHAOS kill=N): SIGKILL seeded-random LOCAL workers at
+    # seeded-random input boundaries — requires fault tolerance, and the
+    # plan always leaves at least one survivor to adopt the channels
+    chaos_kills = (
+        list(CHAOS.plan_worker_kills(list(procs)))
+        if CHAOS.enabled and graph.hbq is not None else []
+    )
     stages = sorted({a.stage for a in graph.actors.values()})
     stage_idx = 0
     cs.set("STAGE", stages[0])
@@ -322,14 +331,21 @@ def _coordinate(graph, cs, procs, owned, timeout, kill_after_inputs,
         if changed:
             cs.set("worker_addrs", addrs)
         # fault injection: SIGKILL a worker once enough input seqs exist
-        if kill_after_inputs is not None:
-            wid, n = kill_after_inputs
+        if kill_after_inputs is not None or chaos_kills:
             total_inputs = sum(
                 len(v) for k, v in cs.tables["GIT"].items()
             )
-            if total_inputs >= n and procs[wid].is_alive():
+            if kill_after_inputs is not None:
+                wid, n = kill_after_inputs
+                if total_inputs >= n and procs[wid].is_alive():
+                    os.kill(procs[wid].pid, signal.SIGKILL)
+                    kill_after_inputs = None
+            while chaos_kills and total_inputs >= chaos_kills[0][0]:
+                _, wid = chaos_kills.pop(0)
+                if wid in dead or not procs[wid].is_alive():
+                    continue
+                CHAOS.record_kill(f"SIGKILL worker {wid}")
                 os.kill(procs[wid].pid, signal.SIGKILL)
-                kill_after_inputs = None
         # failure detection: dead process or stale heartbeat.  External
         # (multi-host) workers have no local PID: heartbeat staleness only.
         # ONE sweep collects every death before any recovery runs, so rewind

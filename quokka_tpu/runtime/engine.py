@@ -36,6 +36,7 @@ from quokka_tpu.ops.batch import DeviceBatch
 from quokka_tpu.ops.expr_compile import evaluate_predicate
 from quokka_tpu.runtime.cache import BatchCache
 from quokka_tpu.runtime.dataset import ResultDataset
+from quokka_tpu.runtime.errors import CorruptArtifactError
 from quokka_tpu.runtime.tables import ControlStore
 from quokka_tpu.runtime.task import (
     ExecutorTask,
@@ -348,6 +349,18 @@ class TaskGraph:
         self._saved_metrics = self._store_metrics()
 
 
+def ckpt_candidates(store, a: int, ch: int) -> List[Tuple[int, int, int]]:
+    """A channel's recovery-point history: the recorded checkpoint triples
+    ``(state_seq, out_seq, tape_pos)`` plus the always-available ``(0,0,0)``
+    (state 0 + full tape replay needs no snapshot).  The single source for
+    every covering-checkpoint selection (plan_rewinds, corrupt-checkpoint
+    fallback, forced producer rewind) — the covering rule is correctness-
+    critical and must not fork."""
+    return [(0, 0, 0)] + [
+        tuple(h) for h in (store.tget("LT", ("ckpts", a, ch)) or [])
+    ]
+
+
 def plan_rewinds(store, dead_exec: List[Tuple[int, int]]) -> Dict[Tuple[int, int], Tuple[int, int, int]]:
     """Need-driven checkpoint selection for a set of simultaneously lost exec
     channels (the reference's rewind requests, coordinator.py:221-229,274-334).
@@ -377,9 +390,7 @@ def plan_rewinds(store, dead_exec: List[Tuple[int, int]]) -> Dict[Tuple[int, int
                     seq = name[2]
                     if choice[key][1] <= seq:
                         continue  # producer's replay regenerates it
-                    hist = [(0, 0, 0)] + list(
-                        store.tget("LT", ("ckpts",) + key) or []
-                    )
+                    hist = ckpt_candidates(store, *key)
                     best = max(
                         (h for h in hist if h[1] <= seq), key=lambda h: h[0]
                     )
@@ -830,9 +841,23 @@ class Engine:
             # replay; recording an LCT here would silently drop state
             return
         state = executor.checkpoint()
-        self._ckpt_store().save(
-            task.actor, task.channel, task.state_seq, pickle.dumps(state)
-        )
+        try:
+            self._ckpt_store().save(
+                task.actor, task.channel, task.state_seq, pickle.dumps(state)
+            )
+        except (CorruptArtifactError, OSError) as e:
+            # a failed snapshot is a SKIPPED snapshot, never a dead query:
+            # checkpointing only shortens recovery (older checkpoints and
+            # the full tape remain valid recovery points), so a flaky
+            # store/torn upload must not kill a healthy run.  LCT is not
+            # recorded — recovery never points at the failed save.
+            obs.REGISTRY.counter("recover.ckpt_save_skipped").inc()
+            obs.RECORDER.record("recover.ckpt_save_skipped",
+                                f"a{task.actor}c{task.channel}",
+                                state=task.state_seq, error=repr(e)[:160])
+            obs.diag(f"[ckpt] snapshot ({task.actor},{task.channel}) state "
+                     f"{task.state_seq} skipped: {e!r}")
+            return
         tape_len = self.store.tape_len(task.actor, task.channel)
         with self.store.transaction():
             self.store.tset(
@@ -1008,6 +1033,12 @@ class Engine:
         tape = self.store.tape_slice(a, ch, task.tape_pos)
 
         def _requeue_waiting(name):
+            # a vanished input whose producer is ALIVE will never reappear
+            # on its own (e.g. its only spill copy was quarantined as
+            # corrupt): force the producer to rewind far enough to re-emit
+            # it (no-op outside the embedded single-threaded loop).  A
+            # rewind queued now counts as progress — recovery work exists.
+            rewound = self._maybe_force_producer_rewind(name)
             # time-based, not attempt-based: the co-dead producer's own
             # replay (possibly from state 0 with a long tape) can
             # legitimately take minutes to regenerate this object
@@ -1029,7 +1060,7 @@ class Engine:
                 )
             self.store.ntt_push(a, task)
             time.sleep(0.05)
-            return False
+            return rewound
 
         probed = set()
         for ev in tape:
@@ -1042,7 +1073,17 @@ class Engine:
                     return _requeue_waiting(name)
                 probed.add(name)
         self.execs[(a, ch)] = self.g.actors[a].executor_factory()
-        blob = self._ckpt_store().load(a, ch, task.state_seq)
+        try:
+            blob = self._ckpt_store().load(a, ch, task.state_seq)
+        except CorruptArtifactError:
+            # corrupt checkpoint == LOST checkpoint (the store already
+            # quarantined it): rewind this channel to an older checkpoint —
+            # ultimately (0,0,0) + full tape replay — instead of crashing
+            # or restoring from untrusted bytes.  True: the queued fallback
+            # IS progress (the embedded loop's no-progress stall check
+            # would otherwise fire when this was the only pending task)
+            self._ckpt_fallback(task)
+            return True
         if blob is not None:
             self.execs[(a, ch)].restore(pickle.loads(blob))
         elif task.state_seq > 0:
@@ -1099,6 +1140,82 @@ class Engine:
         self.store.ntt_push(a, ExecutorTask(a, ch, state_seq, out_seq, reqs))
         return True
 
+    def _ckpt_fallback(self, task: TapedExecutorTask) -> None:
+        """Requeue a tape replay whose checkpoint failed its integrity
+        check, rebuilt at the deepest available OLDER checkpoint (the
+        ``ckpts`` history recorded at checkpoint time; (0,0,0) is always
+        available — state 0 + full tape replay needs no snapshot).  The
+        target ``last_state_seq`` is unchanged, so the replay still proves
+        it reached exactly the state the channel died at."""
+        a, ch = task.actor, task.channel
+        hist = ckpt_candidates(self.store, a, ch)
+        choice = max((h for h in hist if h[0] < task.state_seq),
+                     key=lambda h: h[0])
+        obs.REGISTRY.counter("recover.ckpt_fallback").inc()
+        obs.RECORDER.record("recover.ckpt_fallback", f"a{a}c{ch}",
+                            bad_state=task.state_seq, to=repr(choice))
+        state_seq, out_seq, tape_pos = choice
+        reqs = {
+            s: dict(c)
+            for s, c in self.store.tget("IRT", (a, ch, state_seq)).items()
+        }
+        self.store.ntt_push(
+            a,
+            TapedExecutorTask(a, ch, state_seq, out_seq,
+                              task.last_state_seq, reqs, tape_pos),
+        )
+
+    # Escalation for an unrecoverable-by-waiting tape/replay input: the
+    # object is in no cache and no HBQ (e.g. its spill was quarantined as
+    # corrupt), and its producer is a LIVE exec channel — nothing in the
+    # basic chain will ever regenerate it, so the producer itself must
+    # rewind to a checkpoint old enough to re-emit it (corruption is
+    # treated as loss OF THE PRODUCER'S OUTPUT, the same judgment
+    # plan_rewinds makes for co-dead producers).  Embedded-engine only:
+    # its dispatch loop is single-threaded, so rewinding a live channel
+    # cannot race an in-flight dispatch of that channel.  The distributed
+    # worker and the multi-threaded query service keep the wait-with-
+    # deadline behavior (loud failure, never silent corruption).
+    _allow_forced_rewind = True
+
+    def _maybe_force_producer_rewind(self, name) -> bool:
+        """Returns True when a rewind was queued NOW — that is real
+        scheduling progress (new recovery work exists), which keeps the
+        embedded loop's no-progress stall check honest while the waiting
+        consumer requeues itself."""
+        if not self._allow_forced_rewind or getattr(self, "_svc_ready", False):
+            return False
+        src_a, src_ch, seq = name[0], name[1], name[2]
+        info = self.g.actors.get(src_a)
+        if info is None or info.kind != "exec":
+            return False
+        forced = getattr(self, "_forced_rewinds", None)
+        if forced is None:
+            forced = self._forced_rewinds = set()
+        key = (src_a, src_ch, seq)
+        if key in forced:
+            return False
+        forced.add(key)
+        # a LATER rewind of the same channel replaces any queued earlier one
+        # (_recover_channel drops the channel's queued tasks), so every
+        # rewind must cover the MINIMUM seq ever lost from this channel —
+        # rewinding only far enough for the newest loss would cancel the
+        # pending replay that was going to regenerate an older one
+        floors = getattr(self, "_rewind_floor", None)
+        if floors is None:
+            floors = self._rewind_floor = {}
+        floor = min(seq, floors.get((src_a, src_ch), seq))
+        floors[(src_a, src_ch)] = floor
+        hist = ckpt_candidates(self.store, src_a, src_ch)
+        # the checkpoint must PREDATE the lost output seq or the replay
+        # never re-emits it (same covering rule as plan_rewinds)
+        choice = max((h for h in hist if h[1] <= floor), key=lambda h: h[0])
+        obs.REGISTRY.counter("recover.producer_rewind").inc()
+        obs.RECORDER.record("recover.producer_rewind", f"a{src_a}c{src_ch}",
+                            for_seq=seq, to=repr(choice))
+        self._recover_channel(src_a, src_ch, choice=choice)
+        return True
+
     def dispatch_task(self, task) -> bool:
         """Route a popped NTT task to its handler by task kind, recording
         the dispatch in the flight recorder: completed dispatches as
@@ -1142,12 +1259,45 @@ class Engine:
         """Re-push spilled post-partition objects to the (rebuilt) consumer's
         cache — the reference's ReplayTask (pyquokka/core.py:967-1025), the
         objects coming off this worker's own HBQ or a live peer's (or an
-        input re-read when no copy survives)."""
+        input re-read when no copy survives).
+
+        Unresolvable names (every surviving copy corrupt/quarantined, the
+        producer's regeneration not landed yet) requeue with the remaining
+        specs instead of being silently dropped — a dropped spec would
+        starve the rebuilt consumer forever.  A live exec producer of such
+        a name is force-rewound (embedded engine) so regeneration actually
+        happens; after the deadline the loss is surfaced loudly."""
+        missing = []
+        resolved = 0
         for name in task.replay_specs:
             b = self._resolve_lost_object(name)
             if b is not None:
                 self._cache_put(name, b)
-        return True
+                resolved += 1
+            else:
+                missing.append(name)
+        if not missing:
+            return True
+        rewound = False
+        for name in missing:
+            rewound |= self._maybe_force_producer_rewind(name)
+        deadline = getattr(task, "retry_deadline", None)
+        if deadline is None:
+            deadline = task.retry_deadline = time.time() + 600.0
+        if time.time() > deadline:
+            raise RuntimeError(
+                f"replay objects {missing[:3]}{'...' if len(missing) > 3 else ''} "
+                f"for channel ({task.actor},{task.channel}) survive in no "
+                "cache or HBQ and were never regenerated within 600s — "
+                "irrecoverable loss"
+            )
+        task.replay_specs = missing
+        self.store.ntt_push(task.actor, task)
+        time.sleep(0.05)
+        # resolved objects ARE progress (they may unblock the consumer this
+        # pass); so is a freshly queued producer rewind — only a fully
+        # fruitless pass reads as no-progress to the stall check
+        return rewound or resolved > 0
 
     def _replay_tape(self, actor: int, ch: int, events, reqs,
                      state_seq: int, out_seq: int):
@@ -1328,6 +1478,16 @@ class Engine:
         t0 = time.time()
         inject = self.g.exec_config.get("inject_failure")
         handled = 0
+        # chaos plane (QK_CHAOS kill=N): lose seeded-random exec channels at
+        # seeded-random task boundaries, on top of any scripted injection
+        from quokka_tpu.chaos import CHAOS
+
+        chaos_kills = []
+        if CHAOS.enabled and self.g.hbq is not None:
+            exec_channels = sorted(
+                (a.id, ch) for a in actors if a.kind == "exec"
+                for ch in range(a.channels))
+            chaos_kills = list(CHAOS.plan_embedded_failures(exec_channels))
         while True:
             if time.time() - t0 > timeout:
                 _, report, _ = obs.dump_flight(
@@ -1353,6 +1513,11 @@ class Engine:
                     if inject is not None and handled >= inject["after_tasks"]:
                         self.simulate_failure_and_recover(inject["channels"])
                         inject = None
+                        progress = True
+                    while chaos_kills and handled >= chaos_kills[0][0]:
+                        _, chans = chaos_kills.pop(0)
+                        CHAOS.record_kill(f"embedded {chans}")
+                        self.simulate_failure_and_recover(chans)
                         progress = True
             if self._all_done(actors):
                 return

@@ -1,0 +1,83 @@
+"""Runtime error taxonomy: transient vs fatal, and corruption-as-loss.
+
+The recovery protocol (engine.py, distributed.py) and the chaos plane
+(quokka_tpu/chaos) both need callers to tell three failure classes apart:
+
+- **transient** (``TransientError`` mixin): the operation may succeed if
+  simply retried — a dropped TCP connection, a flaky store call.  Retry
+  with bounded exponential backoff (``retry_with_backoff``); the request
+  either never left this process or is idempotent at the receiver
+  (runtime/rpc.py dedups retried request ids server-side).
+- **fatal**: retrying cannot help — an auth/protocol failure
+  (``RpcAuthError``), a programming error.  Surface immediately.
+- **corrupt artifact** (``CorruptArtifactError``): bytes came back but
+  failed their integrity check (runtime/integrity.py).  NEVER retried in
+  place and NEVER trusted: the artifact is quarantined and the loss falls
+  through the normal recovery chain (cache -> live HBQ -> input-lineage
+  re-read -> producer rewind), exactly as if the file had vanished.
+  "Corrupt artifacts are loss, never data."
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional, Tuple, Type
+
+
+class TransientError(Exception):
+    """Mixin marking an error as retryable (the operation did not take
+    effect, or taking effect twice is harmless)."""
+
+
+class RpcTransportError(TransientError, ConnectionError):
+    """The RPC transport died mid-call (socket reset, peer closed, timeout)
+    and reconnect-with-backoff exhausted its attempts.  Distinct from
+    ``RpcAuthError`` (fatal: wrong cluster token / not a quokka server),
+    which subclasses ConnectionError but NOT TransientError."""
+
+
+class TransientStoreError(TransientError, RuntimeError):
+    """A control-store operation failed before it was applied (flaky
+    backend, chaos injection).  Safe to retry: the request never reached
+    the store's mutation path."""
+
+
+class CorruptArtifactError(RuntimeError):
+    """An on-disk/remote artifact (HBQ spill, checkpoint) failed its
+    integrity check.  The reader quarantines the artifact and treats it as
+    LOSS — recovery regenerates the data; the bytes are never used."""
+
+    def __init__(self, source: str, reason: str):
+        super().__init__(f"corrupt artifact {source}: {reason}")
+        self.source = source
+        self.reason = reason
+
+
+def is_transient(exc: BaseException) -> bool:
+    return isinstance(exc, TransientError)
+
+
+def retry_with_backoff(
+    fn: Callable,
+    *,
+    attempts: int = 5,
+    base_delay: float = 0.02,
+    max_delay: float = 2.0,
+    retry_on: Tuple[Type[BaseException], ...] = (TransientError,),
+    on_retry: Optional[Callable[[int, BaseException], None]] = None,
+):
+    """Call ``fn()``; on a retryable error sleep ``base_delay * 2**k``
+    (capped) and try again, up to ``attempts`` total calls.  The backoff is
+    deterministic (no jitter) so a seeded chaos run replays identically.
+    The final failure re-raises the last error unchanged."""
+    delay = base_delay
+    for attempt in range(attempts):
+        try:
+            return fn()
+        except retry_on as e:  # noqa: PERF203 — retry loop by design
+            if attempt == attempts - 1:
+                raise
+            if on_retry is not None:
+                on_retry(attempt, e)
+            time.sleep(delay)
+            delay = min(delay * 2.0, max_delay)

@@ -9,6 +9,14 @@ Namespacing (the query service): many concurrent queries may share one spill
 directory.  An HBQ constructed with ``namespace=query_id`` prefixes its
 filenames ``hbq-<ns>-...`` and only ever lists/serves/wipes its own
 namespace, so co-resident queries cannot replay each other's spill.
+
+Integrity: every spill file is checksum-framed (runtime/integrity.py) and
+verified on read.  A truncated, bit-flipped or otherwise unreadable spill
+is QUARANTINED (moved aside, counted, recorded) and ``get`` returns None —
+corruption is treated as loss, so recovery falls through the normal chain
+(cache -> live-peer HBQ -> input-lineage re-read / producer replay) instead
+of crashing on ``pa.ArrowInvalid`` or, worse, feeding bad bytes back into
+the replay protocol.
 """
 
 from __future__ import annotations
@@ -20,6 +28,9 @@ from typing import Optional, Sequence, Tuple
 
 import pyarrow as pa
 import pyarrow.ipc as ipc
+
+from quokka_tpu.runtime import integrity
+from quokka_tpu.runtime.errors import CorruptArtifactError
 
 # namespaces embed in filenames between dash-separated integer fields: keep
 # them unambiguous to parse (and filesystem-safe)
@@ -45,16 +56,39 @@ class HBQ:
 
     def put(self, name: Tuple, table: pa.Table) -> None:
         p = os.path.join(self.path, self._fname(name))
-        with ipc.new_file(p + ".tmp", table.schema) as w:
-            w.write_table(table)
-        os.replace(p + ".tmp", p)  # atomic: readers never see partial spills
+
+        def _write(sink):
+            with ipc.new_file(sink, table.schema) as w:
+                w.write_table(table)
+
+        # framed + STREAMED (checksum accumulates as pyarrow writes — no
+        # 3x-the-spill buffering) + atomic rename: readers never see
+        # partial or torn spills, and anything the disk mangles later
+        # fails the checksum on read
+        integrity.write_framed_stream(p, _write, site="spill")
 
     def get(self, name: Tuple) -> Optional[pa.Table]:
         p = os.path.join(self.path, self._fname(name))
         if not os.path.exists(p):
             return None
-        with ipc.open_file(p) as r:
-            return r.read_all()
+        try:
+            payload = integrity.read_framed(p)
+            with ipc.open_file(pa.BufferReader(payload)) as r:
+                return r.read_all()
+        except (CorruptArtifactError, pa.ArrowInvalid) as e:
+            # corrupt spill == lost spill: quarantine it so the next
+            # existence probe says gone, and let recovery regenerate the
+            # object (live peer HBQ / input lineage / producer replay)
+            integrity.quarantine(p, e)
+            return None
+        except OSError as e:
+            # transient read failure (EMFILE, EINTR, raced GC) proves
+            # nothing about the BYTES — report loss for this attempt but
+            # leave the (possibly healthy) file in place for the next one
+            from quokka_tpu import obs
+
+            obs.diag(f"[hbq] transient read failure on {p}: {e}")
+            return None
 
     def contains(self, name: Tuple) -> bool:
         return os.path.exists(os.path.join(self.path, self._fname(name)))
@@ -97,13 +131,18 @@ class HBQ:
     def wipe(self) -> None:
         """Drop this HBQ's spill.  A namespaced HBQ shares its directory
         with other queries, so only its own files go; an un-namespaced one
-        owns the directory outright."""
+        owns the directory outright.  Prefix (not suffix) matching so
+        quarantined ``.corrupt`` and stale ``.tmp`` leftovers of this
+        namespace go too — a long-lived service would otherwise leak them
+        into the shared spill dir forever."""
         if self.namespace is None:
             shutil.rmtree(self.path, ignore_errors=True)
             os.makedirs(self.path, exist_ok=True)
             return
-        for f, _name in list(self._own_files()):
-            try:
-                os.remove(os.path.join(self.path, f))
-            except OSError:
-                continue
+        prefix = f"hbq-{self.namespace}-"
+        for f in os.listdir(self.path):
+            if f.startswith(prefix):
+                try:
+                    os.remove(os.path.join(self.path, f))
+                except OSError:
+                    continue

@@ -1,0 +1,202 @@
+"""Checksummed artifact framing: every HBQ spill and checkpoint file is
+written as ``MAGIC | payload_len | crc | payload`` and verified on read.
+
+Why framing instead of trusting the container format: a truncated Arrow IPC
+file raises somewhere deep in pyarrow, a bit-flipped one may silently parse
+into WRONG DATA, and a partially-written pickle can unpickle garbage.  The
+frame turns all of those into one named, caught-at-the-boundary
+``CorruptArtifactError`` — and the recovery protocol treats that as loss
+(quarantine the file, regenerate the data), never as data.
+
+Checksum: crc32c (the S3/GCS integrity standard) when a native module is
+available, else zlib.crc32 — both 32-bit, both detect the truncation and
+bit-flip classes the chaos plane injects; the frame records which was used
+so a mixed-environment cluster never misreads a healthy file as corrupt.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import zlib
+
+from quokka_tpu.runtime.errors import CorruptArtifactError
+
+try:  # optional native crc32c (google-crc32c / crc32c packages)
+    import crc32c as _crc32c_mod
+
+    def _crc32c(data: bytes) -> int:
+        return _crc32c_mod.crc32c(data) & 0xFFFFFFFF
+
+    _HAVE_CRC32C = True
+except ImportError:
+    _HAVE_CRC32C = False
+
+# one magic per checksum algorithm: a reader never guesses which to verify
+MAGIC_CRC32C = b"QKA1c"
+MAGIC_CRC32 = b"QKA1z"
+_HEADER = struct.Struct(">QI")  # payload length, checksum
+_MAGIC_LEN = 5
+HEADER_LEN = _MAGIC_LEN + _HEADER.size
+
+
+def checksum(data: bytes) -> int:
+    if _HAVE_CRC32C:
+        return _crc32c(data)
+    return zlib.crc32(data) & 0xFFFFFFFF
+
+
+def _crc_update(crc: int, data) -> int:
+    if _HAVE_CRC32C:
+        return _crc32c_mod.crc32c(data, crc) & 0xFFFFFFFF
+    return zlib.crc32(data, crc) & 0xFFFFFFFF
+
+
+def frame(payload: bytes) -> bytes:
+    """Wrap payload bytes with magic + length + checksum."""
+    magic = MAGIC_CRC32C if _HAVE_CRC32C else MAGIC_CRC32
+    return magic + _HEADER.pack(len(payload), checksum(payload)) + payload
+
+
+def unframe(data: bytes, source: str = "<bytes>") -> bytes:
+    """Verify and strip the frame; raises CorruptArtifactError on any
+    mismatch (bad magic, truncation, trailing junk, checksum)."""
+    if len(data) < HEADER_LEN:
+        raise CorruptArtifactError(source, f"truncated header ({len(data)}B)")
+    magic = data[:_MAGIC_LEN]
+    if magic == MAGIC_CRC32C:
+        if not _HAVE_CRC32C:
+            raise CorruptArtifactError(
+                source, "crc32c-framed artifact but no crc32c module here")
+        algo = _crc32c
+    elif magic == MAGIC_CRC32:
+        def algo(b):
+            return zlib.crc32(b) & 0xFFFFFFFF
+    else:
+        raise CorruptArtifactError(source, f"bad magic {magic!r}")
+    length, want = _HEADER.unpack_from(data, _MAGIC_LEN)
+    payload = data[HEADER_LEN:]
+    if len(payload) != length:
+        raise CorruptArtifactError(
+            source, f"length mismatch (header {length}, got {len(payload)})")
+    got = algo(payload)
+    if got != want:
+        raise CorruptArtifactError(
+            source, f"checksum mismatch (want {want:#010x}, got {got:#010x})")
+    return payload
+
+
+def write_framed_atomic(path: str, payload: bytes,
+                        site: str = "spill") -> None:
+    """Frame + write + atomic rename: a crashed writer leaves only a tmp
+    file, never a partial artifact under the final name.  ``site`` names
+    the chaos injection point ("spill" | "ckpt")."""
+    data = maybe_corrupt(frame(payload), site)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+    os.replace(tmp, path)
+
+
+class _CrcTee:
+    """Write-only file passthrough accumulating length + checksum of every
+    byte, so a large artifact streams to disk instead of being materialized
+    (the Arrow file format is written strictly sequentially, so no backward
+    seek ever crosses this wrapper).  close() is a no-op: the caller owns
+    the underlying file (it still has a header to patch)."""
+
+    def __init__(self, f):
+        self._f = f
+        self.length = 0
+        self.crc = 0
+
+    def write(self, b) -> int:
+        n = self._f.write(b)
+        self.crc = _crc_update(self.crc, b)
+        self.length += len(b)
+        return n
+
+    def tell(self) -> int:
+        return self.length
+
+    def flush(self) -> None:
+        self._f.flush()
+
+    def close(self) -> None:
+        pass
+
+    def writable(self) -> bool:
+        return True
+
+    def readable(self) -> bool:
+        return False
+
+    def seekable(self) -> bool:
+        return False
+
+    @property
+    def closed(self) -> bool:
+        return False
+
+
+def write_framed_stream(path: str, writer_cb, site: str = "spill") -> None:
+    """Framed write for LARGE artifacts: ``writer_cb(filelike)`` streams
+    the payload (e.g. pyarrow writing an IPC file) while length + checksum
+    accumulate incrementally; the header is patched in afterwards and the
+    tmp file renamed into place.  Peak memory is one write buffer, not
+    3x the artifact (serialize + copy + concat) like the bytes-based path."""
+    magic = MAGIC_CRC32C if _HAVE_CRC32C else MAGIC_CRC32
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(magic + _HEADER.pack(0, 0))  # placeholder header
+        tee = _CrcTee(f)
+        writer_cb(tee)
+        f.flush()
+        f.seek(_MAGIC_LEN)
+        f.write(_HEADER.pack(tee.length, tee.crc))
+    maybe_corrupt_file(tmp, site)
+    os.replace(tmp, path)
+
+
+def read_framed(path: str) -> bytes:
+    """Read + verify a framed artifact.  Raises CorruptArtifactError (the
+    caller quarantines via ``quarantine``) or OSError (missing file)."""
+    with open(path, "rb") as f:
+        data = f.read()
+    return unframe(data, source=path)
+
+
+def quarantine(path: str, reason: BaseException) -> None:
+    """Move a corrupt artifact aside (``<path>.corrupt``) so the next
+    existence probe reports it gone and recovery regenerates the data; the
+    bytes are kept for post-mortem.  Counts + records the detection so a
+    chaos soak can assert every injected corruption was caught."""
+    from quokka_tpu import obs
+
+    obs.REGISTRY.counter("integrity.corrupt").inc()
+    obs.RECORDER.record("integrity.corrupt", os.path.basename(path),
+                        reason=str(reason)[:200])
+    obs.diag(f"[integrity] quarantining corrupt artifact {path}: {reason}")
+    try:
+        os.replace(path, path + ".corrupt")
+    except OSError as e:
+        # already gone (raced a GC) — the loss path proceeds either way
+        obs.diag(f"[integrity] quarantine rename of {path} skipped: {e}")
+
+
+def maybe_corrupt(data: bytes, site: str) -> bytes:
+    """Chaos hook: the seeded fault plane may hand back a truncated or
+    bit-flipped copy of the framed bytes (simulating torn writes / media
+    corruption) — a no-op unless QK_CHAOS enables the ``corrupt`` site."""
+    from quokka_tpu.chaos import CHAOS
+
+    mangled = CHAOS.corrupt_artifact(data, site)
+    return data if mangled is None else mangled
+
+
+def maybe_corrupt_file(path: str, site: str) -> None:
+    """File-level variant for the streaming write path: truncates or
+    bit-flips the on-disk tmp file in place (never buffers the artifact)."""
+    from quokka_tpu.chaos import CHAOS
+
+    CHAOS.corrupt_file(path, site)

@@ -18,6 +18,16 @@ deliberate improvement over the reference's open Redis/Flight ports.)
 
 Threaded server: one thread per connection, so a blocking call from one
 worker never stalls another's.
+
+Transient-failure hardening (the chaos plane, quokka_tpu/chaos): every
+request carries an idempotency key ``(client_id, req_id)``.  A client whose
+connection dies mid-call reconnects with bounded exponential backoff and
+RESENDS the same request id; the server keeps each client's last
+``(req_id, response)`` and answers a replayed id from that cache without
+re-executing — so a retried mutation (ntt_push, result_append, ...)
+applies exactly once even when the response was lost in flight.  Exhausted
+retries raise ``RpcTransportError`` (transient, runtime/errors.py),
+distinct from the fatal ``RpcAuthError``.
 """
 
 from __future__ import annotations
@@ -32,15 +42,33 @@ import socketserver
 import struct
 import threading
 import time
+from collections import OrderedDict
 from typing import Any, Optional, Tuple
+
+from quokka_tpu.runtime.errors import RpcTransportError  # noqa: F401 — re-export
 
 _LEN = struct.Struct(">I")
 _MAGIC = b"QRPC1"
 _NONCE = 16
 
+# per-server cap on remembered clients (each entry: last req id + response);
+# a client needs only its LAST response replayable — requests are serial per
+# connection — so this bounds memory at one response per live-ish client
+_DEDUP_CLIENTS = 4096
+# responses whose PICKLED size exceeds this are tombstoned instead of
+# cached — but ONLY for methods the server declared re-executable
+# (RpcServer(reexecutable=...): idempotent bulk reads like hbq_get_ipc).
+# Everything else is always cached whole, whatever its size: a destructive
+# call (ntt_pop returning a huge ReplayTask) must never be re-executed on
+# retry — a tombstone there would pop and silently DISCARD a second task.
+_DEDUP_MAX_RESP_BYTES = 1 << 20
+_DEDUP_LARGE = object()  # tombstone: executed, response too big to replay
+_DEDUP_WAIT_S = 600.0
+
 
 class RpcAuthError(ConnectionError):
-    """Peer failed the HMAC handshake (wrong or missing cluster token)."""
+    """Peer failed the HMAC handshake (wrong or missing cluster token).
+    Fatal: deterministic, never retried (NOT a TransientError)."""
 
 
 def _token_file() -> str:
@@ -116,7 +144,10 @@ def _client_handshake(sock: socket.socket, token: str) -> None:
 
 
 def _send_msg(sock: socket.socket, obj: Any) -> None:
-    data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    _send_raw(sock, pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL))
+
+
+def _send_raw(sock: socket.socket, data: bytes) -> None:
     sock.sendall(_LEN.pack(len(data)) + data)
 
 
@@ -139,6 +170,8 @@ class _Handler(socketserver.BaseRequestHandler):
     def handle(self):
         target = self.server.target  # type: ignore[attr-defined]
         token = self.server.token  # type: ignore[attr-defined]
+        dedup = self.server.dedup  # type: ignore[attr-defined]
+        dedup_lock = self.server.dedup_lock  # type: ignore[attr-defined]
         try:
             # a silent peer (port scanner, half-open connect) must not pin
             # this handler thread forever waiting on the handshake reply
@@ -151,22 +184,111 @@ class _Handler(socketserver.BaseRequestHandler):
             return
         while True:
             try:
-                method, args = _recv_msg(self.request)
+                msg = _recv_msg(self.request)
             except (ConnectionError, EOFError):
                 return
             try:
-                if method == "__multi__":
-                    # atomic batch (transaction): applied under one lock hold
-                    with target._lock:
-                        out = [getattr(target, m)(*a) for m, a in args]
-                else:
-                    out = getattr(target, method)(*args)
-                _send_msg(self.request, (True, out))
-            except Exception as e:  # noqa: BLE001 — ship the error to the caller
-                try:
-                    _send_msg(self.request, (False, e))
-                except Exception:
-                    return
+                cid, rid, method, args = msg
+            except (TypeError, ValueError):
+                return  # malformed request shape: drop the connection
+            data = self._execute_idempotent(target, dedup, dedup_lock,
+                                            cid, rid, method, args)
+            try:
+                _send_raw(self.request, data)
+            except Exception:
+                return
+
+    def _execute_idempotent(self, target, dedup, dedup_lock, cid, rid,
+                            method, args):
+        """At-most-once execution keyed by (client id, request id).
+
+        The dedup entry is installed BEFORE execution as a
+        ``threading.Event`` in-progress marker: a retried request that
+        arrives while the original is still executing (its connection died
+        after send, the client backed off and reconnected faster than the
+        call finished) WAITS for the original instead of re-executing the
+        mutation concurrently.  After completion the entry becomes the
+        PICKLED cached response (a replay ships it without re-pickling) —
+        or a tombstone when it is too large to pin, in which case the
+        replay re-executes (large responses are idempotent reads by
+        invariant, see _DEDUP_MAX_RESP_BYTES).  Returns the pickled
+        response bytes to send."""
+        entry = None
+        run_it = False
+        with dedup_lock:
+            hit = dedup.get(cid)
+            if hit is not None and hit[0] == rid:
+                entry = hit[1]
+                dedup.move_to_end(cid)
+            else:
+                entry = threading.Event()
+                dedup[cid] = (rid, entry)
+                dedup.move_to_end(cid)
+                while len(dedup) > _DEDUP_CLIENTS:
+                    dedup.popitem(last=False)
+                run_it = True
+        if not run_it:
+            from quokka_tpu import obs
+
+            obs.REGISTRY.counter("rpc.dedup_hit").inc()
+            obs.RECORDER.record("rpc.dedup", f"{method}#{rid}")
+            if isinstance(entry, threading.Event):
+                # the original execution is in flight on another handler
+                # thread: wait for it, then answer from its result
+                if not entry.wait(_DEDUP_WAIT_S):
+                    return pickle.dumps(
+                        (False, RpcTransportError(
+                            f"request {method}#{rid} still executing after "
+                            f"{_DEDUP_WAIT_S:.0f}s")),
+                        protocol=pickle.HIGHEST_PROTOCOL)
+                with dedup_lock:
+                    hit = dedup.get(cid)
+                entry = (hit[1] if hit is not None and hit[0] == rid
+                         else _DEDUP_LARGE)  # replaced/evicted: fall through
+            if entry is not _DEDUP_LARGE:
+                return entry  # cached pickled response
+            if method not in self.server.reexecutable:  # type: ignore[attr-defined]
+                # the cached entry was replaced (client moved on) or
+                # evicted, and the method is destructive: re-executing
+                # could double-apply — a named error is the only safe
+                # answer to this stale retry
+                return pickle.dumps(
+                    (False, RpcTransportError(
+                        f"retry of {method}#{rid} arrived after its cached "
+                        "response was replaced — cannot safely re-execute "
+                        "a non-idempotent method")),
+                    protocol=pickle.HIGHEST_PROTOCOL)
+            # tombstone: re-execute (idempotent-read invariant)
+        try:
+            if method == "__multi__":
+                # atomic batch (transaction): one lock hold
+                with target._lock:
+                    out = [getattr(target, m)(*a) for m, a in args]
+            else:
+                out = getattr(target, method)(*args)
+            resp = (True, out)
+        except Exception as e:  # noqa: BLE001 — ship to the caller
+            resp = (False, e)
+        try:
+            data = pickle.dumps(resp, protocol=pickle.HIGHEST_PROTOCOL)
+        except Exception as e:  # noqa: BLE001 — ship a NAMED error instead
+            # of dying mid-send (which the client would retry forever)
+            data = pickle.dumps(
+                (False, RuntimeError(
+                    f"unpicklable RPC response for {method!r}: {e!r}")),
+                protocol=pickle.HIGHEST_PROTOCOL)
+        big = (len(data) > _DEDUP_MAX_RESP_BYTES
+               and method in self.server.reexecutable)  # type: ignore[attr-defined]
+        with dedup_lock:
+            cur = dedup.get(cid)
+            # don't clobber a NEWER request's entry (we may be a late
+            # tombstone re-execution racing the client's next call)
+            if cur is None or cur[0] == rid:
+                dedup[cid] = (rid, _DEDUP_LARGE if big else data)
+                dedup.move_to_end(cid)
+        if run_it and isinstance(entry, threading.Event):
+            entry.set()
+        return data
 
 
 class RpcServer:
@@ -174,7 +296,8 @@ class RpcServer:
     for `__multi__` atomic batches."""
 
     def __init__(self, target: Any, host: str = "127.0.0.1", port: int = 0,
-                 token: Optional[str] = None):
+                 token: Optional[str] = None,
+                 reexecutable: Optional[frozenset] = None):
         class _Srv(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
             daemon_threads = True
@@ -182,6 +305,15 @@ class RpcServer:
         self._srv = _Srv((host, port), _Handler)
         self._srv.target = target  # type: ignore[attr-defined]
         self._srv.token = token or default_token()  # type: ignore[attr-defined]
+        # method names whose responses are idempotent bulk reads: safe to
+        # re-execute on a retried request id instead of pinning a huge
+        # cached response (see _DEDUP_MAX_RESP_BYTES)
+        self._srv.reexecutable = frozenset(reexecutable or ())  # type: ignore[attr-defined]
+        # client_id -> (last req_id, last response): the retried-request
+        # dedup cache, shared across ALL connections (a retry arrives on a
+        # fresh connection after the original died)
+        self._srv.dedup = OrderedDict()  # type: ignore[attr-defined]
+        self._srv.dedup_lock = threading.Lock()  # type: ignore[attr-defined]
         self.address: Tuple[str, int] = self._srv.server_address
         self._thread = threading.Thread(target=self._srv.serve_forever, daemon=True)
         self._thread.start()
@@ -198,15 +330,46 @@ class RpcClient:
     a per-method counter always, a flight-recorder event when slow, and a
     per-thread "current activity" marker while blocked in the call — a
     wedged transport (the round-5 blocked tcp_recvmsg) never produces a
-    completion event, so the marker is what a stall/watchdog dump shows."""
+    completion event, so the marker is what a stall/watchdog dump shows.
+
+    Transient transport failures (peer reset, chaos-injected drops) are
+    absorbed transparently: reconnect with exponential backoff and resend
+    the SAME request id, which the server dedups.  A reconnect that cannot
+    even re-establish TCP+handshake fails fast (the peer is down, not
+    flaky) so dead-peer detection in recovery stays bounded; a receive that
+    times out is also NOT retried (the server may still be executing —
+    retrying would double-apply)."""
 
     def __init__(self, address: Tuple[str, int], timeout: float = 120.0,
-                 token: Optional[str] = None):
+                 token: Optional[str] = None, max_attempts: Optional[int] = None):
         self.address = tuple(address)
-        self._sock = socket.create_connection(self.address, timeout=timeout)
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        _client_handshake(self._sock, token or default_token())
+        self._timeout = timeout
+        self._token = token or default_token()
+        self._client_id = secrets.token_hex(8)
+        self._req_id = 0
         self._lock = threading.Lock()
+        self._max_attempts = max_attempts if max_attempts is not None else int(
+            os.environ.get("QK_RPC_ATTEMPTS", "5"))
+        self._sock: Optional[socket.socket] = None
+        self._connect()  # first connect: auth/refused errors surface raw
+
+    def _connect(self) -> None:
+        s = socket.create_connection(self.address, timeout=self._timeout)
+        try:
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            _client_handshake(s, self._token)
+        except BaseException:
+            s.close()
+            raise
+        self._sock = s
+
+    def _drop_sock(self) -> None:
+        import contextlib
+
+        s, self._sock = self._sock, None
+        if s is not None:
+            with contextlib.suppress(OSError):
+                s.close()
 
     def call(self, method: str, *args):
         from quokka_tpu import obs
@@ -214,8 +377,7 @@ class RpcClient:
         t0 = time.perf_counter()
         with obs.RECORDER.activity(f"rpc:{method}@{self.address[1]}"):
             with self._lock:
-                _send_msg(self._sock, (method, args))
-                ok, out = _recv_msg(self._sock)
+                ok, out = self._request(method, args)
         obs.rpc_event(method, time.perf_counter() - t0)
         if not ok:
             raise out
@@ -228,15 +390,69 @@ class RpcClient:
         t0 = time.perf_counter()
         with obs.RECORDER.activity(f"rpc:__multi__@{self.address[1]}"):
             with self._lock:
-                _send_msg(self._sock, ("__multi__", list(calls)))
-                ok, out = _recv_msg(self._sock)
+                ok, out = self._request("__multi__", list(calls))
         obs.rpc_event("__multi__", time.perf_counter() - t0)
         if not ok:
             raise out
         return out
 
+    def _request(self, method: str, args) -> Tuple[bool, Any]:
+        """One idempotent request: retried verbatim (same req id) across
+        reconnects until a response arrives or attempts are exhausted.
+        Caller holds self._lock."""
+        from quokka_tpu import obs
+        from quokka_tpu.chaos import CHAOS
+
+        self._req_id += 1
+        payload = (self._client_id, self._req_id, method, args)
+        delay = 0.05
+        last: Optional[BaseException] = None
+        for attempt in range(self._max_attempts):
+            if attempt:
+                obs.REGISTRY.counter("rpc.reconnect").inc()
+                obs.RECORDER.record("rpc.retry",
+                                    f"{method}@{self.address[1]}",
+                                    attempt=attempt, error=repr(last)[:120])
+                time.sleep(delay)
+                delay = min(delay * 2.0, 1.0)
+            try:
+                if self._sock is None:
+                    self._connect()
+            except RpcAuthError:
+                raise
+            except (ConnectionError, OSError) as e:
+                # can't even re-establish TCP+handshake: the peer is gone,
+                # not flaky — fail fast (recovery probes must stay bounded)
+                raise RpcTransportError(
+                    f"rpc {method!r} to {self.address}: reconnect failed: "
+                    f"{e!r}") from e
+            sock = self._sock
+            mode = CHAOS.rpc_fault() if CHAOS.enabled else None
+            try:
+                if mode == "pre":
+                    sock.close()  # injected: connection died before send
+                _send_msg(sock, payload)
+                if mode == "post":
+                    sock.close()  # injected: died before the response
+                return _recv_msg(sock)
+            except socket.timeout as e:
+                # the server may still be executing this request — retrying
+                # could double-apply a mutation whose first execution is
+                # merely slow, so a timeout is terminal, never retried
+                self._drop_sock()
+                raise RpcTransportError(
+                    f"rpc {method!r} to {self.address} timed out after "
+                    f"{self._timeout}s") from e
+            except (ConnectionError, EOFError, OSError) as e:
+                last = e
+                self._drop_sock()
+        raise RpcTransportError(
+            f"rpc {method!r} to {self.address} failed after "
+            f"{self._max_attempts} attempts: {last!r}") from last
+
     def close(self) -> None:
         try:
-            self._sock.close()
+            if self._sock is not None:
+                self._sock.close()
         except Exception:
             pass

@@ -21,6 +21,7 @@ from collections import deque
 from contextlib import contextmanager
 from typing import Deque, Dict, List, Optional, Tuple
 
+from quokka_tpu.runtime.errors import TransientStoreError, retry_with_backoff
 from quokka_tpu.runtime.rpc import RpcClient, RpcServer
 from quokka_tpu.runtime.tables import ControlStore
 
@@ -126,6 +127,13 @@ class ControlStoreClient:
         "result_append", "heartbeat", "mailbox_push", "flight_append",
     }
 
+    # transient store failures (a flaky backend, chaos "store" injection)
+    # are retried with bounded backoff.  Safe because a TransientStoreError
+    # is raised BEFORE the request is applied (errors.py taxonomy); loss of
+    # an in-flight request/response is handled one layer down by the RPC
+    # client's same-request-id retry + server dedup.
+    _STORE_ATTEMPTS = 5
+
     def __init__(self, address: Tuple[str, int]):
         self._rpc = RpcClient(address)
         self._txn: Optional[List] = None
@@ -141,13 +149,32 @@ class ControlStoreClient:
         finally:
             calls, self._txn = self._txn, None
             if calls:
-                self._rpc.call_multi(calls)
+                self._retry(
+                    "__multi__", lambda: self._rpc.call_multi(calls))
+
+    def _retry(self, method: str, fn):
+        from quokka_tpu import obs
+        from quokka_tpu.chaos import CHAOS
+
+        def attempt():
+            if CHAOS.enabled:
+                CHAOS.store_fault(method)  # may raise TransientStoreError
+            return fn()
+
+        def on_retry(n, e):
+            obs.REGISTRY.counter("store.retry").inc()
+            obs.RECORDER.record("store.retry", method, attempt=n,
+                                error=repr(e)[:120])
+
+        return retry_with_backoff(
+            attempt, attempts=self._STORE_ATTEMPTS,
+            retry_on=(TransientStoreError,), on_retry=on_retry)
 
     def _call(self, method: str, *args):
         if self._txn is not None and method in self._WRITES:
             self._txn.append((method, args))
             return None
-        return self._rpc.call(method, *args)
+        return self._retry(method, lambda: self._rpc.call(method, *args))
 
     def __getattr__(self, name: str):
         if name.startswith("_"):

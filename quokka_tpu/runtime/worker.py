@@ -63,6 +63,12 @@ def _actors_from_spec(spec: Dict) -> Dict[int, ActorInfo]:
 
 
 class Worker(Engine):
+    # never rewind a LIVE peer-owned channel from this process: the owner's
+    # in-flight dispatch would race the rewind (engine._maybe_force_
+    # producer_rewind) — distributed loss escalation stays with the
+    # coordinator's co-dead planning + the loud wait-deadline
+    _allow_forced_rewind = False
+
     def __init__(self, spec: Dict, store, cache: BatchCache, worker_id: int,
                  owned: Dict[int, List[int]], hbq=None):
         actors = _actors_from_spec(spec)
@@ -367,6 +373,13 @@ def worker_main(spec_bytes: bytes, store_addr, worker_id: int, owned):
             pass
     import pickle
 
+    # chaos plane: spawned children inherit QK_CHAOS through the environment;
+    # the role keys this worker's seeded fault streams apart from (and as
+    # reproducibly as) the coordinator's
+    from quokka_tpu.chaos import CHAOS
+
+    if CHAOS.enabled:
+        CHAOS.set_role(f"worker-{worker_id}")
     spec = pickle.loads(spec_bytes)
     if spec.get("x64"):
         import jax

@@ -124,7 +124,13 @@ class QueryService:
         ]
         for t in self._threads:
             t.start()
-        obs.RECORDER.record("service.start", f"pool={self.pool_size}")
+        # the active chaos spec (QK_CHAOS) is part of the service's
+        # identity: a soak triaging a failed run needs to see, in the
+        # flight timeline, which fault plan this service ran under
+        from quokka_tpu.chaos import CHAOS
+
+        obs.RECORDER.record("service.start", f"pool={self.pool_size}",
+                            chaos=CHAOS.describe())
 
     # -- client surface ------------------------------------------------------
     def submit(self, stream, *, working_set_bytes: Optional[int] = None,

@@ -1,0 +1,30 @@
+"""QK009 fixture: network/socket/fsspec IO without an explicit timeout."""
+
+import socket
+
+import fsspec
+
+
+def connect(addr):
+    s = socket.create_connection(addr)  # QK009: no timeout
+    s.settimeout(None)  # QK009: explicitly unbounded
+    return s
+
+
+def connect_none(addr):
+    return socket.create_connection(addr, timeout=None)  # QK009: None = unbounded
+
+
+def connect_bounded(addr):
+    s = socket.create_connection(addr, timeout=5.0)  # ok: explicit timeout
+    s.settimeout(10.0)  # ok: finite
+    return s
+
+
+def read_remote(url):
+    with fsspec.open(url, "rb") as f:  # QK009: fsspec call, no timeout
+        return f.read()
+
+
+def move_remote(fs, src, dst):
+    fs.mv(src, dst)  # QK009: bound-filesystem call, no timeout

@@ -1,0 +1,480 @@
+"""Chaos plane + transient-failure hardening (quokka_tpu/chaos, runtime
+integrity/retry): the corruption matrix (truncate/bit-flip x spill/ckpt)
+must be DETECTED via checksum and recovered bit-exactly; RPC disconnects
+must reconnect with backoff and dedup the retried request; remote
+checkpoint saves must be atomic (tmp key + move + verify)."""
+
+import os
+import socket
+import threading
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from quokka_tpu import QuokkaContext, obs
+from quokka_tpu.chaos import CHAOS, ChaosConfig, ChaosSpecError
+from quokka_tpu.dataset.readers import InputArrowDataset
+from quokka_tpu.runtime import integrity
+from quokka_tpu.runtime.errors import (
+    CorruptArtifactError,
+    RpcTransportError,
+    TransientStoreError,
+)
+
+
+@pytest.fixture(autouse=True)
+def _chaos_off():
+    """Every test starts and ends with the chaos plane inert."""
+    CHAOS.disable()
+    yield
+    CHAOS.disable()
+
+
+def _corrupt_file(path, mode):
+    with open(path, "rb") as f:
+        data = f.read()
+    if mode == "truncate":
+        data = data[: max(1, len(data) // 3)]
+    else:  # bitflip: past the header, so the magic/length still parse
+        i = integrity.HEADER_LEN + (len(data) - integrity.HEADER_LEN) // 2
+        data = data[:i] + bytes([data[i] ^ 0x40]) + data[i + 1:]
+    with open(path, "wb") as f:
+        f.write(data)
+
+
+class TestFraming:
+    def test_roundtrip(self):
+        payload = b"hello quokka" * 100
+        assert integrity.unframe(integrity.frame(payload)) == payload
+
+    @pytest.mark.parametrize("mode", ["truncate", "bitflip"])
+    def test_mangled_frame_detected(self, mode, tmp_path):
+        p = str(tmp_path / "a.bin")
+        integrity.write_framed_atomic(p, b"x" * 4096)
+        _corrupt_file(p, mode)
+        with pytest.raises(CorruptArtifactError):
+            integrity.read_framed(p)
+
+    def test_bad_magic_detected(self):
+        with pytest.raises(CorruptArtifactError):
+            integrity.unframe(b"NOTAFRAME" + b"x" * 64)
+
+
+class TestHBQCorruption:
+    @pytest.mark.parametrize("mode", ["truncate", "bitflip"])
+    def test_corrupt_spill_quarantined_and_lost(self, tmp_path, mode):
+        from quokka_tpu.runtime.hbq import HBQ
+
+        hbq = HBQ(str(tmp_path))
+        name = (0, 1, 2, 3, 0, 4)
+        hbq.put(name, pa.table({"a": [1, 2, 3]}))
+        path = os.path.join(hbq.path, hbq._fname(name))
+        _corrupt_file(path, mode)
+        before = obs.REGISTRY.counter("integrity.corrupt").value
+        assert hbq.get(name) is None  # loss, not ArrowInvalid / bad data
+        assert obs.REGISTRY.counter("integrity.corrupt").value == before + 1
+        # quarantined: the next existence probe reports it gone, so
+        # recovery regenerates instead of retrying the bad file forever
+        assert not hbq.contains(name)
+        assert os.path.exists(path + ".corrupt")
+
+    def test_namespaced_wipe_sweeps_quarantine_and_tmp(self, tmp_path):
+        """Query teardown in a shared spill dir must also remove this
+        namespace's quarantined .corrupt and stale .tmp leftovers — a
+        long-lived service would otherwise leak them forever."""
+        from quokka_tpu.runtime.hbq import HBQ
+
+        hbq = HBQ(str(tmp_path), namespace="q1")
+        other = HBQ(str(tmp_path), namespace="q2")
+        name = (0, 0, 0, 1, 0, 0)
+        hbq.put(name, pa.table({"a": [1]}))
+        other.put(name, pa.table({"a": [2]}))
+        p = os.path.join(hbq.path, hbq._fname(name))
+        _corrupt_file(p, "bitflip")
+        assert hbq.get(name) is None  # quarantined to .corrupt
+        with open(p + ".tmp", "wb") as f:
+            f.write(b"stale")  # crashed-writer leftover
+        hbq.wipe()
+        left = os.listdir(str(tmp_path))
+        assert all(not f.startswith("hbq-q1-") for f in left), left
+        assert other.contains(name)  # the neighbor's spill is untouched
+
+    def test_healthy_roundtrip_still_works(self, tmp_path):
+        from quokka_tpu.runtime.hbq import HBQ
+
+        hbq = HBQ(str(tmp_path))
+        t = pa.table({"a": [1, 2, 3], "b": ["x", "y", "z"]})
+        hbq.put((0, 0, 0, 1, 0, 0), t)
+        assert hbq.get((0, 0, 0, 1, 0, 0)).equals(t)
+
+
+class TestCheckpointCorruption:
+    @pytest.mark.parametrize("mode", ["truncate", "bitflip"])
+    def test_corrupt_local_checkpoint_raises_named_error(self, tmp_path, mode):
+        from quokka_tpu.runtime.ckptstore import CheckpointStore
+
+        cs = CheckpointStore(str(tmp_path))
+        cs.save(1, 0, 4, b"state-bytes" * 200)
+        assert cs.load(1, 0, 4) == b"state-bytes" * 200
+        _corrupt_file(cs._path(1, 0, 4), mode)
+        before = obs.REGISTRY.counter("integrity.corrupt").value
+        with pytest.raises(CorruptArtifactError):
+            cs.load(1, 0, 4)
+        assert obs.REGISTRY.counter("integrity.corrupt").value == before + 1
+        # quarantined -> subsequent loads see it as ABSENT (treated as loss)
+        assert cs.load(1, 0, 4) is None
+
+    def test_remote_save_never_exposes_partial_object(self):
+        """The fsspec path writes a tmp key then moves it into place: at no
+        point does a partial object exist under the final key, and the
+        landed bytes are re-read and checksum-verified."""
+        from quokka_tpu.runtime.ckptstore import CheckpointStore
+
+        root = "memory://qk-ckpt-atomic"
+        cs = CheckpointStore(root, namespace="q1")
+        data = b"snapshot" * 500
+        cs.save(2, 1, 6, data)
+        assert cs.load(2, 1, 6) == data
+        fs, base = cs._fs()
+        names = fs.glob(f"{base}/ckpt-q1-*")
+        assert len(names) == 1 and names[0].endswith(".pkl")  # no tmp litter
+        cs.wipe_namespace()
+        assert cs.load(2, 1, 6) is None
+
+    def test_remote_partial_object_is_loss_not_data(self):
+        """A torn write under the final key (what the old direct-write path
+        could leave) fails the frame check: quarantined + named error."""
+        from quokka_tpu.runtime.ckptstore import CheckpointStore
+
+        root = "memory://qk-ckpt-torn"
+        cs = CheckpointStore(root, namespace="q2")
+        cs.save(0, 0, 2, b"real-state" * 100)
+        fs, base = cs._fs()
+        path = f"{base}/ckpt-q2-0-0-2.pkl"
+        with fs.open(path, "wb") as f:
+            f.write(fs.cat_file(path)[:37])  # torn mid-upload
+        with pytest.raises(CorruptArtifactError):
+            cs.load(0, 0, 2)
+        assert cs.load(0, 0, 2) is None  # quarantined away
+        cs.wipe_namespace()
+
+
+class _Target:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self.calls = []
+
+    def bump(self, x):
+        self.calls.append(x)
+        return x * 2
+
+
+class TestRpcResilience:
+    def test_transport_error_is_named_and_distinct_from_auth(self):
+        from quokka_tpu.runtime.rpc import RpcAuthError
+
+        assert issubclass(RpcTransportError, ConnectionError)
+        assert not issubclass(RpcTransportError, RpcAuthError)
+        assert not issubclass(RpcAuthError, RpcTransportError)
+
+    def test_reconnect_after_disconnect(self):
+        from quokka_tpu.runtime.rpc import RpcClient, RpcServer
+
+        t = _Target()
+        srv = RpcServer(t, token="s")
+        try:
+            cli = RpcClient(srv.address, token="s")
+            assert cli.call("bump", 1) == 2
+            cli._sock.close()  # connection dies under the client
+            assert cli.call("bump", 2) == 4  # transparent reconnect
+            assert t.calls == [1, 2]
+            cli.close()
+        finally:
+            srv.close()
+
+    def test_retried_request_id_dedups_server_side(self):
+        """Replay the exact wire protocol: the same (client_id, req_id)
+        resent — including over a brand-new connection, the
+        lost-response-then-reconnect case — executes the mutation ONCE and
+        returns the cached response."""
+        from quokka_tpu.runtime import rpc as rpcmod
+
+        t = _Target()
+        srv = rpcmod.RpcServer(t, token="s")
+
+        def dial():
+            s = socket.create_connection(srv.address, timeout=10)
+            rpcmod._client_handshake(s, "s")
+            return s
+
+        try:
+            s1 = dial()
+            rpcmod._send_msg(s1, ("cid-1", 1, "bump", (21,)))
+            assert rpcmod._recv_msg(s1) == (True, 42)
+            # retry on the SAME connection (response was lost in flight)
+            rpcmod._send_msg(s1, ("cid-1", 1, "bump", (21,)))
+            assert rpcmod._recv_msg(s1) == (True, 42)
+            s1.close()
+            # retry across a reconnect (connection died before the reply)
+            s2 = dial()
+            rpcmod._send_msg(s2, ("cid-1", 1, "bump", (21,)))
+            assert rpcmod._recv_msg(s2) == (True, 42)
+            s2.close()
+            assert t.calls == [21]  # applied exactly once
+        finally:
+            srv.close()
+
+    def test_chaos_drops_with_dedup_apply_once(self):
+        """Seeded chaos connection drops (pre- and post-send): every call
+        still returns the right answer and every mutation applies once."""
+        from quokka_tpu.runtime.rpc import RpcClient, RpcServer
+
+        t = _Target()
+        srv = RpcServer(t, token="s")
+        try:
+            cli = RpcClient(srv.address, token="s")
+            CHAOS.configure("seed=7,rpc=0.2")
+            vals = [cli.call("bump", i) for i in range(40)]
+            CHAOS.disable()
+            assert vals == [i * 2 for i in range(40)]
+            assert t.calls == list(range(40))
+            cli.close()
+        finally:
+            srv.close()
+
+    def test_concurrent_replay_waits_for_inflight_execution(self):
+        """A retried request that lands while the ORIGINAL is still
+        executing must wait for it, not re-execute the mutation
+        concurrently (the fast-reconnect double-apply race)."""
+        import time
+
+        from quokka_tpu.runtime import rpc as rpcmod
+
+        class Slow:
+            def __init__(self):
+                self._lock = threading.RLock()
+                self.calls = 0
+
+            def slow_bump(self, x):
+                self.calls += 1
+                time.sleep(0.6)
+                return x + 1
+
+        t = Slow()
+        srv = rpcmod.RpcServer(t, token="s")
+
+        def dial():
+            s = socket.create_connection(srv.address, timeout=10)
+            rpcmod._client_handshake(s, "s")
+            return s
+
+        try:
+            s1, s2 = dial(), dial()
+            rpcmod._send_msg(s1, ("cid-r", 5, "slow_bump", (1,)))
+            time.sleep(0.1)  # original is mid-execution
+            rpcmod._send_msg(s2, ("cid-r", 5, "slow_bump", (1,)))
+            results = {}
+
+            def read(sock, key):
+                results[key] = rpcmod._recv_msg(sock)
+
+            th = [threading.Thread(target=read, args=(s1, "a")),
+                  threading.Thread(target=read, args=(s2, "b"))]
+            for x in th:
+                x.start()
+            for x in th:
+                x.join(timeout=10)
+            assert results == {"a": (True, 2), "b": (True, 2)}
+            assert t.calls == 1  # the replay waited; applied exactly once
+            s1.close(), s2.close()
+        finally:
+            srv.close()
+
+    @pytest.mark.parametrize("declared,expect_calls", [(True, 2), (False, 1)],
+                             ids=["reexecutable", "default"])
+    def test_large_response_tombstone_is_opt_in(self, declared, expect_calls):
+        """Responses over the dedup size cap are tombstoned (re-executed on
+        replay, not pinned in server memory) ONLY for methods the server
+        declared re-executable idempotent reads.  By default even a huge
+        response is cached whole: a destructive call (ntt_pop) replayed
+        against a tombstone would pop — and silently lose — a second task."""
+        from quokka_tpu.runtime import rpc as rpcmod
+
+        class Bulk:
+            def __init__(self):
+                self._lock = threading.RLock()
+                self.calls = 0
+
+            def big_read(self):
+                self.calls += 1
+                return b"z" * (2 << 20)
+
+        t = Bulk()
+        srv = rpcmod.RpcServer(
+            t, token="s",
+            reexecutable=frozenset({"big_read"}) if declared else None)
+        try:
+            s = socket.create_connection(srv.address, timeout=10)
+            rpcmod._client_handshake(s, "s")
+            rpcmod._send_msg(s, ("cid-b", 1, "big_read", ()))
+            assert rpcmod._recv_msg(s)[1] == b"z" * (2 << 20)
+            rpcmod._send_msg(s, ("cid-b", 1, "big_read", ()))
+            assert rpcmod._recv_msg(s)[1] == b"z" * (2 << 20)
+            assert t.calls == expect_calls
+            s.close()
+        finally:
+            srv.close()
+
+    def test_dead_peer_fails_fast_with_transport_error(self):
+        from quokka_tpu.runtime.rpc import RpcClient, RpcServer
+
+        t = _Target()
+        srv = RpcServer(t, token="s")
+        cli = RpcClient(srv.address, token="s")
+        srv.close()
+        cli._drop_sock()  # force the next call through a reconnect
+        with pytest.raises(RpcTransportError):
+            cli.call("bump", 1)
+
+
+class TestStoreRetry:
+    def test_flaky_store_calls_retried_to_success(self):
+        from quokka_tpu.runtime.store_service import (
+            ControlStoreClient,
+            CoordinatorStore,
+            serve_store,
+        )
+
+        cs = CoordinatorStore()
+        srv = serve_store(cs)
+        try:
+            cli = ControlStoreClient(srv.address)
+            CHAOS.configure("seed=3,store=0.4")
+            before = obs.REGISTRY.counter("store.retry").value
+            for i in range(30):
+                cli.set(f"k{i}", i)
+            with cli.transaction():
+                cli.tset("LIT", (0, 0), 7)
+                cli.tset("LIT", (0, 1), 9)
+            CHAOS.disable()
+            assert [cli.get(f"k{i}") for i in range(30)] == list(range(30))
+            assert cli.tget("LIT", (0, 0)) == 7
+            assert obs.REGISTRY.counter("store.retry").value > before
+            cli.close()
+        finally:
+            srv.close()
+
+    def test_exhausted_transient_errors_surface(self):
+        from quokka_tpu.runtime.store_service import (
+            ControlStoreClient,
+            CoordinatorStore,
+            serve_store,
+        )
+
+        cs = CoordinatorStore()
+        srv = serve_store(cs)
+        try:
+            cli = ControlStoreClient(srv.address)
+            CHAOS.configure("seed=3,store=1.0")  # every attempt fails
+            with pytest.raises(TransientStoreError):
+                cli.set("k", 1)
+            CHAOS.disable()
+            cli.close()
+        finally:
+            srv.close()
+
+
+class TestChaosSpec:
+    def test_parse_render_roundtrip(self):
+        cfg = ChaosConfig.parse("seed=42,rpc=0.02,corrupt=0.01,kill=1")
+        assert cfg.seed == 42 and cfg.kill == 1
+        assert cfg.prob("rpc") == 0.02
+        assert cfg.prob("spill") == 0.01  # corrupt covers both sites
+        cfg2 = ChaosConfig.parse(cfg.render())
+        assert cfg2.render() == cfg.render()
+
+    def test_site_overrides(self):
+        cfg = ChaosConfig.parse("seed=1,corrupt=0.1,corrupt_ckpt=0.9")
+        assert cfg.prob("spill") == 0.1 and cfg.prob("ckpt") == 0.9
+        # an EXPLICIT zero override beats the blanket rate (falsy-zero must
+        # not fall through an `or`)
+        cfg = ChaosConfig.parse("seed=1,corrupt=0.3,corrupt_spill=0")
+        assert cfg.prob("spill") == 0.0 and cfg.prob("ckpt") == 0.3
+
+    def test_unknown_key_rejected(self):
+        with pytest.raises(ChaosSpecError):
+            ChaosConfig.parse("seed=1,typo_rate=0.5")
+
+    def test_same_seed_same_plan(self):
+        a, b = ChaosConfig.parse("seed=9,kill=2"), None
+        CHAOS.configure(a)
+        p1 = CHAOS.plan_embedded_failures([(1, 0), (1, 1), (2, 0)])
+        CHAOS.configure(ChaosConfig.parse("seed=9,kill=2"))
+        p2 = CHAOS.plan_embedded_failures([(1, 0), (1, 1), (2, 0)])
+        assert p1 == p2 and p1
+
+
+# -- end-to-end corruption matrix -------------------------------------------
+
+
+def _make_table(n=8000):
+    r = np.random.default_rng(5)
+    # integer-valued floats: sums are exact under any execution order, so
+    # the bit-exact assertion is a real claim, not a tolerance
+    return pa.table({"k": r.integers(0, 40, n).astype(np.int64),
+                     "v": r.integers(0, 100, n).astype(np.float64)})
+
+
+def _agg(ctx, table, **cfg):
+    for k, v in cfg.items():
+        ctx.set_config(k, v)
+    s = ctx.read_dataset(InputArrowDataset(table, batch_rows=512))
+    return (s.groupby("k").agg_sql("sum(v) as sv, count(*) as n")
+            .collect().sort_values("k").reset_index(drop=True))
+
+
+class TestCorruptionE2E:
+    """Every artifact write corrupted (prob 1.0) + a mid-run channel loss:
+    results must stay bit-exact AND the corruption-detected counter must
+    move (silent acceptance of bad bytes would pass a looser test)."""
+
+    @pytest.mark.parametrize("site,cfg", [
+        ("spill", dict(checkpoint_interval=None,
+                       inject_failure={"after_tasks": 12,
+                                       "channels": [(1, 0), (1, 1)]})),
+        ("ckpt", dict(checkpoint_interval=3,
+                      inject_failure={"after_tasks": 10,
+                                      "channels": [(1, 0)]})),
+    ], ids=["spill", "ckpt"])
+    def test_corrupt_artifacts_detected_and_bit_exact(self, tmp_path, site,
+                                                      cfg):
+        table = _make_table()
+        baseline = _agg(QuokkaContext(), table)
+        before = obs.REGISTRY.counter("integrity.corrupt").value
+        CHAOS.configure(f"seed=99,corrupt_{site}=1.0")
+        try:
+            got = _agg(QuokkaContext(), table, fault_tolerance=True,
+                       hbq_path=str(tmp_path), **cfg)
+        finally:
+            CHAOS.disable()
+        pd.testing.assert_frame_equal(got, baseline, check_exact=True,
+                                      check_dtype=False)
+        assert obs.REGISTRY.counter("integrity.corrupt").value > before
+
+    def test_chaos_kill_without_scripts(self, tmp_path):
+        """kill=N alone (no scripted inject_failure): seeded random exec
+        channels are lost at seeded task boundaries and recovered."""
+        table = _make_table()
+        baseline = _agg(QuokkaContext(), table)
+        before = obs.REGISTRY.counter("chaos.kill").value
+        CHAOS.configure("seed=31,kill=2,kill_after=8,corrupt=0.2")
+        try:
+            got = _agg(QuokkaContext(), table, fault_tolerance=True,
+                       hbq_path=str(tmp_path), checkpoint_interval=3)
+        finally:
+            CHAOS.disable()
+        pd.testing.assert_frame_equal(got, baseline, check_exact=True,
+                                      check_dtype=False)
+        assert obs.REGISTRY.counter("chaos.kill").value > before

@@ -22,6 +22,7 @@ CASES = [
     ("QK006", "qk006_swallow.py", 1),
     ("QK007", "qk007_print.py", 1),          # library print; main() exempt
     ("QK008", "qk008_global_config.py", 3),  # jax.config, environ, module
+    ("QK009", "qk009_io_timeout.py", 5),     # create_connection, settimeout(None), timeout=None, fsspec.open, fs.mv
 ]
 
 
