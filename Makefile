@@ -4,7 +4,7 @@
 PY ?= python
 export JAX_PLATFORMS ?= cpu
 
-.PHONY: lint lint-baseline test test-slow sanitize-demo service-smoke chaos-smoke
+.PHONY: lint lint-baseline test test-slow sanitize-demo service-smoke chaos-smoke obs-smoke bench-check
 
 # engine-invariant static analysis; exits nonzero on findings beyond the
 # checked-in baseline (quokka_tpu/analysis/baseline.json)
@@ -39,6 +39,20 @@ stall-demo:
 service-smoke:
 	QUOKKA_BENCH_SF=0.01 QUOKKA_BENCH_CACHE=/tmp/quokka_tpu_bench_smoke \
 		$(PY) bench.py --service --smoke
+
+# observability smoke: a profiled query's critical-path buckets must sum to
+# the measured wall time within 10%, and /metrics + /status must serve a
+# live 2-query service run (Prometheus text with per-query histograms)
+obs-smoke:
+	$(PY) -m quokka_tpu.obs.smoke
+
+# perf-regression gate: run the bench and compare against the newest
+# BENCH_r*.json (override with CHECK_ARGS="--against path --threshold 0.2"
+# or compare two artifacts offline with CHECK_ARGS="--current path").
+# Exits nonzero when any metric regresses beyond its threshold, printing
+# the regressed queries' critical-path diffs.
+bench-check:
+	$(PY) bench.py --check $(CHECK_ARGS)
 
 # chaos plane soak: >= 20 seeded mixed-fault runs (RPC drops/delays, flaky
 # store calls, worker kills, spill + checkpoint corruption) each asserting

@@ -319,7 +319,9 @@ def measure_service(paths, smoke=False):
                 if smoke and ds.to_arrow() is None:
                     raise RuntimeError(
                         f"service smoke: {name} returned an empty result")
-                per_query.setdefault(name, []).append(h.timings())
+                t = h.timings()
+                t["latency"] = h.latency_stats()  # per-task p50/p95
+                per_query.setdefault(name, []).append(t)
             wall = time.time() - t0
         finally:
             svc.shutdown()
@@ -334,13 +336,29 @@ def measure_service(paths, smoke=False):
                 t["finished_at"] - t["submitted_at"] for t in ts
                 if t["finished_at"] is not None
             ]
+            task_p50 = [t["latency"]["p50"] for t in ts
+                        if t.get("latency") and t["latency"]["p50"]]
+            task_p95 = [t["latency"]["p95"] for t in ts
+                        if t.get("latency") and t["latency"]["p95"]]
             lat_detail[name] = {
                 "serial_s": round(serial_seconds[name], 4),
                 "run_p50_s": round(_quantile(runs, 0.5), 4),
                 "run_p95_s": round(_quantile(runs, 0.95), 4),
                 "total_p50_s": round(_quantile(totals, 0.5), 4),
                 "total_p95_s": round(_quantile(totals, 0.95), 4),
+                # per-TASK dispatch-latency quantiles from the typed
+                # per-query histograms (QueryService.stats() shape)
+                "task_p50_s": round(_quantile(task_p50, 0.5), 6)
+                if task_p50 else None,
+                "task_p95_s": round(_quantile(task_p95, 0.5), 6)
+                if task_p95 else None,
             }
+            sys.stderr.write(
+                f"bench --service [{ways}-way] {name}: "
+                f"task p50={lat_detail[name]['task_p50_s']}s "
+                f"p95={lat_detail[name]['task_p95_s']}s over "
+                f"{sum(t['latency']['count'] for t in ts if t.get('latency'))}"
+                " dispatches\n")
         lines.append({
             "metric": f"service_{ways}way_aggregate_speedup",
             "value": round(speedup, 4),
@@ -461,7 +479,26 @@ def measure(paths):
             sys.stderr.write(f"[spans] {qname} warmup\n"
                              + obs_spans.summary() + "\n")
         obs_spans.reset()
-        times = sorted(fn(paths) for _ in range(3))
+        # critical-path profile of the LAST timed run: the DAG rebuilt from
+        # the flight recorder, wall time attributed into compile/scan/
+        # transfer/compute/queue/stall buckets (obs/critpath.py)
+        from quokka_tpu.obs import critpath as obs_critpath
+
+        times = [fn(paths) for _ in range(2)]
+        with obs_critpath.profile() as _prof:
+            times.append(fn(paths))
+        crit = None
+        if _prof.result is not None:
+            crit = _prof.result.to_json()
+            crit["measured_wall_s"] = round(times[-1], 4)
+            # the full segment list lives in bench_obs.json; the stdout
+            # line of record carries the bucket attribution only
+            crit_line = {k: v for k, v in crit.items() if k != "path"}
+            if trace_print:
+                sys.stderr.write(_prof.result.render() + "\n")
+        else:
+            crit_line = None
+        times = sorted(times)
         c2 = compilestats.snapshot()
         t = times[0]
         speedup = ref / t
@@ -481,7 +518,8 @@ def measure(paths):
         }
         obs_per_query[qname] = {"spans_warmup": spans_warmup,
                                 "spans_timed": spans_timed,
-                                "breakdown": breakdown}
+                                "breakdown": breakdown,
+                                "critpath": crit}
         if trace_print:
             sys.stderr.write(f"[spans] {qname} timed runs (3)\n"
                              + obs_spans.summary() + "\n")
@@ -500,6 +538,7 @@ def measure(paths):
             ),
             "cache_hits_warmup": c1["cache_hits"] - c0["cache_hits"],
             "breakdown": breakdown,
+            "critpath": crit_line,
             **extra,
         }
         # QK_SANITIZE=1: the recompile sentinel fails the run outright when
@@ -663,6 +702,262 @@ def _run_child(platform: str, timeout: int):
     return None
 
 
+# ---------------------------------------------------------------------------
+# --check: perf-regression gate
+# ---------------------------------------------------------------------------
+# Per-metric relative regression thresholds on the normalized vs_baseline
+# ratios (all bench metrics are higher-is-better).  Defaults are sized for
+# the shared-CI noise floor observed across BENCH_r01..r05; the geomean is
+# tighter because noise averages out across queries.
+CHECK_THRESHOLDS = {
+    "tpch_q135_speedup_geomean_per_chip": 0.15,
+    "tpch_q1_scan_gbps_per_chip": 0.30,
+    "tick_asof_rows_per_s_per_chip": 0.30,
+    "service_aggregate_speedup_geomean": 0.30,
+}
+CHECK_DEFAULT_THRESHOLD = 0.25
+
+
+def _parse_artifact(path):
+    """({metric: line-dict}, truncated) from any bench artifact shape: raw
+    bench stdout (JSON lines), a single line, a list, or the driver's
+    BENCH_r*.json wrapper ({"tail": "<stdout tail>", "parsed": <last
+    line>}).  ``truncated`` is True for a wrapper whose stdout tail was
+    cut mid-stream (its first kept line fails to parse): metrics absent
+    from such an artifact fell off the tail — their absence says nothing
+    about whether the benchmark ran."""
+    with open(path, "r", encoding="utf-8") as f:
+        text = f.read()
+    lines = []
+    truncated = False
+    try:
+        obj = json.loads(text)
+    except ValueError:
+        obj = None
+    if isinstance(obj, dict) and "tail" in obj:
+        tail_lines = [ln.strip() for ln in str(obj["tail"]).splitlines()
+                      if ln.strip()]
+        for i, ln in enumerate(tail_lines):
+            try:
+                lines.append(json.loads(ln))
+            except ValueError:
+                if i == 0:
+                    truncated = True
+        if not tail_lines:
+            truncated = True
+        if isinstance(obj.get("parsed"), dict):
+            lines.append(obj["parsed"])
+    elif isinstance(obj, dict) and "metric" in obj:
+        lines = [obj]
+    elif isinstance(obj, list):
+        lines = obj
+    else:
+        for ln in text.splitlines():
+            ln = ln.strip()
+            if ln.startswith("{"):
+                try:
+                    lines.append(json.loads(ln))
+                except ValueError:
+                    pass
+    return ({d["metric"]: d for d in lines
+             if isinstance(d, dict) and "metric" in d}, truncated)
+
+
+def load_metrics(path):
+    """{metric: line-dict} from any bench artifact shape (see
+    ``_parse_artifact``)."""
+    return _parse_artifact(path)[0]
+
+
+def _artifact_truncated(path):
+    try:
+        return _parse_artifact(path)[1]
+    except (OSError, ValueError):
+        return False
+
+
+def _metric_ratio(d):
+    """The comparable number: vs_baseline (normalized, unit-free across
+    metrics) when present, else the raw value."""
+    v = d.get("vs_baseline")
+    return float(v if v is not None else d["value"])
+
+
+def _critpath_of(d):
+    detail = d.get("detail") or {}
+    cp = detail.get("critpath")
+    if cp:
+        return cp
+    # geomean line: nested per-query details
+    return None
+
+
+def _print_critpath_diff(metric, base_d, cur_d, out):
+    pairs = []  # (heading, base_cp_or_None, cur_cp)
+    cur_cp = _critpath_of(cur_d)
+    if cur_cp:
+        pairs.append((metric, _critpath_of(base_d), cur_cp))
+    else:
+        # geomean-style line: per-query details nested under the summary
+        cur_queries = (cur_d.get("detail") or {}).get("queries") or {}
+        base_queries = (base_d.get("detail") or {}).get("queries") or {}
+        for qname, qd in sorted(cur_queries.items()):
+            cp = (qd or {}).get("critpath")
+            if cp:
+                pairs.append((qname,
+                              (base_queries.get(qname) or {}).get("critpath"),
+                              cp))
+    if not pairs:
+        out.write(f"    (no critical-path data in the current run for "
+                  f"{metric})\n")
+        return
+    for heading, base_cp, cp in pairs:
+        out.write(f"    critical path [{heading}] "
+                  f"(wall {cp.get('wall_s', 0) * 1e3:.1f}ms):\n")
+        base_buckets = (base_cp or {}).get("buckets") or {}
+        for k, v in (cp.get("buckets") or {}).items():
+            if not v and not base_buckets.get(k):
+                continue
+            b = base_buckets.get(k)
+            delta = (f" (baseline {b * 1e3:.1f}ms, "
+                     f"{(v - b) * 1e3:+.1f}ms)" if b is not None else "")
+            out.write(f"      {k:<10} {v * 1e3:>9.1f}ms{delta}\n")
+
+
+def check_regressions(base, cur, threshold=None, not_run_prefixes=()):
+    """Compare {metric: line} maps; returns (report_rows, regressed_list).
+    A metric present in the baseline but missing from the current run
+    counts as regressed (a silently vanished benchmark is the regression
+    mode this gate exists for) — EXCEPT metrics under ``not_run_prefixes``,
+    which the current run's mode could not have produced (a fresh --check
+    runs only the --measure section, so a baseline that also captured
+    --service metrics must not trip on them)."""
+    rows, regressed = [], []
+    for metric in sorted(base):
+        b = _metric_ratio(base[metric])
+        thr = threshold if threshold is not None else \
+            CHECK_THRESHOLDS.get(metric, CHECK_DEFAULT_THRESHOLD)
+        if metric not in cur:
+            if not_run_prefixes and metric.startswith(
+                    tuple(not_run_prefixes)):
+                rows.append((metric, b, None, None, None, "not-run"))
+            else:
+                rows.append((metric, b, None, None, thr, "MISSING"))
+                regressed.append(metric)
+            continue
+        c = _metric_ratio(cur[metric])
+        delta = (c - b) / b if b else 0.0
+        bad = c < b * (1.0 - thr)
+        rows.append((metric, b, c, delta, thr,
+                     "REGRESSED" if bad else "ok"))
+        if bad:
+            regressed.append(metric)
+    for metric in sorted(set(cur) - set(base)):
+        rows.append((metric, None, _metric_ratio(cur[metric]), None,
+                     None, "new"))
+    return rows, regressed
+
+
+def check_main(argv):
+    import argparse
+    import glob
+
+    ap = argparse.ArgumentParser(
+        prog="bench.py --check",
+        description="Perf-regression gate: compare a bench run against a "
+                    "baseline artifact; exit 1 on regression.")
+    ap.add_argument("--against", default=None,
+                    help="baseline artifact (default: newest BENCH_r*.json "
+                         "next to bench.py)")
+    ap.add_argument("--current", default=None,
+                    help="compare this artifact instead of running the "
+                         "bench now (file-vs-file mode)")
+    ap.add_argument("--threshold", type=float, default=None,
+                    help="override every per-metric relative threshold "
+                         "(fraction, e.g. 0.2)")
+    args = ap.parse_args(argv)
+
+    against = args.against
+    if against is None:
+        here = os.path.dirname(os.path.abspath(__file__))
+        cands = sorted(glob.glob(os.path.join(here, "BENCH_r*.json")))
+        if not cands:
+            sys.stderr.write("bench --check: no --against and no "
+                             "BENCH_r*.json found\n")
+            return 2
+        against = cands[-1]
+    try:
+        base, base_truncated = _parse_artifact(against)
+    except OSError as e:
+        sys.stderr.write(f"bench --check: cannot read {against}: {e}\n")
+        return 2
+    if not base:
+        sys.stderr.write(f"bench --check: no metrics in {against}\n")
+        return 2
+
+    not_run_prefixes = ()
+    if args.current is not None:
+        try:
+            cur, cur_truncated = _parse_artifact(args.current)
+        except OSError as e:
+            sys.stderr.write(f"bench --check: cannot read "
+                             f"{args.current}: {e}\n")
+            return 2
+        cur_src = args.current
+        if cur_truncated:
+            # which metrics survived the wrapper's 2000-byte tail is
+            # arbitrary: gate only the intersection instead of failing
+            # on lines that merely fell off the tail
+            sys.stderr.write(
+                f"bench --check: {args.current} is a truncated driver "
+                "tail; baseline metrics absent from it report as "
+                "not-run, not REGRESSED\n")
+            not_run_prefixes = ("",)
+    else:
+        ensure_data()
+        attempts = (["tpu", "tpu"] if probe_tpu() else []) + ["cpu"]
+        lines = None
+        for platform in attempts:
+            lines = _run_child(platform, MEASURE_TIMEOUT)
+            if lines is not None:
+                break
+        if lines is None:
+            sys.stderr.write("bench --check: measurement failed\n")
+            return 2
+        cur = {d["metric"]: d for d in map(json.loads, lines)
+               if "metric" in d}
+        cur_src = "fresh run"
+        # the fresh run executes only the --measure section: baseline
+        # metrics from other modes (--service) are "not run", not missing
+        not_run_prefixes = ("service_",)
+    if not cur:
+        sys.stderr.write("bench --check: no current metrics\n")
+        return 2
+
+    rows, regressed = check_regressions(base, cur, args.threshold,
+                                        not_run_prefixes=not_run_prefixes)
+    out = sys.stdout
+    out.write(f"bench --check: {cur_src} vs {against}\n")
+    if base_truncated:
+        out.write("  note: the baseline is a truncated driver tail — "
+                  "metrics missing from IT are not gated at all\n")
+    for metric, b, c, delta, thr, status in rows:
+        b_s = f"{b:.4f}" if b is not None else "-"
+        c_s = f"{c:.4f}" if c is not None else "-"
+        d_s = f"{delta:+.1%}" if delta is not None else "-"
+        t_s = f"(allow -{thr:.0%})" if thr is not None else ""
+        out.write(f"  {status:>9}  {metric:<42} {b_s:>9} -> {c_s:>9} "
+                  f"{d_s:>8} {t_s}\n")
+        if status == "REGRESSED":
+            _print_critpath_diff(metric, base[metric], cur[metric], out)
+    if regressed:
+        out.write(f"REGRESSION: {len(regressed)} metric(s) regressed "
+                  f"beyond threshold: {', '.join(regressed)}\n")
+        return 1
+    out.write("clean: no metric regressed beyond its threshold\n")
+    return 0
+
+
 def main():
     ensure_data()
     attempts = []
@@ -684,6 +979,11 @@ def main():
 
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "--measure":
+        # a full TPC-H run records far more than the 4096-event default
+        # ring; size it so the critical-path profile keeps the whole last
+        # timed run (set BEFORE the first quokka_tpu import instantiates
+        # the recorder)
+        os.environ.setdefault("QK_TRACE_BUFFER", "262144")
         if os.environ.get("QUOKKA_BENCH_FORCE_CPU"):
             import jax
 
@@ -699,6 +999,11 @@ if __name__ == "__main__":
         # query -> its error, empty smoke result -> RuntimeError): any of
         # them exits nonzero
         measure_service(ensure_data(), smoke="--smoke" in sys.argv[2:])
+    elif len(sys.argv) > 1 and sys.argv[1] == "--check":
+        # perf-regression gate: fresh run (or --current file) vs the
+        # newest BENCH_r*.json (or --against); exit 1 on regression with
+        # the regressed queries' critical-path diffs printed
+        sys.exit(check_main(sys.argv[2:]))
     elif len(sys.argv) > 1 and sys.argv[1] == "--chaos":
         # seeded mixed-fault soak (the chaos plane, quokka_tpu/chaos):
         # bit-exact-under-injection is a robustness benchmark, so it rides

@@ -85,10 +85,21 @@ def load_baseline(path: str) -> Dict[str, str]:
 
 
 def write_baseline(path: str, findings: Sequence[Finding],
-                   old: Dict[str, str]) -> None:
+                   old: Dict[str, str],
+                   reason: Optional[str] = None) -> int:
+    """Rewrite the baseline; surviving entries keep their rationale, NEW
+    entries take ``reason``.  Returns the number of new entries written —
+    the caller refuses to grow the baseline without a real reason (the
+    old auto-filled "TODO: rationale" placeholder let growth ship
+    unreviewed; the tier-1 gate rejects TODO rationales)."""
     entries = {}
+    grew = 0
     for f in sorted(findings, key=lambda f: (f.rel, f.line, f.rule)):
-        entries[f.key()] = old.get(f.key(), "TODO: rationale")
+        rationale = old.get(f.key())
+        if rationale is None:
+            grew += 1
+            rationale = reason or ""
+        entries[f.key()] = rationale
     with open(path, "w", encoding="utf-8") as fh:
         json.dump({
             "comment": (
@@ -101,6 +112,7 @@ def write_baseline(path: str, findings: Sequence[Finding],
             "findings": entries,
         }, fh, indent=2, sort_keys=False)
         fh.write("\n")
+    return grew
 
 
 def main(argv: Sequence[str] = None) -> int:
@@ -114,7 +126,12 @@ def main(argv: Sequence[str] = None) -> int:
                    help="report every finding (fixture/dev mode)")
     p.add_argument("--write-baseline", action="store_true",
                    help="rewrite the baseline from the current tree "
-                        "(preserves rationales of surviving entries)")
+                        "(preserves rationales of surviving entries; "
+                        "GROWING it requires --reason)")
+    p.add_argument("--reason", default=None,
+                   help="rationale recorded on every NEW baseline entry "
+                        "(required when --write-baseline would grow the "
+                        "baseline; >= 10 chars, the gate rejects TODOs)")
     p.add_argument("--quiet", action="store_true")
     args = p.parse_args(argv)
 
@@ -122,9 +139,24 @@ def main(argv: Sequence[str] = None) -> int:
     baseline = {} if args.no_baseline else load_baseline(args.baseline)
 
     if args.write_baseline:
-        write_baseline(args.baseline, findings,
-                       load_baseline(args.baseline))
-        print(f"wrote {len(findings)} finding(s) to {args.baseline}")
+        old = load_baseline(args.baseline)
+        new_keys = [f for f in findings if f.key() not in old]
+        if new_keys:
+            reason = (args.reason or "").strip()
+            if len(reason) < 10 or "TODO" in reason:
+                plural = "y" if len(new_keys) == 1 else "ies"
+                print(f"--write-baseline would ADD {len(new_keys)} "
+                      f"entr{plural} — pass --reason \"<why this finding "
+                      "is accepted>\" (>= 10 chars, no TODO placeholders)",
+                      file=sys.stderr)
+                for f in new_keys:
+                    print(f"  would add: {f.key()}", file=sys.stderr)
+                return 2
+        grew = write_baseline(args.baseline, findings, old,
+                              reason=args.reason)
+        print(f"wrote {len(findings)} finding(s) to {args.baseline}"
+              + (f" ({grew} new, rationale: {args.reason!r})" if grew
+                 else ""))
         return 0
 
     new = [f for f in findings if f.key() not in baseline]

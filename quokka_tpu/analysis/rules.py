@@ -31,6 +31,11 @@ Rule ids:
                                 object-store request hangs a worker to the
                                 stall timeout instead of failing fast into
                                 the retry/recovery path
+  QK010 adhoc-counter-dict      counter-shaped increments on plain dicts in
+                                runtime code (``stats["hits"] += 1``) —
+                                counters must go through the typed
+                                obs.REGISTRY so the Prometheus exporter,
+                                bench snapshots and /status see them
 
 Finding keys (``Finding.key``) are line-number-free — ``rule::relpath::
 scope::snippet[::n]`` — so a baseline survives unrelated edits above the
@@ -892,6 +897,95 @@ def check_unbounded_io(tree: ast.Module, path: str, rel: str,
     return out
 
 
+# ---------------------------------------------------------------------------
+# QK010 — ad-hoc counter dicts in runtime code
+# ---------------------------------------------------------------------------
+
+# the typed Registry itself (and its exporter) legitimately manipulate raw
+# count stores; everything else routes through it
+ADHOC_COUNTER_EXEMPT_PREFIXES = ("quokka_tpu/obs/",)
+# receiver names that mark a dict as a metrics store
+_COUNTERISH_TOKENS = ("counter", "metric", "stat", "count", "hit", "miss")
+
+
+def _counterish(name: Optional[str]) -> bool:
+    if not name:
+        return False
+    low = name.lower()
+    return any(tok in low for tok in _COUNTERISH_TOKENS)
+
+
+def _sub_base_name(node: ast.AST) -> Optional[str]:
+    """The base identifier of a subscript target: ``stats`` for
+    ``stats[k]``, ``_hits`` for ``self._hits[k]``, dotted tail otherwise."""
+    if not isinstance(node, ast.Subscript):
+        return None
+    base = node.value
+    d = _dotted(base)
+    if d is not None:
+        return d.rsplit(".", 1)[-1]
+    return None
+
+
+def check_adhoc_counter_dict(tree: ast.Module, path: str, rel: str,
+                             src_lines: Sequence[str]) -> List[Finding]:
+    """Runtime code must not grow hand-rolled counter dicts: they are
+    invisible to the Prometheus exporter (obs/export.py), to bench's
+    counter snapshot and to /status, they race without the Registry lock,
+    and every one eventually grows its own flush/reset idiom.  Flags the
+    two counter-increment shapes on counter-named subscript bases:
+
+    - ``stats["hits"] += n`` (AugAssign-Add on a subscript);
+    - ``stats[k] = stats.get(k, 0) + n`` (read-modify-write via .get).
+
+    The typed Registry (quokka_tpu/obs/metrics.py) is exempt — it is what
+    the rule points at.  Pre-existing stores carry baseline rationales.
+    """
+    if rel.replace("\\", "/").startswith(ADHOC_COUNTER_EXEMPT_PREFIXES):
+        return []
+    out: List[Finding] = []
+    for node in ast.walk(tree):
+        hit = None
+        if (isinstance(node, ast.AugAssign)
+                and isinstance(node.op, (ast.Add, ast.Sub))
+                and isinstance(node.target, ast.Subscript)):
+            base = _sub_base_name(node.target)
+            if _counterish(base):
+                op = "+=" if isinstance(node.op, ast.Add) else "-="
+                hit = (node, base, f"'{base}[...] {op} ...'")
+        elif (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Subscript)):
+            base = _sub_base_name(node.targets[0])
+            if _counterish(base):
+                for sub in ast.walk(node.value):
+                    d = (_dotted(sub.func.value)
+                         if isinstance(sub, ast.Call)
+                         and isinstance(sub.func, ast.Attribute) else None)
+                    if (isinstance(sub, ast.Call)
+                            and isinstance(sub.func, ast.Attribute)
+                            and sub.func.attr == "get"
+                            and d is not None
+                            and d.rsplit(".", 1)[-1] == base
+                            and isinstance(node.value, ast.BinOp)
+                            and isinstance(node.value.op, ast.Add)):
+                        hit = (node, base,
+                               f"'{base}[k] = {base}.get(k, ...) + ...'")
+                        break
+        if hit is not None:
+            n, base, shape = hit
+            out.append(_mk(
+                "QK010", "adhoc-counter-dict", path, rel, n,
+                _scope_of(tree, n),
+                f"{shape} grows an ad-hoc counter dict — route it through "
+                "the typed registry (quokka_tpu.obs.REGISTRY: "
+                "Counter.inc() for monotone counts, Gauge.set() for "
+                "up-and-down quantities) so the /metrics exporter, bench "
+                "snapshots and stall reports see it, or baseline with a "
+                "rationale",
+                src_lines))
+    return out
+
+
 RULES = (
     check_module_level_jit,
     check_import_time_side_effects,
@@ -902,6 +996,7 @@ RULES = (
     check_bare_print,
     check_global_config_mutation,
     check_unbounded_io,
+    check_adhoc_counter_dict,
 )
 
 

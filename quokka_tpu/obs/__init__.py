@@ -39,7 +39,7 @@ from __future__ import annotations
 import contextlib
 import sys
 
-from quokka_tpu.obs import merge, metrics, recorder, spans
+from quokka_tpu.obs import critpath, export, merge, metrics, recorder, spans
 from quokka_tpu.obs.merge import (
     dump_flight,
     merge_streams,
@@ -47,7 +47,13 @@ from quokka_tpu.obs.merge import (
     to_chrome_trace,
     write_chrome_trace,
 )
-from quokka_tpu.obs.metrics import REGISTRY, Counter, EngineMetrics, Gauge
+from quokka_tpu.obs.metrics import (
+    REGISTRY,
+    Counter,
+    EngineMetrics,
+    Gauge,
+    Histogram,
+)
 from quokka_tpu.obs.recorder import (
     RECORDER,
     FlightRecorder,
@@ -73,9 +79,11 @@ def diag(msg: str) -> None:
 
 
 def rpc_event(method: str, dur: float) -> None:
-    """Account one client-side RPC: always a counter, an event only when it
-    was slow (every store op would otherwise flood the ring and evict the
-    task-level events a stall dump needs)."""
+    """Account one client-side RPC: always a counter + latency-histogram
+    observation, an event only when it was slow (every store op would
+    otherwise flood the ring and evict the task-level events a stall dump
+    needs)."""
     REGISTRY.counter(f"rpc.{method}").inc()
+    REGISTRY.histogram("rpc.latency_s").observe(dur)
     if dur > _RPC_SLOW_S:
         RECORDER.record("rpc", method, dur=dur)

@@ -1,0 +1,404 @@
+"""Critical-path profiler: turn a merged flight timeline into an answer.
+
+The flight recorder (obs/recorder.py) captures WHAT happened — task
+dispatches, batch push/pull edges, compiles, recovery events.  This module
+reconstructs WHY a query took as long as it did: it rebuilds the causal
+task DAG from the merged timeline, walks the critical path from the last
+task back to the query's start, and attributes every second of wall time
+to one of the latency buckets the accelerator-query-engine literature
+separates (arxiv 2203.01877, 2512.02862):
+
+    compile     XLA backend compiles overlapping the path
+    scan_read   parquet decode / reader execution / prefetch waits
+    transfer    host<->device bridging, partition pushes, result d2h
+    compute     executor kernels (exec./done./source. spans)
+    queue_wait  inputs were ready but the task waited for a dispatch slot
+    stall       the pipeline itself was starved (task.wait backpressure)
+    recovery    replay/exectape tasks + recover.*/chaos overlap
+    other       planning, store bookkeeping, unattributed task interior
+
+Buckets PARTITION the analysis window: their sum equals the window's wall
+time by construction, so a report whose buckets do not reconcile with the
+measured wall clock (within recorder granularity) indicates dropped events
+— which the report states explicitly via the recorder's drop counter.
+
+Edges come from the producer/consumer notes the engine attaches to task
+events (runtime/engine.py dispatch_task): each task event carries its
+``(a, c)`` identity, the output seqs it pushed (``outs``) and, for exec
+tasks, the ``(src, [[ch, seq], ...])`` batches it consumed.  A consumer's
+data predecessor is whoever produced ``(src, ch, seq)``; tasks on one
+channel additionally chain sequentially (executor state is serial per
+channel).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+BUCKETS = ("compile", "scan_read", "transfer", "compute",
+           "queue_wait", "stall", "recovery", "other")
+
+# span-name prefix -> bucket, for spans nested inside a task's interval
+_SPAN_BUCKETS = (
+    (("reader.", "prefetch"), "scan_read"),
+    (("bridge.", "emit.", "push.", "count_valid"), "transfer"),
+    (("exec.", "done.", "source."), "compute"),
+)
+
+# task kinds that ARE recovery work, whole-interval
+_RECOVERY_KINDS = ("exectape", "replay")
+
+
+def _span_bucket(name: str) -> Optional[str]:
+    for prefixes, bucket in _SPAN_BUCKETS:
+        if name.startswith(prefixes):
+            return bucket
+    return None
+
+
+@dataclass
+class _Task:
+    """One dispatched task reconstructed from a ``task`` event."""
+
+    pid: str
+    tid: str
+    label: str
+    kind: str           # input | exec | exectape | replay
+    actor: int
+    channel: int
+    start: float
+    end: float
+    q: Optional[str]
+    src: Optional[int] = None                 # exec: planned source actor
+    ins: List[Tuple[int, int]] = field(default_factory=list)   # (ch, seq)
+    outs: List[int] = field(default_factory=list)              # pushed seqs
+    critpred: Optional["_Task"] = None
+    arrival: Optional[float] = None
+
+    @property
+    def dur(self) -> float:
+        return self.end - self.start
+
+
+@dataclass
+class CritPath:
+    """The analysis result: bucketed wall-time attribution + the path."""
+
+    query: Optional[str]
+    wall_s: float
+    buckets: Dict[str, float]
+    path: List[dict]          # [{label, start_s, dur_s, gap_s, gap_bucket}]
+    n_tasks: int
+    n_path: int
+    dropped: int = 0
+
+    def to_json(self) -> dict:
+        return {
+            "query": self.query,
+            "wall_s": round(self.wall_s, 6),
+            "buckets": {k: round(v, 6) for k, v in self.buckets.items()},
+            "bucket_sum_s": round(sum(self.buckets.values()), 6),
+            "n_tasks": self.n_tasks,
+            "n_path": self.n_path,
+            "dropped_events": self.dropped,
+            "path": self.path,
+        }
+
+    def render(self, max_segments: int = 12) -> str:
+        head = f"query {self.query}" if self.query else "run"
+        lines = [f"==== critical path: {head} ====",
+                 f"wall {self.wall_s * 1e3:.1f}ms over {self.n_tasks} "
+                 f"task(s), {self.n_path} on the critical path"]
+        if self.dropped:
+            lines.append(f"WARNING: flight recorder dropped {self.dropped} "
+                         "event(s) — attribution is missing the earliest "
+                         "tail (raise QK_TRACE_BUFFER)")
+        wall = max(self.wall_s, 1e-12)
+        for k in BUCKETS:
+            v = self.buckets.get(k, 0.0)
+            if v <= 0:
+                continue
+            bar = "#" * max(1, int(30 * v / wall))
+            lines.append(f"  {k:<10} {v * 1e3:>9.1f}ms {100 * v / wall:>5.1f}%  {bar}")
+        segs = sorted(self.path, key=lambda s: -(s["dur_s"] + s["gap_s"]))
+        segs = segs[:max_segments]
+        keep = {id(s) for s in segs}
+        if segs:
+            lines.append(f"top path segments (of {len(self.path)}):")
+        for s in self.path:
+            if id(s) not in keep:
+                continue
+            gap = (f"  [+{s['gap_s'] * 1e3:.1f}ms {s['gap_bucket']}]"
+                   if s["gap_s"] > 0 else "")
+            lines.append(f"  {s['label']:<36} {s['dur_s'] * 1e3:>8.1f}ms{gap}")
+        lines.append("=" * 33)
+        return "\n".join(lines)
+
+
+def _clip_total(intervals: List[Tuple[float, float]],
+                lo: float, hi: float) -> float:
+    """Total coverage of [lo, hi] by the (possibly overlapping) intervals."""
+    clipped = sorted((max(lo, s), min(hi, e)) for s, e in intervals
+                     if e > lo and s < hi)
+    total = 0.0
+    cur = lo
+    for s, e in clipped:
+        s = max(s, cur)
+        if e > s:
+            total += e - s
+            cur = e
+    return total
+
+
+def _parse_tasks(merged: Sequence[dict],
+                 query: Optional[str]) -> List[_Task]:
+    tasks: List[_Task] = []
+    for d in merged:
+        if d["kind"] != "task":
+            continue
+        args = d.get("args") or {}
+        q = args.get("q")
+        if query is not None and q != query:
+            continue
+        a, c = args.get("a"), args.get("c")
+        if a is None or c is None:
+            continue  # pre-enrichment event stream: no DAG identity
+        tasks.append(_Task(
+            pid=d["pid"], tid=d["tid"], label=d["name"],
+            kind=args.get("k", d["name"].split(":")[0] or "exec"),
+            actor=int(a), channel=int(c),
+            start=d["ts"] - d["dur"], end=d["ts"], q=q,
+            src=args.get("src"),
+            ins=[(int(ch), int(s)) for ch, s in (args.get("in") or [])],
+            outs=[int(s) for s in (args.get("outs") or [])],
+        ))
+    tasks.sort(key=lambda t: t.end)
+    return tasks
+
+
+def _link(tasks: List[_Task]) -> None:
+    """Fill ``critpred``/``arrival`` on every task: the latest-finishing
+    predecessor among (a) the previous task on the same channel and (b) the
+    producers of every batch this task consumed."""
+    producers: Dict[Tuple[int, int, int], _Task] = {}
+    last_on_channel: Dict[Tuple[str, int, int], _Task] = {}
+    for t in tasks:  # already end-ordered
+        preds: List[_Task] = []
+        chain = last_on_channel.get((t.pid, t.actor, t.channel))
+        if chain is not None:
+            preds.append(chain)
+        if t.src is not None:
+            for ch, seq in t.ins:
+                p = producers.get((int(t.src), ch, seq))
+                if p is not None and p is not t:
+                    preds.append(p)
+        if preds:
+            t.critpred = max(preds, key=lambda p: p.end)
+            t.arrival = t.critpred.end
+        last_on_channel[(t.pid, t.actor, t.channel)] = t
+        for seq in t.outs:
+            producers.setdefault((t.actor, t.channel, seq), t)
+
+
+def _task_interior(t: _Task, spans: List[Tuple[float, float, str]],
+                   compiles: List[Tuple[float, float]],
+                   buckets: Dict[str, float],
+                   lo: float, hi: float) -> None:
+    """Attribute one on-path task's interior, CLIPPED to [lo, hi] — the
+    portion of the task not already covered by earlier path segments
+    (cross-process chains can overlap in time; attributing overlap twice
+    would break the buckets-partition-the-window invariant).  Recovery
+    tasks count whole; others split by their nested spans with a
+    covered-until watermark (a nested span's time goes to whichever span
+    started first), compile events claim what the spans left, and the
+    remainder is ``other``."""
+    if hi <= lo:
+        return  # fully shadowed by an already-attributed segment
+    dur = hi - lo
+    if t.kind in _RECOVERY_KINDS:
+        buckets["recovery"] += dur
+        return
+    covered = lo
+    accounted = 0.0
+    marks: List[Tuple[float, float, str]] = [
+        (s, e, _span_bucket(name) or "other")
+        for (s, e, name) in spans
+        if e > lo - 1e-9 and s < hi + 1e-9
+    ]
+    marks.sort()
+    for s, e, bucket in marks:
+        s = max(s, covered, lo)
+        e = min(e, hi)
+        if e > s:
+            buckets[bucket] += e - s
+            accounted += e - s
+            covered = max(covered, e)
+    comp = min(_clip_total(compiles, lo, hi),
+               max(0.0, dur - accounted))
+    buckets["compile"] += comp
+    buckets["other"] += max(0.0, dur - accounted - comp)
+
+
+def analyze(merged: Sequence[dict],
+            query: Optional[str] = None,
+            window: Optional[Tuple[float, float]] = None,
+            dropped: int = 0) -> Optional[CritPath]:
+    """Merged-timeline dicts (obs.merge_streams output) -> CritPath, or
+    None when the stream holds no DAG-enriched task events (recorder off,
+    or an old stream).  ``window`` widens/narrows the analysis to an
+    externally measured [t0, t1]; buckets partition exactly that window."""
+    tasks = _parse_tasks(merged, query)
+    if not tasks:
+        return None
+    if query is None:
+        # majority query: profile the dominant stream, ignore neighbors
+        by_q: Dict[Optional[str], int] = {}
+        for t in tasks:
+            by_q[t.q] = by_q.get(t.q, 0) + 1
+        query = max(by_q, key=lambda k: by_q[k])
+        if query is not None:
+            tasks = [t for t in tasks if t.q == query]
+    _link(tasks)
+
+    terminal = max(tasks, key=lambda t: t.end)
+    chain: List[_Task] = []
+    cur: Optional[_Task] = terminal
+    seen = set()
+    while cur is not None and id(cur) not in seen:
+        seen.add(id(cur))
+        chain.append(cur)
+        cur = cur.critpred
+    chain.reverse()
+
+    t0 = window[0] if window else min(t.start for t in tasks)
+    t1 = window[1] if window else terminal.end
+    t0 = min(t0, chain[0].start)
+    t1 = max(t1, terminal.end)
+
+    # supporting events, indexed once
+    spans_by_thread: Dict[Tuple[str, str], List[Tuple[float, float, str]]] = {}
+    compiles_by_pid: Dict[str, List[Tuple[float, float]]] = {}
+    recov_by_pid: Dict[str, List[Tuple[float, float]]] = {}
+    waits: List[Tuple[float, int, int]] = []   # (ts, actor, channel)
+    admits: List[Tuple[float, float]] = []     # [submit_ts, admit_ts]
+    pending_submit: Dict[str, float] = {}
+    for d in merged:
+        kind = d["kind"]
+        if kind == "span":
+            spans_by_thread.setdefault((d["pid"], d["tid"]), []).append(
+                (d["ts"] - d["dur"], d["ts"], d["name"]))
+        elif kind == "compile":
+            compiles_by_pid.setdefault(d["pid"], []).append(
+                (d["ts"] - d["dur"], d["ts"]))
+        elif kind.startswith(("recover", "chaos")):
+            recov_by_pid.setdefault(d["pid"], []).append(
+                (d["ts"] - max(d["dur"], 0.001), d["ts"]))
+        elif kind == "task.wait":
+            args = d.get("args") or {}
+            if query is None or args.get("q") in (None, query):
+                waits.append((d["ts"], args.get("a"), args.get("c")))
+        elif kind == "service.submit" and d["name"] == query:
+            pending_submit[d["name"]] = d["ts"]
+        elif kind == "service.admit" and d["name"] == query:
+            sub = pending_submit.pop(d["name"], None)
+            if sub is not None:
+                admits.append((sub, d["ts"]))
+
+    buckets: Dict[str, float] = {k: 0.0 for k in BUCKETS}
+    path_out: List[dict] = []
+    prev_end = t0
+    for t in chain:
+        gap_bucket = ""
+        gap = t.start - prev_end
+        if gap > 0:
+            pid_comp = compiles_by_pid.get(t.pid, [])
+            comp = _clip_total(pid_comp, prev_end, t.start)
+            buckets["compile"] += comp
+            rec = min(_clip_total(recov_by_pid.get(t.pid, []),
+                                  prev_end, t.start), gap - comp)
+            buckets["recovery"] += rec
+            adm = min(_clip_total(admits, prev_end, t.start),
+                      gap - comp - rec)
+            buckets["queue_wait"] += adm
+            rest = gap - comp - rec - adm
+            stalled = any(prev_end <= ts <= t.start
+                          and (a is None or a == t.actor)
+                          for ts, a, c in waits)
+            if t.critpred is None and not admits:
+                # leading edge: planning/lowering before the first task
+                gap_bucket = "startup(other)"
+                buckets["other"] += rest
+            elif stalled:
+                gap_bucket = "stall"
+                buckets["stall"] += rest
+            else:
+                gap_bucket = "queue_wait"
+                buckets["queue_wait"] += rest
+        _task_interior(t, spans_by_thread.get((t.pid, t.tid), []),
+                       compiles_by_pid.get(t.pid, []), buckets,
+                       max(t.start, prev_end), t.end)
+        path_out.append({
+            "label": t.label,
+            "start_s": round(t.start - t0, 6),
+            "dur_s": round(t.dur, 6),
+            "gap_s": round(max(0.0, gap), 6),
+            "gap_bucket": gap_bucket,
+        })
+        prev_end = max(prev_end, t.end)
+    buckets["other"] += max(0.0, t1 - prev_end)  # trailing drain/teardown
+
+    return CritPath(query=query, wall_s=t1 - t0, buckets=buckets,
+                    path=path_out, n_tasks=len(tasks), n_path=len(chain),
+                    dropped=dropped)
+
+
+def summarize_queries(merged: Sequence[dict],
+                      max_queries: int = 4) -> List[CritPath]:
+    """Per-query critical paths for a merged timeline (stall dumps append
+    these): the busiest ``max_queries`` queries, busiest first."""
+    counts: Dict[str, int] = {}
+    for d in merged:
+        if d["kind"] == "task":
+            q = (d.get("args") or {}).get("q")
+            if q is not None:
+                counts[q] = counts.get(q, 0) + 1
+    out: List[CritPath] = []
+    for q in sorted(counts, key=lambda k: -counts[k])[:max_queries]:
+        cp = analyze(merged, query=q)
+        if cp is not None:
+            out.append(cp)
+    return out
+
+
+class profile:
+    """``with critpath.profile() as p: run()`` — profile exactly this
+    window of the process-local flight recorder and analyze it on exit
+    (``p.result`` is the CritPath, None when the recorder was off)."""
+
+    def __init__(self, query: Optional[str] = None):
+        self.query = query
+        self.result: Optional[CritPath] = None
+
+    def __enter__(self) -> "profile":
+        from quokka_tpu.obs import recorder
+
+        self._rec = recorder.RECORDER
+        self._since = self._rec.record("critpath.begin", "")
+        self._drop0 = self._rec.dropped
+        self._t0 = time.time()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self._t1 = time.time()
+        if exc and exc[0] is not None:
+            return False
+        from quokka_tpu.obs import merge
+
+        evs = self._rec.snapshot(since=self._since)
+        merged = merge.merge_streams({"local": evs})
+        self.result = analyze(
+            merged, query=self.query, window=(self._t0, self._t1),
+            dropped=max(0, self._rec.dropped - self._drop0))
+        return False

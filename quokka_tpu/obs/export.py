@@ -1,0 +1,263 @@
+"""Prometheus text exposition + the /metrics //status HTTP sidecar.
+
+Renders the typed Registry (obs/metrics.py) in Prometheus text format
+(version 0.0.4) and serves it from a stdlib-only background HTTP server so
+an external scraper can watch a soak or a long-lived QueryService run from
+outside the process:
+
+    GET /metrics   Prometheus text: counters, gauges, histograms
+    GET /status    JSON: live QueryService.stats() (when a service is
+                   attached), process info, recorder drop counter
+
+``QK_METRICS_PORT`` opts in: QueryService starts a sidecar on that port at
+construction and stops it at shutdown (port ``0`` binds an ephemeral port,
+readable from ``server.port`` — what tests use).  No third-party
+dependency: the container has no prometheus_client, and the text format is
+ten lines of escaping rules.
+
+Naming: dotted instrument names sanitize to ``quokka_<name>`` metric
+families.  Per-query/per-site instrument families (``task.latency_s.<qid>``,
+``cache.plan_hit.<qid>``, ``rpc.<method>``, ``chaos.<site>``) render as ONE
+family with a label instead of one family per query — the cardinality lives
+in label values, where Prometheus expects it.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from quokka_tpu.obs import recorder as _recorder
+from quokka_tpu.obs.metrics import REGISTRY, Histogram, Registry
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+# (kind, dotted-prefix, family, label_key).  A name matches when it is the
+# right instrument kind and extends the prefix with a NON-EMPTY suffix; the
+# suffix becomes the label value, so per-query/per-site instruments render
+# as ONE family with a label instead of unbounded family names.
+# INVARIANT: when the runtime also keeps an unlabeled AGGREGATE instrument
+# of a labeled family (observing every event into both), the aggregate
+# needs its own _EXACT_FAMILIES name below — sharing the labeled family
+# would double-count under sum()-style PromQL.
+_LABEL_FAMILIES: Tuple[Tuple[str, str, str, str], ...] = (
+    ("histogram", "task.latency_s.", "quokka_task_latency_seconds", "query"),
+    ("counter", "cache.plan_hit.", "quokka_cache_plan_hit", "query"),
+    ("counter", "cache.plan_miss.", "quokka_cache_plan_miss", "query"),
+    ("counter", "chaos.", "quokka_chaos_injected", "site"),
+    ("counter", "rpc.", "quokka_rpc_calls", "method"),
+)
+
+# Aggregate instruments that ALSO exist as a labeled per-query family: the
+# engine observes every dispatch into both 'task.latency_s' and
+# 'task.latency_s.<qid>' (same for cache.plan_hit/miss).  The aggregate
+# must NOT share the labeled family's name, or sum()-style PromQL over the
+# family double-counts every observation.
+_EXACT_FAMILIES: Dict[Tuple[str, str], str] = {
+    ("histogram", "task.latency_s"): "quokka_task_latency_all_seconds",
+    ("counter", "cache.plan_hit"): "quokka_cache_plan_hit_all",
+    ("counter", "cache.plan_miss"): "quokka_cache_plan_miss_all",
+}
+
+
+def _sanitize(name: str) -> str:
+    out = []
+    for ch in name:
+        out.append(ch if ch.isalnum() or ch in "_:" else "_")
+    s = "".join(out)
+    if s and s[0].isdigit():
+        s = "_" + s
+    return s
+
+
+def escape_label_value(v: str) -> str:
+    """Prometheus label-value escaping: backslash, double quote, newline."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _family(name: str, kind: str) -> Tuple[str, Optional[str]]:
+    """(family_name, label_or_None) for one instrument name."""
+    exact = _EXACT_FAMILIES.get((kind, name))
+    if exact is not None:
+        return exact, None
+    for want_kind, prefix, fam, key in _LABEL_FAMILIES:
+        if (kind == want_kind and name.startswith(prefix)
+                and len(name) > len(prefix)):
+            val = name[len(prefix):]
+            return fam, f'{key}="{escape_label_value(val)}"'
+    if kind == "histogram" and name.endswith("_s"):
+        # seconds-suffix convention: task.latency_s -> ..._latency_seconds
+        return "quokka_" + _sanitize(name[:-2]) + "_seconds", None
+    return "quokka_" + _sanitize(name), None
+
+
+def _fmt(v: float) -> str:
+    if v == float("inf"):
+        return "+Inf"
+    f = float(v)
+    return str(int(f)) if f == int(f) else repr(f)
+
+
+def render(registry: Registry = None,
+           extra_gauges: Optional[Dict[str, float]] = None) -> str:
+    """The /metrics payload.  ``extra_gauges`` lets callers append
+    process-level facts (recorder drops, uptime) without registering
+    instruments."""
+    registry = REGISTRY if registry is None else registry
+    lines: List[str] = []
+    typed: Dict[str, str] = {}   # family -> TYPE already emitted
+
+    def emit(family: str, kind: str, label: Optional[str], value,
+             suffix: str = "", extra_label: str = "") -> None:
+        if typed.get(family) != kind:
+            lines.append(f"# TYPE {family} {kind}")
+            typed[family] = kind
+        labels = ",".join(x for x in (label, extra_label) if x)
+        body = "{" + labels + "}" if labels else ""
+        lines.append(f"{family}{suffix}{body} {_fmt(value)}")
+
+    with registry._lock:
+        counters = {n: c.value for n, c in registry._counters.items()}
+        gauges = {n: g.value for n, g in registry._gauges.items()}
+        histograms = dict(registry._histograms)
+    for name in sorted(counters):
+        fam, label = _family(name, "counter")
+        emit(fam + "_total", "counter", label, counters[name])
+    for name in sorted(gauges):
+        fam, label = _family(name, "gauge")
+        emit(fam, "gauge", label, gauges[name])
+    for name in sorted(histograms):
+        h: Histogram = histograms[name]
+        fam, label = _family(name, "histogram")
+        # one atomic snapshot: bucket{+Inf} == _count must hold per scrape
+        cum, h_sum, h_count = h.snapshot()
+        for bound, acc in cum:
+            emit(fam, "histogram", label, acc, suffix="_bucket",
+                 extra_label=f'le="{_fmt(bound)}"')
+        emit(fam, "histogram", label, h_sum, suffix="_sum")
+        emit(fam, "histogram", label, h_count, suffix="_count")
+    for name in sorted(extra_gauges or {}):
+        emit("quokka_" + _sanitize(name), "gauge", None, extra_gauges[name])
+    return "\n".join(lines) + "\n"
+
+
+class MetricsServer:
+    """Background stdlib HTTP sidecar serving /metrics and /status.
+
+    ``service`` (a QueryService) is optional; without one, /status reports
+    process-level info only.  ``port=0`` binds an ephemeral port (read it
+    back from ``self.port``)."""
+
+    def __init__(self, port: Optional[int] = None, host: str = "127.0.0.1",
+                 service=None, registry: Registry = None):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        if port is None:
+            port = int(os.environ.get("QK_METRICS_PORT", "0"))
+        self.service = service
+        self.registry = REGISTRY if registry is None else registry
+        self._started = time.time()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # scrapes are not diagnostics
+                pass
+
+            def _send(self, code: int, body: bytes, ctype: str) -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                path = self.path.split("?", 1)[0]
+                try:
+                    if path == "/metrics":
+                        self._send(200, outer.metrics_text().encode(),
+                                   CONTENT_TYPE)
+                    elif path == "/status":
+                        self._send(200,
+                                   json.dumps(outer.status(),
+                                              default=repr).encode(),
+                                   "application/json")
+                    else:
+                        self._send(404, b"not found: try /metrics or "
+                                        b"/status\n", "text/plain")
+                except Exception as e:  # noqa: BLE001 — a scrape must not
+                    # take the serving thread down with it; if even the
+                    # 500 cannot be sent the scraper already hung up
+                    with contextlib.suppress(OSError):
+                        self._send(500, repr(e).encode(), "text/plain")
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = int(self._httpd.server_address[1])
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name=f"qk-metrics-{self.port}")
+        self._thread.start()
+
+    # -- payloads -----------------------------------------------------------
+    def metrics_text(self) -> str:
+        return render(self.registry, extra_gauges={
+            "obs_dropped_events": _recorder.RECORDER.dropped,
+            "uptime_seconds": round(time.time() - self._started, 3),
+        })
+
+    def status(self) -> Dict:
+        snap = self.registry.snapshot()
+        out = {
+            "pid": os.getpid(),
+            "time": time.time(),
+            "uptime_s": round(time.time() - self._started, 3),
+            "obs": {
+                "recorder_enabled": _recorder.RECORDER.enabled,
+                "dropped_events": _recorder.RECORDER.dropped,
+                "ring_capacity": _recorder.RECORDER.capacity,
+            },
+            # the counters an operator triages incidents from
+            "integrity_corrupt": snap.get("integrity.corrupt", 0),
+            "chaos": {k.split(".", 1)[1]: v for k, v in snap.items()
+                      if k.startswith("chaos.")},
+        }
+        svc = self.service
+        if svc is not None:
+            try:
+                out["service"] = svc.stats()
+            except Exception as e:  # noqa: BLE001 — a torn-down service
+                out["service"] = {"error": repr(e)}  # must not 500 /status
+        return out
+
+    def url(self, path: str = "/metrics") -> str:
+        return f"http://{self.host}:{self.port}{path}"
+
+    def close(self) -> None:
+        # double-close / already-dead socket is a no-op, not an error
+        with contextlib.suppress(OSError):
+            self._httpd.shutdown()
+            self._httpd.server_close()
+
+    stop = close
+
+
+def start_from_env(service=None) -> Optional[MetricsServer]:
+    """Start a sidecar when ``QK_METRICS_PORT`` is set (any value,
+    including ``0`` for an ephemeral port); None when unset."""
+    port = os.environ.get("QK_METRICS_PORT")
+    if port is None or port.strip() == "":
+        return None
+    try:
+        return MetricsServer(port=int(port), service=service)
+    except (OSError, ValueError) as e:
+        from quokka_tpu import obs
+
+        obs.diag(f"[metrics] sidecar on QK_METRICS_PORT={port!r} failed "
+                 f"to start: {e!r}")
+        return None

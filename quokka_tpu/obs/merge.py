@@ -135,13 +135,21 @@ def stall_report(reason: str,
                  inflight: Dict[int, tuple],
                  ntt_depth: Optional[Dict] = None,
                  now: Optional[float] = None,
-                 last_n: int = 15) -> str:
+                 last_n: int = 15,
+                 dropped: Optional[Dict[str, int]] = None) -> str:
     now = time.time() if now is None else now
     lines = ["==== quokka-tpu stall report ====", f"reason: {reason}",
              f"wall clock: {now:.3f}"]
     stuck = find_stuck(heartbeats, inflight, now)
     lines.append(
         f"verdict: {stuck_headline(stuck, have_heartbeats=bool(heartbeats))}")
+    drops = {p: n for p, n in (dropped or {}).items() if n}
+    if drops:
+        # a wrapped ring means the analysis below is missing its earliest
+        # tail — say so before anyone trusts the timeline
+        lines.append("WARNING: flight-recorder ring(s) dropped events "
+                     "(oldest overwritten; raise QK_TRACE_BUFFER): "
+                     + ", ".join(f"{p}={n}" for p, n in sorted(drops.items())))
     workers = sorted(set(heartbeats) | set(states) | set(inflight))
     lines.append(f"workers ({len(workers)}):")
     for w in workers:
@@ -207,10 +215,12 @@ def dump_flight(reason: str,
                 inflight: Optional[Dict[int, tuple]] = None,
                 ntt_depth: Optional[Dict] = None,
                 directory: Optional[str] = None,
-                echo: bool = True) -> Tuple[str, str, str]:
-    """Write the merged Chrome trace + stall report; returns
-    (trace_path, report_path, one-line headline).  Never raises: a failed
-    dump must not mask the stall it is describing."""
+                echo: bool = True,
+                dropped: Optional[Dict[str, int]] = None) -> Tuple[str, str, str]:
+    """Write the merged Chrome trace + stall report (with per-query
+    critical-path attribution appended); returns (trace_path, report_path,
+    one-line headline).  Never raises: a failed dump must not mask the
+    stall it is describing."""
     heartbeats = heartbeats or {}
     try:
         merged = merge_streams(streams)
@@ -220,12 +230,23 @@ def dump_flight(reason: str,
         trace_path = os.path.join(d, f"flight-{stamp}.trace.json")
         report_path = os.path.join(d, f"flight-{stamp}.report.txt")
         write_chrome_trace(trace_path, merged)
+        if dropped is None:
+            from quokka_tpu.obs.recorder import RECORDER
+
+            dropped = {"local": RECORDER.dropped}
         report = stall_report(reason, merged, heartbeats, states or {},
-                              inflight or {}, ntt_depth)
+                              inflight or {}, ntt_depth, dropped=dropped)
         headline = stuck_headline(find_stuck(heartbeats, inflight or {}),
                                   have_heartbeats=bool(heartbeats))
         with open(report_path, "w", encoding="utf-8") as f:
             f.write(report)
+            # where the wall time of each in-flight query went, so a stall
+            # triage starts from attribution, not from raw events
+            with contextlib.suppress(Exception):
+                from quokka_tpu.obs import critpath as _critpath
+
+                for cp in _critpath.summarize_queries(merged):
+                    f.write(cp.render() + "\n")
             f.write(f"chrome trace: {trace_path} "
                     f"(load at ui.perfetto.dev)\n")
         if echo:

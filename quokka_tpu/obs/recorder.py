@@ -70,6 +70,11 @@ class FlightRecorder:
         self.enabled = recorder_enabled() if enabled is None else enabled
         self._buf: List[Optional[Event]] = [None] * self.capacity
         self._seq = itertools.count()
+        # highest sequence number issued so far; a plain store racing other
+        # recorders only ever reads slightly stale, which a drop COUNTER
+        # tolerates (it exists to say "the ring wrapped, the tail is gone",
+        # not to account bytes)
+        self._last = -1
         # thread name -> (activity, since_ts); plain dict stores are atomic
         # under the GIL and each thread only writes its own key
         self._current: Dict[str, Tuple[str, float]] = {}
@@ -84,7 +89,17 @@ class FlightRecorder:
             i, time.time(), kind, name, float(dur),
             threading.current_thread().name, args or None,
         )
+        if i > self._last:
+            self._last = i
         return i
+
+    @property
+    def dropped(self) -> int:
+        """Events silently overwritten since the last reset: once the ring
+        wraps, every record evicts the oldest event.  Nonzero means a
+        merged timeline / critical-path profile is missing its earliest
+        tail — raise QK_TRACE_BUFFER when it matters."""
+        return max(0, self._last + 1 - self.capacity)
 
     def set_current(self, activity: str) -> None:
         if self.enabled:
@@ -155,6 +170,10 @@ class FlightRecorder:
             stream.write("[flight-recorder] current activity per thread:\n")
             for t, (name, age) in sorted(cur.items()):
                 stream.write(f"  {t}: {name} (for {age:.2f}s)\n")
+        if self.dropped:
+            stream.write(f"[flight-recorder] WARNING: ring dropped "
+                         f"{self.dropped} event(s) (capacity "
+                         f"{self.capacity}; raise QK_TRACE_BUFFER)\n")
         evs = self.snapshot(last_n=last_n)
         stream.write(f"[flight-recorder] last {len(evs)} event(s):\n")
         for (_seq, ts, kind, name, dur, thread, args) in evs:
@@ -166,6 +185,7 @@ class FlightRecorder:
     def reset(self) -> None:
         self._buf = [None] * self.capacity
         self._seq = itertools.count()
+        self._last = -1
         self._current.clear()
 
 

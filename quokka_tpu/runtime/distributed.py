@@ -65,8 +65,11 @@ def _stall_dump(cs: CoordinatorStore, reason: str):
     own, write Chrome trace + stall report into QK_DUMP_DIR, and return
     (trace_path, report_path, one-line headline naming the stuck worker)."""
     heartbeats, states, inflight, ntt_depth = cs.stall_snapshot()
+    dropped = {"coordinator": obs.RECORDER.dropped}
+    for w, st in (states or {}).items():
+        dropped[f"worker-{w}"] = getattr(st, "dropped", 0)
     return obs.dump_flight(reason, _flight_streams(cs), heartbeats, states,
-                           inflight, ntt_depth)
+                           inflight, ntt_depth, dropped=dropped)
 
 
 def _build_spec(graph) -> Dict:

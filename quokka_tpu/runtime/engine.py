@@ -186,7 +186,8 @@ class TaskGraph:
 
             scancache.GLOBAL.drop_query(self.query_id)
             obs.REGISTRY.remove(f"cache.plan_hit.{self.query_id}",
-                                f"cache.plan_miss.{self.query_id}")
+                                f"cache.plan_miss.{self.query_id}",
+                                f"task.latency_s.{self.query_id}")
 
     def _new_actor(self, kind, channels, stage, sorted_actor=False) -> ActorInfo:
         info = ActorInfo(self._next_actor, kind, channels, stage, sorted_actor)
@@ -415,6 +416,25 @@ def _feeds(partitioner, src_ch: int, tgt_ch: int, n_tgt: int) -> bool:
 # _service_prepare holds it across _warm_prefetch -> _ensure_prefetch_pool.
 _LAZY_INIT_LOCK = threading.RLock()
 
+# Per-dispatch observability note (thread-local: service pools dispatch one
+# engine from many threads).  dispatch_task opens a dict, handlers annotate
+# the task's causal identity through it (seqs consumed/produced), and the
+# finished dict rides the task's flight-recorder event — what the
+# critical-path profiler (obs/critpath.py) rebuilds the DAG from.
+_OBS_NOTE = threading.local()
+
+
+def _note(**kw) -> None:
+    d = getattr(_OBS_NOTE, "d", None)
+    if d is not None:
+        d.update(kw)
+
+
+def _note_out(seq: int) -> None:
+    d = getattr(_OBS_NOTE, "d", None)
+    if d is not None:
+        d.setdefault("outs", []).append(seq)
+
 
 class Engine:
     """TaskManager + Coordinator for the embedded runtime."""
@@ -423,6 +443,16 @@ class Engine:
         self.g = graph
         self.store = graph.store
         self.cache = graph.cache
+        # latency histograms resolved ONCE, while the graph is alive: the
+        # observe path must never use a creating registry lookup, or a
+        # dispatch quantum completing after TaskGraph.cleanup would
+        # resurrect the GC'd per-query instrument as a permanent /metrics
+        # leak (observing into the orphaned object instead is harmless)
+        self._lat_hist = obs.REGISTRY.histogram("task.latency_s")
+        qid = getattr(graph, "query_id", None)
+        self._qlat_hist = (
+            obs.REGISTRY.histogram(f"task.latency_s.{qid}")
+            if qid is not None else None)
         self.max_batches = graph.exec_config.get("max_pipeline_batches", 8)
         self.execs: Dict[Tuple[int, int], object] = {}
         self._partition_fns: Dict[Tuple[int, int], Callable] = {}
@@ -493,6 +523,7 @@ class Engine:
 
     # -- push (core.py:276-376) ---------------------------------------------
     def push(self, actor: int, channel: int, seq: int, batch: DeviceBatch) -> None:
+        _note_out(seq)  # producer side of a critical-path data edge
         info = self.g.actors[actor]
         for tgt_actor in info.targets:
             fn = self._partition_fn(actor, tgt_actor)
@@ -709,6 +740,9 @@ class Engine:
             self.store.ntt_push(task.actor, task)
             return False
         src_actor, names = plan
+        # consumer side of the critical-path data edges: which (channel,
+        # seq) batches of src_actor this dispatch consumed
+        _note(src=src_actor, **{"in": [[n[1], n[2]] for n in names]})
         batches = [self.cache.get(n) for n in names]
         stream_id = info.source_streams[src_actor]
         with tracing.span(f"exec.{type(executor).__name__}"):
@@ -1224,10 +1258,16 @@ class Engine:
         loop would otherwise flood the ring and evict the history a stall
         dump needs)."""
         rec = obs.RECORDER
-        if not rec.enabled:
-            return self._dispatch(task)
         qid = getattr(self.g, "query_id", None)
-        qargs = {"q": qid} if qid is not None else {}
+        if not rec.enabled:
+            t0 = time.perf_counter()
+            ok = self._dispatch(task)
+            if ok:
+                self._observe_latency(time.perf_counter() - t0)
+            return ok
+        qargs = {"a": task.actor, "c": task.channel, "k": task.name}
+        if qid is not None:
+            qargs["q"] = qid
         label = f"{task.name}:a{task.actor}c{task.channel}"
         if qid is not None:
             label = f"{qid}:{label}"
@@ -1235,16 +1275,32 @@ class Engine:
         if idle is None:
             idle = self._obs_idle = set()
         key = (task.actor, task.channel, task.name)
+        _OBS_NOTE.d = {}
         t0 = time.perf_counter()
-        with rec.activity("task:" + label):
-            ok = self._dispatch(task)
+        try:
+            with rec.activity("task:" + label):
+                ok = self._dispatch(task)
+        finally:
+            note = getattr(_OBS_NOTE, "d", None) or {}
+            _OBS_NOTE.d = None
         if ok:
-            rec.record("task", label, dur=time.perf_counter() - t0, **qargs)
+            dt = time.perf_counter() - t0
+            rec.record("task", label, dur=dt, **qargs, **note)
+            self._observe_latency(dt)
             idle.discard(key)
         elif key not in idle:
             idle.add(key)
             rec.record("task.wait", label, **qargs)
         return ok
+
+    def _observe_latency(self, dt: float) -> None:
+        """Dispatch latency into the typed histograms (resolved once in
+        __init__): one process-wide family plus a per-query one (GC'd with
+        the query in TaskGraph.cleanup) that service stats() reads p50/p95
+        from."""
+        self._lat_hist.observe(dt)
+        if self._qlat_hist is not None:
+            self._qlat_hist.observe(dt)
 
     def _dispatch(self, task) -> bool:
         if task.name == "input":

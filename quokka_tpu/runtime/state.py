@@ -48,6 +48,10 @@ class WorkerState:
     last_progress: float = 0.0
     queue_hint: int = 0  # locally-known backlog (cached pending batches)
     events_seq: int = -1
+    # flight-recorder events this worker's ring has overwritten (nonzero
+    # means the coordinator's merged timeline is missing this worker's
+    # earliest tail — surfaced as a warning in stall reports)
+    dropped: int = 0
     ts: float = field(default=0.0)
 
 

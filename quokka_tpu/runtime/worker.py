@@ -245,6 +245,7 @@ class Worker(Engine):
             last_progress=getattr(self, "_obs_last_progress", 0.0),
             queue_hint=self.cache.size(),
             events_seq=getattr(self, "_obs_shipped_seq", -1),
+            dropped=obs.RECORDER.dropped,
             ts=now,
         )
 

@@ -131,6 +131,9 @@ class QueryService:
 
         obs.RECORDER.record("service.start", f"pool={self.pool_size}",
                             chaos=CHAOS.describe())
+        # QK_METRICS_PORT: external scrapers watch this service live
+        # (/metrics Prometheus text + /status JSON of stats())
+        self.metrics_server = obs.export.start_from_env(service=self)
 
     # -- client surface ------------------------------------------------------
     def submit(self, stream, *, working_set_bytes: Optional[int] = None,
@@ -183,18 +186,37 @@ class QueryService:
     def stats(self) -> Dict:
         from quokka_tpu.runtime import scancache
 
+        now = time.time()
+        # non-creating lookup: a scrape racing a query's teardown must not
+        # resurrect the just-GC'd per-query histogram (it would leak one
+        # empty labeled family per finished query, forever)
+        hists = obs.REGISTRY.histograms()
         with self._lock:
-            sessions = {
-                qid: {"status": s.status, "est_bytes": s.est_bytes,
-                      "inflight": s.inflight, "handled": s.handled}
-                for qid, s in self._sessions.items()
-            }
+            sessions = {}
+            for qid, s in self._sessions.items():
+                h = hists.get(f"task.latency_s.{qid}")
+                lat = h.stats() if h is not None else \
+                    obs.Histogram.empty_stats()
+                sessions[qid] = {
+                    "status": s.status, "est_bytes": s.est_bytes,
+                    "inflight": s.inflight, "handled": s.handled,
+                    # queue-wait so far (live) or final; task-latency
+                    # quantiles from the per-query histogram
+                    "queue_wait_s": round(
+                        ((s.started_at or now) - s.submitted_at), 6),
+                    "task_p50_s": lat["p50"],
+                    "task_p95_s": lat["p95"],
+                    "tasks": lat["count"],
+                }
         return {
             "pool_size": self.pool_size,
+            "workers_alive": sum(t.is_alive() for t in self._threads),
             "admission": self.admission.stats(),
             "sessions": sessions,  # live only; finished sessions are GC'd
             "finished": self._finished,
             "scan_cache": scancache.GLOBAL.stats(),
+            "queue_wait": obs.REGISTRY.histogram(
+                "admission.queue_wait_s").stats(),
         }
 
     def shutdown(self, timeout: float = 30.0) -> None:
@@ -214,6 +236,8 @@ class QueryService:
                 self.admission.release(s.query_id)
         if self._own_spill:
             shutil.rmtree(self._spill_dir, ignore_errors=True)
+        if self.metrics_server is not None:
+            self.metrics_server.close()
         obs.RECORDER.record("service.stop", "")
 
     close = shutdown
@@ -240,6 +264,8 @@ class QueryService:
                 s.started_at = now
                 s.last_progress = now
                 self._running.append(qid)
+                obs.REGISTRY.histogram("admission.queue_wait_s").observe(
+                    now - s.submitted_at)
                 obs.RECORDER.record("service.admit", qid, q=qid)
             for qid, waited in timed_out:
                 s = self._queued.pop(qid, None)

@@ -51,6 +51,9 @@ class QuerySession:
         # the service's stall detector measures QK_SERVICE_QUERY_TIMEOUT
         # against this (server._worker_loop)
         self.last_progress = time.time()
+        # task-latency quantiles snapshotted at finish (the per-query
+        # histogram GCs with the namespace; the handle keeps answering)
+        self.latency_stats: Optional[Dict] = None
         # fault-injection hook (the test_fault_tolerance.py discipline):
         # {"after_tasks": n, "channels": [(actor, ch), ...]} — consumed once
         self.inject = dict(graph.exec_config.get("inject_failure") or {}) or None
@@ -79,6 +82,12 @@ class QuerySession:
             stats = scancache.GLOBAL.stats()["by_query"].get(self.query_id)
             self.scan_stats = dict(stats) if stats else {"hits": 0,
                                                          "misses": 0}
+            from quokka_tpu import obs
+
+            h = obs.REGISTRY.histograms().get(
+                f"task.latency_s.{self.query_id}")
+            self.latency_stats = (h.stats() if h is not None
+                                  else obs.Histogram.empty_stats())
             try:
                 self.graph.cleanup()  # metrics snapshot + drop_namespace
             except Exception as e:  # noqa: BLE001 — teardown must not kill
@@ -164,6 +173,18 @@ class QueryHandle:
         from quokka_tpu.runtime import scancache
 
         return scancache.GLOBAL.stats()["by_query"].get(self.query_id)
+
+    def latency_stats(self) -> Optional[Dict]:
+        """Per-query task-latency quantiles ({count, sum, p50, p95, p99})
+        — live from the typed histogram while running, snapshotted at
+        finish (the histogram itself GCs with the query's namespace)."""
+        if self._s.latency_stats is not None:
+            return dict(self._s.latency_stats)
+        from quokka_tpu import obs
+
+        h = obs.REGISTRY.histograms().get(
+            f"task.latency_s.{self.query_id}")
+        return h.stats() if h is not None else obs.Histogram.empty_stats()
 
     def timings(self) -> Dict[str, Optional[float]]:
         s = self._s

@@ -1,0 +1,27 @@
+"""QK010 fixture: ad-hoc counter dicts in runtime code (3 findings).
+
+Counters belong in the typed registry (quokka_tpu.obs.REGISTRY) so the
+Prometheus exporter, bench snapshots and stall reports all see them.
+"""
+
+
+class Cache:
+    def __init__(self):
+        self._stats = {"hits": 0, "misses": 0}
+
+    def get(self, key, table):
+        if key in table:
+            self._stats["hits"] += 1  # QK010: += on a counter-named dict
+            return table[key]
+        self._stats["misses"] += 1  # QK010
+        return None
+
+
+def account(metrics, kind):
+    # QK010: read-modify-write counter via .get
+    metrics[kind] = metrics.get(kind, 0) + 1
+
+
+def fine(log, sizes, k):
+    log[k] = log.get(k, 0) + 1  # receiver is not counter-named: not flagged
+    sizes[k] = 7  # plain store, not an increment: not flagged

@@ -1,0 +1,175 @@
+"""Critical-path profiler: known-answer reconstruction on a hand-built
+synthetic trace, wall-clock reconciliation on a real query, and the
+flight-recorder drop counter surfacing (ISSUE 5)."""
+
+import io
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from quokka_tpu import QuokkaContext, obs
+from quokka_tpu.obs import critpath
+from quokka_tpu.obs.recorder import FlightRecorder
+
+
+def _ev(seq, ts, kind, name, dur=0.0, thread="T", args=None):
+    return (seq, ts, kind, name, dur, thread, args)
+
+
+def _synthetic_stream():
+    """One query, three tasks, hand-placed gaps — every bucket knowable.
+
+    input a0c0   [100.0, 100.5]  spans: reader 0.3 + bridge 0.1, rest 0.1
+      (0.2 gap: inputs ready, waiting for a slot -> queue_wait)
+    exec  a1c0   [100.7, 101.0]  span exec.* 0.25, rest 0.05
+      (0.4 gap with a task.wait marker for a2 -> stall)
+    exec  a2c0   [101.4, 101.5]  no spans -> other
+    """
+    q = {"q": "q1"}
+    return [
+        _ev(0, 100.3, "span", "reader.execute", 0.3),
+        _ev(1, 100.4, "span", "bridge.to_device", 0.1),
+        _ev(2, 100.5, "task", "q1:input:a0c0", 0.5,
+            args={**q, "a": 0, "c": 0, "k": "input", "outs": [0]}),
+        _ev(3, 100.95, "span", "exec.GroupAgg", 0.25),
+        _ev(4, 101.0, "task", "q1:exec:a1c0", 0.3,
+            args={**q, "a": 1, "c": 0, "k": "exec", "src": 0,
+                  "in": [[0, 0]], "outs": [0]}),
+        _ev(5, 101.2, "task.wait", "q1:exec:a2c0", 0.0,
+            args={**q, "a": 2, "c": 0, "k": "exec"}),
+        _ev(6, 101.5, "task", "q1:exec:a2c0", 0.1,
+            args={**q, "a": 2, "c": 0, "k": "exec", "src": 1,
+                  "in": [[0, 0]]}),
+    ]
+
+
+class TestSyntheticKnownAnswer:
+    def test_buckets_and_path(self):
+        merged = obs.merge_streams({"w0": _synthetic_stream()})
+        cp = critpath.analyze(merged)
+        assert cp is not None and cp.query == "q1"
+        assert [s["label"] for s in cp.path] == [
+            "q1:input:a0c0", "q1:exec:a1c0", "q1:exec:a2c0"]
+        b = cp.buckets
+        assert b["scan_read"] == pytest.approx(0.3, abs=1e-9)
+        assert b["transfer"] == pytest.approx(0.1, abs=1e-9)
+        assert b["compute"] == pytest.approx(0.25, abs=1e-9)
+        assert b["queue_wait"] == pytest.approx(0.2, abs=1e-9)
+        assert b["stall"] == pytest.approx(0.4, abs=1e-9)  # task.wait gap
+        assert b["other"] == pytest.approx(0.25, abs=1e-9)
+        assert b["compile"] == 0.0 and b["recovery"] == 0.0
+        # the partition property: buckets sum EXACTLY to the window
+        assert sum(b.values()) == pytest.approx(cp.wall_s, abs=1e-9)
+        assert cp.wall_s == pytest.approx(1.5, abs=1e-9)
+
+    def test_compile_overlap_claims_gap(self):
+        evs = _synthetic_stream()
+        # a 0.3s backend compile inside the 0.4s stall gap -> compile wins
+        evs.insert(5, _ev(10, 101.3, "compile", "backend_compile", 0.3))
+        cp = critpath.analyze(obs.merge_streams({"w0": evs}))
+        assert cp.buckets["compile"] == pytest.approx(0.3, abs=1e-9)
+        assert cp.buckets["stall"] == pytest.approx(0.1, abs=1e-9)
+        assert sum(cp.buckets.values()) == pytest.approx(cp.wall_s, abs=1e-9)
+
+    def test_recovery_task_buckets_whole(self):
+        evs = _synthetic_stream()
+        evs.append(_ev(7, 101.8, "task", "q1:exectape:a2c0", 0.2,
+                       args={"q": "q1", "a": 2, "c": 0, "k": "exectape"}))
+        cp = critpath.analyze(obs.merge_streams({"w0": evs}))
+        assert cp.buckets["recovery"] == pytest.approx(0.2, abs=1e-9)
+        assert cp.path[-1]["label"] == "q1:exectape:a2c0"
+
+    def test_query_filter_and_render(self):
+        evs = _synthetic_stream() + [
+            _ev(20, 100.9, "task", "q2:input:a0c0", 0.1,
+                args={"q": "q2", "a": 0, "c": 0, "k": "input"})]
+        merged = obs.merge_streams({"w0": evs})
+        cp = critpath.analyze(merged, query="q1")
+        assert cp.n_tasks == 3  # the q2 neighbor is excluded
+        text = cp.render()
+        assert "critical path: query q1" in text
+        assert "queue_wait" in text and "stall" in text
+        js = cp.to_json()
+        assert js["bucket_sum_s"] == pytest.approx(js["wall_s"], abs=1e-6)
+        # majority-query selection without an explicit filter
+        assert critpath.analyze(merged).query == "q1"
+
+    def test_overlapping_cross_process_tasks_still_partition(self):
+        """Cross-process chains can OVERLAP in time (the consumer pops a
+        pushed batch before the producer's task event lands): the overlap
+        must be attributed once, keeping bucket sum == window."""
+        streams = {
+            "w0": [_ev(0, 101.0, "task", "q1:input:a0c0", 1.0,
+                       args={"q": "q1", "a": 0, "c": 0, "k": "input",
+                             "outs": [0]})],
+            "w1": [_ev(0, 101.3, "task", "q1:exec:a1c0", 0.4,
+                       args={"q": "q1", "a": 1, "c": 0, "k": "exec",
+                             "src": 0, "in": [[0, 0]]})],
+        }
+        cp = critpath.analyze(obs.merge_streams(streams))
+        # consumer starts 100.9, producer ends 101.0: 0.1s overlap
+        assert cp.wall_s == pytest.approx(1.3, abs=1e-9)
+        assert sum(cp.buckets.values()) == pytest.approx(1.3, abs=1e-9)
+        assert cp.buckets["other"] == pytest.approx(1.3, abs=1e-9)
+        assert len(cp.path) == 2 and cp.path[1]["gap_s"] == 0.0
+
+    def test_no_task_events_returns_none(self):
+        merged = obs.merge_streams({"w0": [_ev(0, 1.0, "hb", "w")]})
+        assert critpath.analyze(merged) is None
+
+    def test_summarize_queries_orders_by_volume(self):
+        evs = _synthetic_stream() + [
+            _ev(20, 100.9, "task", "q2:input:a0c0", 0.1,
+                args={"q": "q2", "a": 0, "c": 0, "k": "input"})]
+        cps = critpath.summarize_queries(obs.merge_streams({"w0": evs}))
+        assert [c.query for c in cps] == ["q1", "q2"]
+
+
+class TestEndToEnd:
+    def test_real_query_buckets_reconcile_with_wall(self):
+        import time
+
+        r = np.random.default_rng(0)
+        t = pa.table({"k": r.integers(0, 16, 50_000).astype(np.int64),
+                      "v": r.integers(0, 100, 50_000).astype(np.int64)})
+        ctx = QuokkaContext()
+        q = lambda: (ctx.from_arrow(t).groupby("k")  # noqa: E731
+                     .agg_sql("sum(v) as sv").collect())
+        q()  # warm the kernel set: compiles are not what this test times
+        t0 = time.time()
+        with critpath.profile() as p:
+            df = q()
+        wall = time.time() - t0
+        assert len(df) > 0
+        cp = p.result
+        assert cp is not None, "recorder must be on by default"
+        total = sum(cp.buckets.values())
+        # ISSUE 5 acceptance: bucket sums within 10% of measured wall time
+        assert abs(total - wall) <= 0.1 * wall, (total, wall, cp.buckets)
+        assert cp.n_path > 0
+        assert cp.buckets["compute"] + cp.buckets["scan_read"] > 0
+
+
+class TestDroppedCounter:
+    def test_ring_overwrite_counts_drops(self):
+        rec = FlightRecorder(capacity=16, enabled=True)
+        assert rec.dropped == 0
+        for i in range(40):
+            rec.record("k", f"e{i}")
+        assert rec.dropped == 24  # 40 recorded - 16 retained
+        out = io.StringIO()
+        rec.dump_text(out)
+        assert "dropped 24 event(s)" in out.getvalue()
+        rec.reset()
+        assert rec.dropped == 0
+
+    def test_stall_report_warns_on_drops(self):
+        merged = obs.merge_streams({"w0": _synthetic_stream()})
+        report = obs.stall_report("test", merged, {}, {}, {},
+                                  dropped={"w0": 7, "w1": 0})
+        assert "WARNING" in report and "w0=7" in report
+        assert "w1" not in report.split("WARNING")[1].splitlines()[0]
+        clean = obs.stall_report("test", merged, {}, {}, {},
+                                 dropped={"w0": 0})
+        assert "WARNING: flight-recorder" not in clean

@@ -142,6 +142,26 @@ class TestConcurrentExecution:
                         if isinstance(k, tuple)) for h in handles]
             assert len({r for r in rows if r > 0}) <= 1 and rows[0] > 0
 
+    def test_stats_expose_latency_quantiles(self, tpch_paths):
+        """ISSUE 5 satellite: stats()/handles carry per-query p50/p95 task
+        latency + queue wait from the typed histograms (not just
+        status/bytes), and the snapshot survives the namespace GC."""
+        with QueryService(pool_size=2) as svc:
+            h = svc.submit(q1_stream(QuokkaContext(), tpch_paths))
+            h.wait(300)
+            lat = h.latency_stats()
+            assert lat["count"] > 0
+            assert lat["p50"] > 0 and lat["p95"] >= lat["p50"]
+            st = svc.stats()
+            assert st["workers_alive"] == 2
+            assert st["queue_wait"]["count"] >= 1  # admission wait observed
+        # the per-query histogram is GC'd with the query's namespace...
+        from quokka_tpu import obs
+
+        assert f"task.latency_s.{h.query_id}" not in obs.REGISTRY.histograms()
+        # ...but the handle still answers from its finish-time snapshot
+        assert h.latency_stats()["count"] == lat["count"]
+
     def test_scan_cache_warm_across_queries(self, tpch_paths):
         with QueryService(pool_size=2) as svc:
             h1 = svc.submit(q1_stream(QuokkaContext(), tpch_paths))
