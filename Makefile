@@ -4,7 +4,7 @@
 PY ?= python
 export JAX_PLATFORMS ?= cpu
 
-.PHONY: lint lint-baseline test test-slow sanitize-demo service-smoke chaos-smoke obs-smoke bench-check
+.PHONY: lint lint-baseline test test-slow sanitize-demo service-smoke chaos-smoke obs-smoke bench-check shuffle-smoke
 
 # engine-invariant static analysis; exits nonzero on findings beyond the
 # checked-in baseline (quokka_tpu/analysis/baseline.json)
@@ -53,6 +53,13 @@ obs-smoke:
 # the regressed queries' critical-path diffs.
 bench-check:
 	$(PY) bench.py --check $(CHECK_ARGS)
+
+# shuffle data-plane smoke: a seeded Q3-shaped join+aggregate (two hash
+# exchanges) run twice; the warm run must show ZERO blocking host readbacks
+# on the push path (shuffle.host_syncs flat) and ZERO real recompiles (the
+# sanitizer sentinel), with nonzero shuffle.bytes proving the exchange ran
+shuffle-smoke:
+	$(PY) -m quokka_tpu.runtime.shuffle_smoke
 
 # chaos plane soak: >= 20 seeded mixed-fault runs (RPC drops/delays, flaky
 # store calls, worker kills, spill + checkpoint corruption) each asserting

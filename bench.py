@@ -392,11 +392,14 @@ def measure_service(paths, smoke=False):
     sys.stdout.flush()
     return geomean
 
-# span-name prefix -> breakdown bucket (obs/spans.py names)
+# span-name prefix -> breakdown bucket (obs/spans.py names).  push./spill.
+# are TRANSFER (partition push bookkeeping + HBQ spill d2h/write), matching
+# the critical-path profiler's attribution (obs/critpath.py) so the two
+# reports agree on where exchange time goes.
 _BUCKET_PREFIXES = (
     (("reader.", "prefetch"), "read_s"),
-    (("bridge.", "emit.", "count_valid"), "transfer_s"),
-    (("exec.", "done.", "push.", "source."), "compute_s"),
+    (("bridge.", "emit.", "push.", "spill.", "count_valid"), "transfer_s"),
+    (("exec.", "done.", "source."), "compute_s"),
 )
 
 
@@ -448,10 +451,18 @@ def measure(paths):
     trace_print = obs_spans.enabled()
     obs_spans.set_enabled(True)
     obs_per_query = {}
+    from quokka_tpu import obs as qk_obs
+
+    def _shuffle_snap():
+        snap = qk_obs.REGISTRY.snapshot()
+        return {k: snap.get(k, 0) for k in
+                ("shuffle.bytes", "shuffle.host_syncs", "shuffle.spill_bytes")}
+
     for qname, fn in QUERIES.items():
         ref = REF_SECONDS_SF100_4W[qname] * 4.0 / 100.0 * SF
         obs_spans.reset()
         c0 = compilestats.snapshot()
+        sh0 = _shuffle_snap()
         warm = fn(paths)  # compiles the kernel set for this query shape
         extra = {}
         if qname == "q1":
@@ -484,6 +495,7 @@ def measure(paths):
         # transfer/compute/queue/stall buckets (obs/critpath.py)
         from quokka_tpu.obs import critpath as obs_critpath
 
+        sh1 = _shuffle_snap()
         times = [fn(paths) for _ in range(2)]
         with obs_critpath.profile() as _prof:
             times.append(fn(paths))
@@ -500,6 +512,15 @@ def measure(paths):
             crit_line = None
         times = sorted(times)
         c2 = compilestats.snapshot()
+        sh2 = _shuffle_snap()
+        # shuffle volume of the 3 timed runs (counter deltas): bytes through
+        # fan-out>1 exchanges, blocking host readbacks on the partition
+        # path, and spilled bytes (0 without fault tolerance)
+        shuffle_detail = {
+            "warmup": {k.split(".", 1)[1]: int(sh1[k] - sh0[k]) for k in sh0},
+            "per_timed_run": {k.split(".", 1)[1]: int((sh2[k] - sh1[k]) / 3)
+                              for k in sh0},
+        }
         t = times[0]
         speedup = ref / t
         spans_timed = obs_spans.stats()
@@ -538,6 +559,7 @@ def measure(paths):
             ),
             "cache_hits_warmup": c1["cache_hits"] - c0["cache_hits"],
             "breakdown": breakdown,
+            "shuffle": shuffle_detail,
             "critpath": crit_line,
             **extra,
         }

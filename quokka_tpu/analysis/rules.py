@@ -36,6 +36,14 @@ Rule ids:
                                 counters must go through the typed
                                 obs.REGISTRY so the Prometheus exporter,
                                 bench snapshots and /status see them
+  QK011 push-path-host-sync     blocking host readbacks (np.asarray /
+                                .item() / device_get / block_until_ready /
+                                .tolist()) reachable from the shuffle push
+                                path (Engine.push, the lowered partition
+                                fns, split_by_partition) — the exchange
+                                critical path must never drain the device
+                                pipeline; deliberate readbacks carry
+                                baseline rationales
 
 Finding keys (``Finding.key``) are line-number-free — ``rule::relpath::
 scope::snippet[::n]`` — so a baseline survives unrelated edits above the
@@ -986,6 +994,99 @@ def check_adhoc_counter_dict(tree: ast.Module, path: str, rel: str,
     return out
 
 
+# ---------------------------------------------------------------------------
+# QK011 — blocking host readbacks on the shuffle push path
+# ---------------------------------------------------------------------------
+
+# Function names that ARE the shuffle push path: Engine.push, the partition-
+# fn lowering (and the closures it builds), the range splitter and the
+# multi-partition kernels.  The rule walks same-module reachability from
+# these (simple-name call edges + nested defs), like QK004 does from jit
+# entry points.  _spill_one is deliberately NOT an entry: it is the
+# background spill worker, whose whole job is an off-critical-path d2h.
+_PUSH_PATH_ENTRY_FUNCS = (
+    "push", "_partition_fn", "_range_split",
+    "split_by_partition", "partition_ids",
+)
+# the readback shapes banned on the push path (host round trips / pipeline
+# drains); scalar int()/float() conversions are NOT flagged here — the push
+# path legitimately converts host-side plan metadata (e.g. range boundaries)
+_PUSH_SYNC_TAILS = ("asarray", "item", "tolist", "device_get",
+                    "block_until_ready")
+
+
+def check_push_path_host_sync(tree: ast.Module, path: str, rel: str,
+                              src_lines: Sequence[str]) -> List[Finding]:
+    """The shuffle push path (Engine.push -> partition fn -> split kernels)
+    is the producer's hot loop: a blocking host readback there drains the
+    whole queued device pipeline once per batch per edge — exactly the
+    stall the device-resident data plane removed.  Flags np.asarray/.item()/
+    .tolist()/device_get/block_until_ready in functions reachable from the
+    push-path entry set; the deliberate sites (e.g. the compacted split's
+    bucket-sizing counts readback, whose async host copy starts at plan
+    dispatch) carry baseline rationales."""
+    fns: Dict[str, ast.FunctionDef] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fns.setdefault(node.name, node)
+    entries = [n for n in _PUSH_PATH_ENTRY_FUNCS if n in fns]
+    if not entries:
+        return []
+    reachable: Set[str] = set()
+    frontier = list(entries)
+    while frontier:
+        name = frontier.pop()
+        if name in reachable:
+            continue
+        reachable.add(name)
+        fn = fns[name]
+        frontier.extend(_callees(fn, fns) - reachable)
+        # closures built by an entry run on the push path too (the lowered
+        # partition fn is a nested def inside _partition_fn)
+        for sub in ast.walk(fn):
+            if (isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and sub is not fn and sub.name in fns
+                    and fns[sub.name] is sub):
+                frontier.append(sub.name)
+
+    out: List[Finding] = []
+    for name in sorted(reachable):
+        for node in ast.walk(fns[name]):
+            if not isinstance(node, ast.Call):
+                continue
+            d = _dotted(node.func)
+            if d is None:
+                # chained-call receivers (x.sum().item()) defeat _dotted;
+                # the attribute tail alone decides for the no-base shapes
+                if (isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _PUSH_SYNC_TAILS
+                        and node.func.attr != "asarray"):
+                    d = f"...{node.func.attr}"
+                    tail = node.func.attr
+                else:
+                    continue
+            else:
+                base, _, tail = d.rpartition(".")
+                if tail not in _PUSH_SYNC_TAILS:
+                    continue
+                # jnp.asarray is an h2d upload, not a readback; np/numpy/
+                # bare asarray (and any-receiver .item()/.tolist()/
+                # device_get/block_until_ready) are the blocking shapes
+                if tail == "asarray" and base not in ("np", "numpy", "onp",
+                                                      ""):
+                    continue
+            scope = _scope_of(tree, node)
+            out.append(_mk(
+                "QK011", "push-path-host-sync", path, rel, node, scope,
+                f"'{d}(...)' inside '{scope}' (reachable from the shuffle "
+                "push path) blocks on a device->host readback, draining "
+                "the queued pipeline once per batch per edge — keep the "
+                "push path sync-free (async counts / masked views / "
+                "background spill), or baseline with a rationale",
+                src_lines))
+    return out
+
+
 RULES = (
     check_module_level_jit,
     check_import_time_side_effects,
@@ -997,6 +1098,7 @@ RULES = (
     check_global_config_mutation,
     check_unbounded_io,
     check_adhoc_counter_dict,
+    check_push_path_host_sync,
 )
 
 

@@ -175,6 +175,24 @@ DEFAULT_EXEC_CONFIG = {
 # Thresholds are ROWS accumulated before an operator switches to disk; the
 # defaults keep small queries fully in memory.  Tests lower them to force the
 # spill paths on tiny data.
+# ---------------------------------------------------------------------------
+# Shuffle data plane
+# ---------------------------------------------------------------------------
+# Masked-split cap: a partition split stays in masked-view mode (zero host
+# syncs, shared column buffers) while n_parts * padded_len is at or below
+# this; past it the one-kernel compacted split runs instead (bounds the
+# downstream padded-row inflation for very wide fan-outs).
+SHUFFLE_MASKED_CAP = int(os.environ.get("QUOKKA_SHUFFLE_MASKED_CAP", 1 << 25))
+# Async HBQ spill (Engine.push): background threads doing the device->host
+# copy + checksummed disk write off the critical path.  QK_SPILL_ASYNC=0
+# restores the old synchronous spill; QK_SPILL_POOL sizes the thread pool
+# (1 keeps spill-file write order identical to submission order, which the
+# seeded chaos corruption streams key off); QK_SPILL_INFLIGHT bounds the
+# device batches pinned by pending spills.
+SPILL_ASYNC = os.environ.get("QK_SPILL_ASYNC", "1") not in ("0", "false", "no")
+SPILL_POOL = int(os.environ.get("QK_SPILL_POOL", "1"))
+SPILL_INFLIGHT = int(os.environ.get("QK_SPILL_INFLIGHT", "4"))
+
 SPILL_SORT_ROWS = int(os.environ.get("QUOKKA_TPU_SPILL_SORT_ROWS", 1 << 22))
 SPILL_MERGE_CHUNK_ROWS = int(os.environ.get("QUOKKA_TPU_SPILL_CHUNK_ROWS", 1 << 16))
 SPILL_JOIN_BUILD_ROWS = int(os.environ.get("QUOKKA_TPU_SPILL_JOIN_ROWS", 1 << 22))

@@ -22,6 +22,29 @@ from quokka_tpu.ops.expr_compile import AggPlan, evaluate_predicate, evaluate_to
 from quokka_tpu.executors.base import Executor
 
 
+def _coalesce(live: List[DeviceBatch],
+              cap_rows: int = 1 << 22) -> List[DeviceBatch]:
+    """Concat a dispatch's ready batches into few compacted batches so the
+    per-batch kernel chains (group-by sort, join probe) run once over a
+    bucketed whole instead of once per per-partition slice.  Bounded by
+    accumulated PADDED rows so one group can never overflow MAX_BUCKET (or
+    spike device memory) regardless of how many batches the planner
+    delivered."""
+    if len(live) <= 1:
+        return live
+    groups: List[List[DeviceBatch]] = []
+    cur: List[DeviceBatch] = []
+    acc = 0
+    for b in live:
+        if cur and acc + b.padded_len > cap_rows:
+            groups.append(cur)
+            cur, acc = [], 0
+        cur.append(b)
+        acc += b.padded_len
+    groups.append(cur)
+    return [bridge.concat_batches(g) if len(g) > 1 else g[0] for g in groups]
+
+
 class UDFExecutor(Executor):
     """Stateless per-batch transform (DataStream.transform)."""
 
@@ -197,9 +220,13 @@ class PartialAggExecutor(Executor):
 
     def execute(self, batches, stream_id, channel):
         outs = []
-        for b in batches:
-            if b is None:
-                continue
+        live = [b for b in batches if b is not None]
+        if not self._passthrough:
+            # one group-by over the dispatch's bucketed whole instead of a
+            # sort per per-partition batch; deterministic under tape replay
+            # (the same recorded batch set coalesces identically)
+            live = _coalesce(live)
+        for b in live:
             if self._passthrough:
                 outs.append(self._partial_form(b))
                 continue
@@ -421,9 +448,17 @@ class BuildProbeJoinExecutor(Executor):
                     self._spill(b, "build", self.right_on)
                 return None
             self.build_parts.extend(live)
-            self._build_rows += sum(b.count_valid() for b in live)
+            # padded length is a free upper bound on live rows: the real
+            # counts (a blocking device read per batch when the producer
+            # filtered device-side) are only paid once the bound crosses
+            # the spill threshold
+            self._build_rows += sum(b.padded_len for b in live)
             if self._build_rows > self.spill_rows:
-                self._enter_disk_mode()
+                rows = sum(b.count_valid() for b in self.build_parts)
+                if rows > self.spill_rows:
+                    self._enter_disk_mode()
+                else:
+                    self._build_rows = rows
             return None
         if self._disk:
             for b in live:
@@ -460,7 +495,11 @@ class BuildProbeJoinExecutor(Executor):
         if self._spill_dir is None:
             self._spill_dir = _new_spill_dir("join-")
         pids = kernels.partition_ids(batch, list(keys), self.fanout)
-        for p, part in enumerate(kernels.split_by_partition(batch, pids, self.fanout)):
+        # compacted split: each partition converts to Arrow right here, so
+        # masked views would pay fanout-times the d2h bytes
+        for p, part in enumerate(
+                kernels.split_by_partition(batch, pids, self.fanout,
+                                           compact=True)):
             if part.count_valid() == 0:
                 continue
             table = bridge.device_to_arrow(part)
@@ -543,6 +582,11 @@ class BuildProbeJoinExecutor(Executor):
     def _probe(self, live):
         if self.build is None and self.build_parts:
             self._finalize_build(live[0].names)
+        # vectorized probe pipeline: the dispatch's whole ready set flows
+        # through ONE bucketed join call instead of one kernel chain per
+        # per-partition batch (their async live counts have landed by now,
+        # so the concat compacts without blocking round trips)
+        live = _coalesce(live)
         if self.build is None:
             # No build batch ever arrived on this channel.  Engine.push always
             # delivers every hash partition (even zero-valid ones), so this

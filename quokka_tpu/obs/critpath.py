@@ -40,10 +40,14 @@ from typing import Dict, List, Optional, Sequence, Tuple
 BUCKETS = ("compile", "scan_read", "transfer", "compute",
            "queue_wait", "stall", "recovery", "other")
 
-# span-name prefix -> bucket, for spans nested inside a task's interval
+# span-name prefix -> bucket, for spans nested inside a task's interval.
+# "spill." (HBQ spill: d2h copy + checksummed write) is TRANSFER, not
+# compute — it moves bytes off-device; since the async spill pool it runs
+# on its own thread, so what remains inside task intervals is genuine
+# barrier time (flush at checkpoint/recovery boundaries).
 _SPAN_BUCKETS = (
     (("reader.", "prefetch"), "scan_read"),
-    (("bridge.", "emit.", "push.", "count_valid"), "transfer"),
+    (("bridge.", "emit.", "push.", "spill.", "count_valid"), "transfer"),
     (("exec.", "done.", "source."), "compute"),
 )
 

@@ -1,4 +1,4 @@
-"""Arrow <-> device bridge.
+"""Arrow <-> device bridge (+ device batch concat).
 
 Converts pyarrow Tables (what readers produce and writers consume) into
 DeviceBatch (what kernels consume).  Mirrors the role Polars conversion plays
@@ -12,8 +12,10 @@ signed-int32 lexicographic (hi, lo) order equals numeric order).
 
 from __future__ import annotations
 
+import functools
 from typing import List, Optional, Sequence
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pyarrow as pa
@@ -332,6 +334,9 @@ def concat_batches(batches: Sequence[DeviceBatch]) -> DeviceBatch:
     names = batches[0].names
     total = sum(b.count_valid() for b in batches)
     padded = config.bucket_size(total)
+    fused = _try_fused_concat(batches, total, padded)
+    if fused is not None:
+        return fused
     # compact each batch first (gather valid rows), then concat + pad
     from quokka_tpu.ops import kernels
 
@@ -371,6 +376,85 @@ def concat_batches(batches: Sequence[DeviceBatch]) -> DeviceBatch:
     valid = jnp.arange(padded) < total
     sorted_by = batches[0].sorted_by
     return DeviceBatch(out_cols, valid, nrows=total, sorted_by=sorted_by)
+
+
+@functools.partial(jax.jit, static_argnames=("out_padded",))
+def _fused_concat_kernel(part_arrays, valids, out_padded: int):
+    """One XLA program for the whole compact-concat: stack validity, gather
+    the live rows of every column to the front of one bucketed output.
+    ``part_arrays``: per column, the tuple of per-part arrays.  Replaces the
+    eager per-part compact + per-column concat chain (dozens of dispatches
+    and intermediate buffers per call) that dominated the vectorized
+    probe/aggregate pipelines' host overhead."""
+    vcat = jnp.concatenate(valids)
+    idx = jnp.nonzero(vcat, size=out_padded, fill_value=0)[0]
+    live = jnp.arange(out_padded) < jnp.sum(vcat.astype(jnp.int32))
+    outs = []
+    for arrays in part_arrays:
+        g = jnp.concatenate(arrays)[idx]
+        # zero the invalid tail (nonzero's fill duplicates row 0 there):
+        # downstream sort-segmented kernels key off raw limb values and a
+        # duplicated real key could extend a segment into the padding
+        m = live if g.ndim == 1 else live[:, None]
+        outs.append(jnp.where(m, g, jnp.zeros((), g.dtype)))
+    return tuple(outs), live
+
+
+def _try_fused_concat(batches, total: int, padded: int):
+    """Fused compact-concat when every column concatenates as plain device
+    arrays: NumCol limbs align, StrCol codes remap on host first (dict
+    merge), VecCol joins the fast path via its 2D data.  Returns None when
+    a column mix needs the general path."""
+    names = batches[0].names
+    per_col = []  # (name, kind-tuple) with per-part arrays
+    str_meta = {}
+    for name in names:
+        cols = [b.columns[name] for b in batches]
+        if isinstance(cols[0], StrCol):
+            merged, remaps = merge_dicts([c.dictionary for c in cols])
+            parts = []
+            for c, remap in zip(cols, remaps):
+                codes = c.codes
+                if remap is not None:
+                    remapped = jnp.asarray(remap)[jnp.maximum(codes, 0)]
+                    codes = jnp.where(codes < 0, -1, remapped)
+                parts.append(codes)
+            per_col.append((name, "str", tuple(parts)))
+            str_meta[name] = merged
+        elif isinstance(cols[0], VecCol):
+            if len({c.dim for c in cols}) != 1:
+                return None
+            per_col.append((name, "vec", tuple(c.data for c in cols)))
+        else:
+            cols = _align_limbs(cols)
+            if len({c.data.dtype for c in cols}) != 1:
+                return None  # mixed narrow dtypes: general path promotes
+            per_col.append((name, "num", tuple(c.data for c in cols)))
+            if cols[0].hi is not None:
+                per_col.append((name + "\0hi", "hi",
+                                tuple(c.hi for c in cols)))
+            str_meta[name] = cols[0]  # aligned kind/unit source
+    valids = tuple(jnp.asarray(b.valid) for b in batches)
+    outs, valid = _fused_concat_kernel(
+        tuple(arrs for (_n, _k, arrs) in per_col), valids, padded)
+    out_cols = {}
+    it = iter(zip(per_col, outs))
+    pending_hi = {}
+    for (name, kind, _arrs), arr in it:
+        if kind == "str":
+            out_cols[name] = StrCol(arr, str_meta[name])
+        elif kind == "vec":
+            out_cols[name] = VecCol(arr)
+        elif kind == "hi":
+            pending_hi[name[:-3]] = arr
+        else:
+            src = str_meta[name]
+            out_cols[name] = NumCol(arr, src.kind, unit=src.unit)
+    for name, hi in pending_hi.items():
+        c = out_cols[name]
+        out_cols[name] = NumCol(c.data, c.kind, hi=hi, unit=c.unit)
+    return DeviceBatch(out_cols, valid, nrows=total,
+                       sorted_by=batches[0].sorted_by)
 
 
 def _concat_batches_device(batches: Sequence[DeviceBatch]) -> DeviceBatch:

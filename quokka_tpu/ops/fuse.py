@@ -185,7 +185,11 @@ class FusedPartialAgg:
 
     def _small_dims(self, batch: DeviceBatch):
         """Per-key bucket counts (dict size + a null slot) when the small-key
-        path applies, else None."""
+        path applies, else None.  Dims are CANONICALIZED to the next power
+        of two: raw dictionary sizes vary per file/batch, and keying the
+        fused program on the exact size would recompile the whole small-key
+        program every time a scan chunk's dictionary grows by one entry —
+        the bucket ladder discipline, applied to the signature space."""
         if not self.keys:
             return None
         if not all(isinstance(batch.columns[k], StrCol) for k in self.keys):
@@ -193,7 +197,8 @@ class FusedPartialAgg:
         if not all(op in ("sum", "count") for _, op, _ in self.plan.partials):
             return None
         dims = tuple(
-            len(batch.columns[k].dictionary.values) + 1 for k in self.keys
+            _pow2(len(batch.columns[k].dictionary.values) + 1)
+            for k in self.keys
         )
         n_buckets = int(np.prod(dims)) + 1  # + the invalid-row dump bucket
         itemsize = 8 if config.x64_enabled() else 4
@@ -476,6 +481,10 @@ class FusedPartialAgg:
             return (*outs, _pad_tail(rep_d, out_pad), num)
 
         return fused
+
+
+def _pow2(n: int) -> int:
+    return 1 << max(0, int(n - 1)).bit_length()
 
 
 def _pad_tail(arr, padded):

@@ -42,6 +42,11 @@ def _nonnull_valid(batch: DeviceBatch, keys) -> jax.Array:
     return v
 
 
+@jax.jit
+def _count_true(mask: jax.Array):
+    return jnp.sum(mask.astype(jnp.int32))
+
+
 def _concat_limbs(probe: DeviceBatch, build: DeviceBatch, probe_keys, build_keys):
     lp = key_limbs(probe, probe_keys)
     lb = key_limbs(build, build_keys)
@@ -194,7 +199,12 @@ def hash_join_pk(
         out_valid = probe.valid
     else:
         raise ValueError(f"how={how}")
-    return DeviceBatch(cols, out_valid, None, probe.sorted_by)
+    # start the output count's async host copy now: downstream consumers
+    # (partial agg, storage filters, concat compaction) read it batches
+    # later, when it has long landed — instead of paying a fresh device
+    # round trip each
+    return DeviceBatch(cols, out_valid, None, probe.sorted_by).note_count(
+        _count_true(out_valid))
 
 
 @functools.partial(jax.jit, static_argnames=("p",))
@@ -284,7 +294,9 @@ def hash_join_general(
         if how == "left":
             taken = with_nulls(taken, unmatched)
         cols[name] = taken
-    return DeviceBatch(cols, out_valid, ntotal if how == "inner" else None, None)
+    # out_valid = (iota < total) for BOTH inner and left (mm_plan_for's
+    # left adjustment feeds total), so the host count is exact either way
+    return DeviceBatch(cols, out_valid, ntotal, None)
 
 
 @functools.partial(jax.jit, static_argnames=("p",))
@@ -299,10 +311,55 @@ def _is_unmatched_gather(limbs, valid, p, probe_idx):
     return ((cnt[rp] == 0) | ~vp)[probe_idx]
 
 
+@jax.jit
+def _distinct_from_table(tbl, ok):
+    """(# placed keys, # insertable rows) from a converged hash table."""
+    from quokka_tpu.ops import hashtable
+
+    return (jnp.sum((tbl != hashtable.EMPTY).astype(jnp.int32)),
+            jnp.sum(ok.astype(jnp.int32)))
+
+
+@jax.jit
+def _sorted_has_dup(sorted_limbs, n_valid):
+    """Any adjacent equal key pair within the valid prefix of a build sort."""
+    dup = jnp.zeros((), dtype=bool)
+    eq = jnp.ones(sorted_limbs[0].shape[0], dtype=bool)
+    for limb in sorted_limbs:
+        eq = eq & (limb == jnp.roll(limb, 1))
+    iota = jnp.arange(sorted_limbs[0].shape[0], dtype=jnp.int32)
+    dup = jnp.any(eq & (iota >= 1) & (iota < n_valid))
+    return dup, n_valid
+
+
 def build_keys_unique(build: DeviceBatch, build_keys: Sequence[str]) -> bool:
-    """Host-synced check whether the build side is PK-unique (decides fast path).
-    Called once per finalized build table, not per probe batch."""
-    limbs = key_limbs(build, build_keys)
-    ranks, num = dense_rank(limbs, build.valid)
+    """Host-synced check whether the build side is PK-unique (decides fast
+    path).  Called once per finalized build table, not per probe batch.
+
+    Answered from the SAME cached structure the probe will use — the device
+    hash table (distinct == placed slots) or the cached build sort (any
+    adjacent equal pair) — instead of a fresh dense-rank sort over the
+    build, so the check is nearly free and the probe cache is warm before
+    the first probe batch arrives.  Null-key rows match the dense-rank
+    semantics this replaces: all nulls collapse into one key, so uniqueness
+    additionally requires at most one null/NaN-key row."""
     nvalid = build.count_valid()
-    return int(num) == nvalid
+    if config.use_hash_tables():
+        from quokka_tpu.ops import hashtable
+
+        try:
+            table = hashtable.build_table(
+                build, build_keys, key_limbs,
+                lambda: _nonnull_valid(build, build_keys),
+            )
+        except hashtable.HashTableConvergenceError:
+            table = None  # diverged build: the sort fallback below decides
+        if table is not None:
+            raw = key_limbs(build, build_keys)
+            ok = _nonnull_valid(build, build_keys) & ~hashtable.nan_rows(raw)
+            distinct, n_ok = _distinct_from_table(table.tbl, ok)
+            distinct, n_ok = int(distinct), int(n_ok)
+            return distinct == n_ok and nvalid - n_ok <= 1
+    sorted_limbs, _perm, n_ok_dev = _build_sorted_cached(build, build_keys)
+    dup, n_ok = _sorted_has_dup(tuple(sorted_limbs), n_ok_dev)
+    return (not bool(dup)) and nvalid - int(n_ok) <= 1

@@ -17,7 +17,9 @@ Design notes (TPU-first):
 
 from __future__ import annotations
 
+import contextlib
 import functools
+import threading
 from typing import List, Optional, Sequence, Tuple
 
 import jax
@@ -389,35 +391,114 @@ def partition_ids(batch: DeviceBatch, keys: Sequence[str], n_parts: int) -> jax.
     return _partition_ids(tuple(limbs), n_parts)
 
 
-def split_by_partition(batch: DeviceBatch, part_ids: jax.Array, n_parts: int):
-    """Split a batch into n per-partition batches.
-
-    Small batches split as masked views — zero host syncs (each part keeps
-    the parent's padded length, which is cheap at these sizes).  Large
-    batches pay ONE host sync for all partition counts (a bincount readback)
-    and compact each partition to its own bucket, so a shuffle does not
-    multiply device memory by the fan-out."""
-    if batch.padded_len <= (1 << 16):
-        out = []
-        for p in range(n_parts):
-            # apply_mask ANDs with batch.valid itself
-            out.append(apply_mask(batch, part_ids == p))
-        return out
-    counts = np.asarray(_partition_counts(part_ids, batch.valid, n_parts))
-    out = []
-    for p in range(n_parts):
-        n = int(counts[p])
-        padded = config.bucket_size(n)
-        mask = (part_ids == p) & batch.valid
-        idx = _compact_idx(mask, padded)
-        valid = jnp.arange(padded) < n
-        out.append(batch.take(idx, valid, n))
-    return out
+@functools.partial(jax.jit, static_argnames=("n_parts",))
+def _split_masks(part_ids, valid, n_parts: int):
+    """ONE dispatch producing every partition's validity mask plus its live
+    count (the masked-split fast path used to dispatch one apply_mask kernel
+    per partition)."""
+    masks = tuple((part_ids == p) & valid for p in range(n_parts))
+    counts = tuple(jnp.sum(m.astype(jnp.int32)) for m in masks)
+    return masks, counts
 
 
 @functools.partial(jax.jit, static_argnames=("n_parts",))
-def _partition_counts(part_ids, valid, n_parts):
-    return jnp.bincount(jnp.where(valid, part_ids, n_parts), length=n_parts + 1)[:n_parts]
+def _partition_plan(part_ids, valid, n_parts: int):
+    """ONE dispatch planning a compacted split: a stable permutation grouping
+    valid rows by partition id (invalid rows last), per-partition counts and
+    start offsets.  Every partition is then a window of ``perm`` — no
+    per-partition nonzero scans over the full batch."""
+    n = valid.shape[0]
+    pid = jnp.where(valid, part_ids, jnp.int32(n_parts))
+    counts = jnp.bincount(pid, length=n_parts + 1)[:n_parts]
+    iota = jnp.arange(n, dtype=jnp.int32)
+    perm = lax.sort([pid.astype(jnp.int32), iota], num_keys=2)[-1]
+    offsets = jnp.cumsum(counts) - counts
+    return perm, counts, offsets
+
+
+@functools.partial(jax.jit, static_argnames=("out_size",))
+def _part_window(perm, offset, count, out_size: int):
+    """Row indices + validity of one partition's window of the plan perm."""
+    pos = offset + jnp.arange(out_size, dtype=jnp.int32)
+    idx = perm[jnp.clip(pos, 0, perm.shape[0] - 1)]
+    return idx, jnp.arange(out_size, dtype=jnp.int32) < count
+
+
+# Per-query attribution for push-path host syncs: the engine enters a scope
+# carrying its ONCE-RESOLVED per-query counter (a creating registry lookup
+# here would resurrect a GC'd per-query instrument after TaskGraph.cleanup,
+# and diffing the global counter would cross-attribute concurrent queries).
+_SYNC_SCOPE = threading.local()
+
+
+@contextlib.contextmanager
+def shuffle_sync_scope(counter):
+    prev = getattr(_SYNC_SCOPE, "counter", None)
+    _SYNC_SCOPE.counter = counter
+    try:
+        yield
+    finally:
+        _SYNC_SCOPE.counter = prev
+
+
+def _shuffle_sync() -> None:
+    """Count a blocking host readback on the shuffle path (the shuffle-smoke
+    sentinel asserts this stays flat in steady state)."""
+    from quokka_tpu import obs
+
+    obs.REGISTRY.counter("shuffle.host_syncs").inc()
+    c = getattr(_SYNC_SCOPE, "counter", None)
+    if c is not None:
+        c.inc()
+
+
+def split_by_partition(batch: DeviceBatch, part_ids: jax.Array, n_parts: int,
+                       compact: Optional[bool] = None):
+    """Split a batch into n per-partition batches.
+
+    Default (masked) mode: parts are VIEWS over the parent's column arrays —
+    one fused kernel produces every partition's mask and live count, columns
+    are shared (no copies, no gathers) and the counts' host copies start
+    asynchronously (note_count), so the push path pays ZERO blocking host
+    syncs.  Consumers compact/concat when the counts have long landed.
+
+    Compacted mode (``compact=True``, or auto past SHUFFLE_MASKED_CAP total
+    padded rows): one segmented-sort plan kernel groups rows by partition,
+    then each partition is a window-gather at its own bucket — n_parts
+    window gathers instead of n_parts full-batch nonzero scans, and ONE
+    counts readback whose async host copy starts at plan dispatch.  Buckets
+    are UNIFORM across partitions when skew allows, so every downstream
+    consumer sees one shape per split instead of one per partition."""
+    if n_parts == 1:
+        return [batch]
+    if compact is None:
+        compact = (batch.padded_len > (1 << 16)
+                   and n_parts * batch.padded_len > config.SHUFFLE_MASKED_CAP)
+    if not compact:
+        masks, counts = _split_masks(part_ids, batch.valid, n_parts)
+        return [
+            DeviceBatch(batch.columns, m, None, batch.sorted_by).note_count(c)
+            for m, c in zip(masks, counts)
+        ]
+    perm, counts, offsets = _partition_plan(part_ids, batch.valid, n_parts)
+    with contextlib.suppress(Exception):  # numpy-backed arrays lack it
+        counts.copy_to_host_async()
+    _shuffle_sync()
+    host_counts = np.asarray(counts)  # overlaps the plan kernel's execution
+    max_count = int(host_counts.max()) if n_parts else 0
+    uniform = config.bucket_size(max_count)
+    total = int(host_counts.sum())
+    # uniform buckets collapse the downstream shape space to ONE per split;
+    # skewed splits fall back to per-partition buckets so device memory
+    # stays proportional to the data
+    use_uniform = n_parts * uniform <= 2 * config.bucket_size(max(total, 1))
+    out = []
+    for p in range(n_parts):
+        cnt = int(host_counts[p])
+        padded = uniform if use_uniform else config.bucket_size(cnt)
+        idx, valid = _part_window(perm, offsets[p], counts[p], padded)
+        out.append(batch.take(idx, valid, cnt))
+    return out
 
 
 # ---------------------------------------------------------------------------

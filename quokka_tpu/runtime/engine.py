@@ -187,7 +187,9 @@ class TaskGraph:
             scancache.GLOBAL.drop_query(self.query_id)
             obs.REGISTRY.remove(f"cache.plan_hit.{self.query_id}",
                                 f"cache.plan_miss.{self.query_id}",
-                                f"task.latency_s.{self.query_id}")
+                                f"task.latency_s.{self.query_id}",
+                                f"shuffle.bytes.{self.query_id}",
+                                f"shuffle.host_syncs.{self.query_id}")
 
     def _new_actor(self, kind, channels, stage, sorted_actor=False) -> ActorInfo:
         info = ActorInfo(self._next_actor, kind, channels, stage, sorted_actor)
@@ -443,16 +445,7 @@ class Engine:
         self.g = graph
         self.store = graph.store
         self.cache = graph.cache
-        # latency histograms resolved ONCE, while the graph is alive: the
-        # observe path must never use a creating registry lookup, or a
-        # dispatch quantum completing after TaskGraph.cleanup would
-        # resurrect the GC'd per-query instrument as a permanent /metrics
-        # leak (observing into the orphaned object instead is harmless)
-        self._lat_hist = obs.REGISTRY.histogram("task.latency_s")
-        qid = getattr(graph, "query_id", None)
-        self._qlat_hist = (
-            obs.REGISTRY.histogram(f"task.latency_s.{qid}")
-            if qid is not None else None)
+        self._init_latency_hists(graph)
         self.max_batches = graph.exec_config.get("max_pipeline_batches", 8)
         self.execs: Dict[Tuple[int, int], object] = {}
         self._partition_fns: Dict[Tuple[int, int], Callable] = {}
@@ -476,6 +469,16 @@ class Engine:
 
             fused_pred = FusedPredicate(tinfo.predicate)
 
+        range_state = None
+        if isinstance(part, RangePartitioner):
+            # boundaries land on device ONCE per edge, not once per batch
+            # (the per-batch jnp.asarray upload used to sit on the push hot
+            # path).  The device copy is built lazily on the first narrow-
+            # column batch: wide (int64-limb) columns never upload — their
+            # boundaries exceed int32 without x64 — and use the host ints.
+            range_state = {"host": [int(b) for b in part.boundaries],
+                           "dev": None}
+
         def fn(batch: DeviceBatch, src_ch: int) -> Dict[int, DeviceBatch]:
             if fused_pred is not None:
                 batch = fused_pred(batch)
@@ -494,7 +497,7 @@ class Engine:
                     pids = kernels.partition_ids(batch, part.keys, n_tgt)
                     out = dict(enumerate(kernels.split_by_partition(batch, pids, n_tgt)))
             elif isinstance(part, RangePartitioner):
-                out = self._range_split(batch, part, n_tgt)
+                out = self._range_split(batch, part, n_tgt, range_state)
             elif isinstance(part, FunctionPartitioner):
                 out = part.fn(batch, src_ch, n_tgt)
             else:
@@ -506,17 +509,23 @@ class Engine:
         self._partition_fns[key] = fn
         return fn
 
-    def _range_split(self, batch, part: RangePartitioner, n_tgt: int):
+    def _range_split(self, batch, part: RangePartitioner, n_tgt: int,
+                     range_state=None):
         import jax.numpy as jnp
 
+        if range_state is None:  # direct callers (tests): uncached
+            range_state = {"host": [int(b) for b in part.boundaries],
+                           "dev": None}
         col = batch.columns[part.key]
         if getattr(col, "hi", None) is not None:
             from quokka_tpu.ops import timewide
 
-            pids = timewide.limb_le_scalar_count(col, [int(b) for b in part.boundaries])
+            pids = timewide.limb_le_scalar_count(col, range_state["host"])
         else:
-            bounds = jnp.asarray(part.boundaries)
-            pids = jnp.searchsorted(bounds, col.data, side="right").astype(jnp.int32)
+            if range_state["dev"] is None:
+                range_state["dev"] = jnp.asarray(part.boundaries)
+            pids = jnp.searchsorted(
+                range_state["dev"], col.data, side="right").astype(jnp.int32)
         if part.descending:
             pids = (n_tgt - 1) - pids  # channel 0 owns the highest range
         return dict(enumerate(kernels.split_by_partition(batch, pids, n_tgt)))
@@ -525,19 +534,98 @@ class Engine:
     def push(self, actor: int, channel: int, seq: int, batch: DeviceBatch) -> None:
         _note_out(seq)  # producer side of a critical-path data edge
         info = self.g.actors[actor]
-        for tgt_actor in info.targets:
-            fn = self._partition_fn(actor, tgt_actor)
-            parts = fn(batch, channel)
-            for tgt_ch, part in parts.items():
-                name = (actor, channel, seq, tgt_actor, actor, tgt_ch)
-                if self.g.hbq is not None:
-                    # spill post-partition (core.py:311-313): replayable
-                    # without recomputing the producer
-                    self.g.hbq.put(name, bridge.device_to_arrow(part))
-                self._cache_put(name, part)
-                with self.store.transaction():
-                    self.store.sadd("NOT", (actor, channel), name)
-                    self.store.tset("PT", name, (actor, channel))
+        from quokka_tpu.runtime.cache import _batch_nbytes
+
+        # the sync scope carries this engine's once-resolved per-query
+        # counter, so a split blocking inside the partition fn attributes to
+        # THIS query even when neighbors dispatch concurrently
+        with kernels.shuffle_sync_scope(self._shuffle_syncs_q):
+            for tgt_actor in info.targets:
+                fn = self._partition_fn(actor, tgt_actor)
+                parts = fn(batch, channel)
+                if len(parts) > 1:
+                    # shuffle volume: bytes entering a real exchange
+                    # (fan-out > 1), counted once per edge from the parent
+                    nb = _batch_nbytes(batch)
+                    self._shuffle_bytes.inc(nb)
+                    if self._shuffle_bytes_q is not None:
+                        self._shuffle_bytes_q.inc(nb)
+                for tgt_ch, part in parts.items():
+                    name = (actor, channel, seq, tgt_actor, actor, tgt_ch)
+                    if self.g.hbq is not None:
+                        # spill post-partition (core.py:311-313): replayable
+                        # without recomputing the producer.  The d2h copy +
+                        # checksummed write run on the background spill
+                        # pool, overlapped with compute; recovery/checkpoint
+                        # boundaries flush it (_flush_spills).
+                        self._spill_submit(name, part)
+                    self._cache_put(name, part)
+                    with self.store.transaction():
+                        self.store.sadd("NOT", (actor, channel), name)
+                        self.store.tset("PT", name, (actor, channel))
+
+    # -- async HBQ spill ------------------------------------------------------
+    # The HBQ write used to sit synchronously inside push: a full d2h sync +
+    # framed disk write per partition per batch, serializing the producer
+    # behind the disk.  It now runs on a bounded background pool; the
+    # fault-tolerance contract is preserved by flush barriers at every point
+    # recovery consults the spill (checkpoint record, failure simulation,
+    # tape replay, object replay) and at engine teardown.  QK_SPILL_ASYNC=0
+    # restores the synchronous path.
+
+    def _spill_submit(self, name: Tuple, part: DeviceBatch) -> None:
+        if not config.SPILL_ASYNC:
+            self._spill_one(name, part)
+            return
+        pool = getattr(self, "_spill_pool", None)
+        if pool is None:
+            with _LAZY_INIT_LOCK:
+                pool = getattr(self, "_spill_pool", None)
+                if pool is None:
+                    from concurrent.futures import ThreadPoolExecutor
+
+                    self._spill_futs = []
+                    self._spill_lock = threading.Lock()
+                    pool = self._spill_pool = ThreadPoolExecutor(
+                        max_workers=max(1, config.SPILL_POOL),
+                        thread_name_prefix="quokka-spill",
+                    )
+        with self._spill_lock:
+            self._spill_futs.append(pool.submit(self._spill_one, name, part))
+        while True:
+            with self._spill_lock:
+                if len(self._spill_futs) <= config.SPILL_INFLIGHT:
+                    break
+                f = self._spill_futs.pop(0)
+            f.result()  # bound device memory pinned by pending spills
+
+    def _spill_one(self, name: Tuple, part: DeviceBatch) -> None:
+        with tracing.span("spill.hbq"):
+            # masked-view parts compact here (counts have landed by spill
+            # time) so the d2h copy and the disk bytes stay proportional to
+            # the partition, not the parent batch
+            if part.padded_len > (1 << 16):
+                part = kernels.compact(part)
+            table = bridge.device_to_arrow(part)
+            self.g.hbq.put(name, table)
+        obs.REGISTRY.counter("shuffle.spill_bytes").inc(table.nbytes)
+
+    def _flush_spills(self) -> None:
+        futs = getattr(self, "_spill_futs", None)
+        if futs:
+            with self._spill_lock:
+                futs, self._spill_futs = self._spill_futs, []
+            for f in futs:
+                f.result()  # propagate the first spill error loudly
+
+    def _shutdown_spill(self) -> None:
+        pool = getattr(self, "_spill_pool", None)
+        if pool is not None:
+            try:
+                self._flush_spills()
+            finally:
+                self._spill_pool = None
+                pool.shutdown(wait=True)
 
     def _cache_put(self, name: Tuple, part: DeviceBatch) -> None:
         """Deliver a partition to its consumer channel's cache.  The embedded
@@ -874,6 +962,10 @@ class Engine:
             # no snapshot support: recovery rewinds to state 0 + full tape
             # replay; recording an LCT here would silently drop state
             return
+        # flush barrier: every spill the tape references up to this point
+        # must be durable before the checkpoint triple is recorded —
+        # recovery that restores here may immediately replay from the HBQ
+        self._flush_spills()
         state = executor.checkpoint()
         try:
             self._ckpt_store().save(
@@ -927,6 +1019,9 @@ class Engine:
         checkpoint chosen by the rewind planner, rebuild the input frontier
         from IRT, and replay already-produced inputs from the HBQ spill."""
         assert self.g.hbq is not None, "fault tolerance is not enabled"
+        # flush barrier: the rewind planner and the replay tasks it queues
+        # consult HBQ listings — pending async spills must land first
+        self._flush_spills()
         dead_exec = []
         for (a, ch) in failed:
             info = self.g.actors[a]
@@ -1063,6 +1158,7 @@ class Engine:
         seq-keyed and deterministic, so the retried replay overwrites its own
         partial output rather than duplicating it."""
         a, ch = task.actor, task.channel
+        self._flush_spills()  # tape inputs probe the HBQ listing below
         reqs = {s: dict(c) for s, c in task.input_reqs.items()}
         tape = self.store.tape_slice(a, ch, task.tape_pos)
 
@@ -1293,6 +1389,28 @@ class Engine:
             rec.record("task.wait", label, **qargs)
         return ok
 
+    def _init_latency_hists(self, graph) -> None:
+        """Latency histograms resolved ONCE, while the graph is alive: the
+        observe path must never use a creating registry lookup, or a
+        dispatch quantum completing after TaskGraph.cleanup would resurrect
+        the GC'd per-query instrument as a permanent /metrics leak
+        (observing into the orphaned object instead is harmless).  Shared
+        with the distributed Worker, whose __init__ bypasses Engine's."""
+        self._lat_hist = obs.REGISTRY.histogram("task.latency_s")
+        qid = getattr(graph, "query_id", None)
+        self._qlat_hist = (
+            obs.REGISTRY.histogram(f"task.latency_s.{qid}")
+            if qid is not None else None)
+        # shuffle instruments, same once-resolved discipline (push runs on
+        # the dispatch path; per-query twins are GC'd in TaskGraph.cleanup)
+        self._shuffle_bytes = obs.REGISTRY.counter("shuffle.bytes")
+        self._shuffle_bytes_q = (
+            obs.REGISTRY.counter(f"shuffle.bytes.{qid}")
+            if qid is not None else None)
+        self._shuffle_syncs_q = (
+            obs.REGISTRY.counter(f"shuffle.host_syncs.{qid}")
+            if qid is not None else None)
+
     def _observe_latency(self, dt: float) -> None:
         """Dispatch latency into the typed histograms (resolved once in
         __init__): one process-wide family plus a per-query one (GC'd with
@@ -1323,6 +1441,7 @@ class Engine:
         starve the rebuilt consumer forever.  A live exec producer of such
         a name is force-rewound (embedded engine) so regeneration actually
         happens; after the deadline the loss is surfaced loudly."""
+        self._flush_spills()  # _resolve_lost_object reads the HBQ below
         missing = []
         resolved = 0
         for name in task.replay_specs:
@@ -1479,6 +1598,7 @@ class Engine:
                 pass  # a dead store must not block thread shutdown below
             self._shutdown_prefetch()
             self._shutdown_emitter()
+            self._shutdown_spill()
             self._export_trace()
 
     def _export_trace(self) -> None:
@@ -1665,6 +1785,7 @@ class Engine:
                 obs.diag(f"[service] final metrics flush failed: {e!r}")
             self._shutdown_prefetch()
             self._shutdown_emitter()
+            self._shutdown_spill()
 
     def _stage_undone(self, actors, stage) -> bool:
         for info in actors:

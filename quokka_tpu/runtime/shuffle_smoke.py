@@ -1,0 +1,105 @@
+"""Shuffle data-plane smoke: the push path must be sync-free at steady state.
+
+    python -m quokka_tpu.runtime.shuffle_smoke      (or: make shuffle-smoke)
+
+A seeded Q3-shaped pipeline (fact join dim on an integer key, then a grouped
+aggregate — two hash-shuffle exchanges) runs twice; the second, fully-warm
+run must show
+
+1. ZERO blocking host readbacks on the partition/push path (the
+   ``shuffle.host_syncs`` counter the split kernels increment on every
+   blocking counts readback stays flat), and
+2. ZERO real backend compiles (the sanitizer's recompile sentinel,
+   ``analysis/sanitize.check_no_recompiles`` with force=True), and
+3. nonzero ``shuffle.bytes`` — proof the run actually exercised a fan-out
+   exchange rather than trivially passing on an empty path.
+
+Exit nonzero on any violation, with the counter deltas printed.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+
+
+def _make_tables(tmp: str, seed: int = 20260804):
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    r = np.random.default_rng(seed)
+    n_fact, n_dim = 400_000, 40_000
+    fact = pa.table({
+        "fk": r.integers(0, n_dim, n_fact).astype(np.int64),
+        "v": r.integers(0, 1000, n_fact).astype(np.int64),
+        "flag": r.integers(0, 4, n_fact).astype(np.int64),
+    })
+    dim = pa.table({
+        "pk": np.arange(n_dim, dtype=np.int64),
+        "grp": r.integers(0, 64, n_dim).astype(np.int64),
+    })
+    fp, dp = os.path.join(tmp, "fact.parquet"), os.path.join(tmp, "dim.parquet")
+    pq.write_table(fact, fp, row_group_size=1 << 17)
+    pq.write_table(dim, dp)
+    return fp, dp
+
+
+def _query(ctx, fp, dp):
+    from quokka_tpu.expression import col
+
+    fact = ctx.read_parquet(fp)
+    dim = ctx.read_parquet(dp)
+    return (
+        fact.filter(col("flag") < 3)
+        .join(dim, left_on="fk", right_on="pk")
+        .groupby("grp")
+        .agg_sql("sum(v) as sv, count(*) as n")
+    )
+
+
+def main() -> int:
+    from quokka_tpu import QuokkaContext, obs
+    from quokka_tpu.analysis import sanitize
+    from quokka_tpu.utils import compilestats
+
+    with tempfile.TemporaryDirectory(prefix="qk-shuffle-smoke-") as tmp:
+        fp, dp = _make_tables(tmp)
+        ctx = QuokkaContext(io_channels=2, exec_channels=2)
+        warm = _query(ctx, fp, dp).collect()  # compiles + fills scan cache
+        assert len(warm) > 0, "smoke query returned no rows"
+
+        c0 = compilestats.snapshot()
+        snap0 = obs.REGISTRY.snapshot()
+        steady = _query(ctx, fp, dp).collect()
+        c1 = compilestats.snapshot()
+        snap1 = obs.REGISTRY.snapshot()
+
+        assert warm.equals(steady), "steady-state run changed the result"
+        syncs = snap1.get("shuffle.host_syncs", 0) - snap0.get(
+            "shuffle.host_syncs", 0)
+        sbytes = snap1.get("shuffle.bytes", 0) - snap0.get("shuffle.bytes", 0)
+        print(f"shuffle-smoke: steady-state shuffle.bytes={sbytes} "
+              f"host_syncs={syncs} real_compiles="
+              f"{c1['real_compiles'] - c0['real_compiles']}")
+        if sbytes <= 0:
+            print("shuffle-smoke: FAIL — no shuffle volume recorded; the "
+                  "pipeline did not exercise a fan-out exchange",
+                  file=sys.stderr)
+            return 1
+        if syncs > 0:
+            print(f"shuffle-smoke: FAIL — {syncs} blocking host readback(s) "
+                  "on the steady-state push path (shuffle.host_syncs)",
+                  file=sys.stderr)
+            return 1
+        # recompile sentinel: a warmed shuffle pipeline must reuse its
+        # executables (raises RecompileError on violation)
+        sanitize.check_no_recompiles(c0, c1, context="shuffle-smoke steady run",
+                                     force=True)
+    print("shuffle-smoke: OK — zero steady-state host syncs, zero recompiles")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -77,6 +77,7 @@ class Worker(Engine):
         g = WorkerGraph(store, cache, actors, spec["exec_config"], hbq,
                         spec["ckpt_dir"], query_id=spec.get("query_id"))
         self.worker_id = worker_id
+        self._init_latency_hists(g)
         self.owned = {a: set(chs) for a, chs in owned.items()}
         self._peers: Dict[int, DataPlaneClient] = {}
         self._peer_addrs: Dict[int, Tuple[str, int]] = {}
@@ -126,6 +127,7 @@ class Worker(Engine):
     def _cache_put(self, name, part):
         tgt = (name[3], name[5])
         deadline = time.time() + 30
+        compacted = False
         while True:
             owner = self._clt.get(tgt)
             if owner is None:
@@ -135,6 +137,15 @@ class Worker(Engine):
                 self.cache.put(name, part)
                 return
             try:
+                if not compacted and part.padded_len > (1 << 16):
+                    # remote put serializes the batch whole: a masked-view
+                    # partition would ship the full PARENT padded buffers
+                    # (fan-out times the bytes) — compact before the wire,
+                    # same discipline as the spill worker (_spill_one)
+                    from quokka_tpu.ops import kernels
+
+                    part = kernels.compact(part)
+                    compacted = True
                 self._peer(owner).put(name, part, part.sorted_by)
                 return
             except (ConnectionError, OSError):
@@ -231,6 +242,9 @@ class Worker(Engine):
         `choice` is the coordinator's rewind-planner checkpoint selection."""
         obs.RECORDER.record("adopt", f"a{actor}c{channel}",
                             choice=repr(choice))
+        # flush barrier: adoption replays from HBQ listings (ours included);
+        # our own pending async spills must be durable first
+        self._flush_spills()
         self.owned.setdefault(actor, set()).add(channel)
         self._recover_channel(actor, channel, choice=choice)
 
@@ -417,6 +431,7 @@ def worker_main(spec_bytes: bytes, store_addr, worker_id: int, owned):
                 pass  # a dead coordinator store must not block shutdown
             w._shutdown_prefetch()
             w._shutdown_emitter()
+            w._shutdown_spill()
             server.close()
     except Exception:
         import traceback

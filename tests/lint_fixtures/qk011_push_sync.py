@@ -1,0 +1,34 @@
+"""QK011 fixture: blocking host readbacks on the shuffle push path.
+
+Three findings: np.asarray in push, .item() in _range_split (reached via
+push -> _partition_fn -> closure), device_get in a helper reachable from
+split_by_partition.
+"""
+
+import numpy as np
+import jax
+
+
+def push(batch, parts):
+    sizes = np.asarray(batch.counts)  # finding 1: blocking readback in push
+    fn = _partition_fn()
+    return fn(batch, sizes), split_by_partition(batch, parts)
+
+
+def _partition_fn():
+    def fn(batch, sizes):
+        return _range_split(batch, sizes)
+
+    return fn
+
+
+def _range_split(batch, sizes):
+    return sizes.sum().item()  # finding 2: scalar readback on the push path
+
+
+def split_by_partition(batch, parts):
+    return _materialize(batch, parts)
+
+
+def _materialize(batch, parts):
+    return jax.device_get(batch.columns)  # finding 3: reachable from split

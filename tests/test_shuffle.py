@@ -1,0 +1,249 @@
+"""Device-resident shuffle data plane (ISSUE 6): the multi-partition split
+kernel (masked views vs one-kernel compacted), the sync-free push-path
+contract, the async HBQ spill's flush barriers, and the spill/replay round
+trip staying bit-exact under injected spill corruption."""
+
+import os
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from quokka_tpu import QuokkaContext, config, obs
+from quokka_tpu.chaos import CHAOS
+from quokka_tpu.dataset.readers import InputArrowDataset
+from quokka_tpu.ops import bridge, kernels
+from quokka_tpu.ops.batch import DeviceBatch
+
+
+@pytest.fixture(autouse=True)
+def _chaos_off():
+    CHAOS.disable()
+    yield
+    CHAOS.disable()
+
+
+def _batch(n=5000, seed=0, invalid_frac=0.3, n_keys=64):
+    r = np.random.default_rng(seed)
+    table = pa.table({
+        "k": r.integers(0, n_keys, n).astype(np.int64),
+        "v": r.normal(size=n),
+        "s": pa.array(np.array([f"s{i % 7}" for i in range(n)])),
+    })
+    b = bridge.arrow_to_device(table)
+    if invalid_frac:
+        import jax.numpy as jnp
+
+        mask = jnp.asarray(r.random(b.padded_len) >= invalid_frac)
+        b = kernels.apply_mask(b, mask)
+    return b
+
+
+def _rows(part: DeviceBatch) -> pd.DataFrame:
+    """Valid rows of a partition, in stored order."""
+    return bridge.to_pandas(part).reset_index(drop=True)
+
+
+class TestMultiPartitionKernel:
+    @pytest.mark.parametrize("n_parts", [2, 3, 4])
+    def test_masked_vs_compacted_equivalence(self, n_parts):
+        """The two split modes must deliver identical rows per partition,
+        in identical (source) order — the fault-tolerance tape replay
+        depends on partition contents being mode-independent."""
+        b = _batch(seed=1)
+        pids = kernels.partition_ids(b, ["k"], n_parts)
+        masked = kernels.split_by_partition(b, pids, n_parts, compact=False)
+        compacted = kernels.split_by_partition(b, pids, n_parts, compact=True)
+        assert len(masked) == len(compacted) == n_parts
+        total = 0
+        for m, c in zip(masked, compacted):
+            dm, dc = _rows(m), _rows(c)
+            pd.testing.assert_frame_equal(dm, dc)
+            total += len(dm)
+        assert total == b.count_valid()
+
+    def test_masked_parts_share_parent_buffers(self):
+        b = _batch(seed=2)
+        pids = kernels.partition_ids(b, ["k"], 2)
+        parts = kernels.split_by_partition(b, pids, 2, compact=False)
+        for p in parts:
+            assert p.columns["v"].data is b.columns["v"].data
+            assert p.padded_len == b.padded_len
+
+    def test_empty_partitions(self):
+        """Keys concentrated on one partition: the others are empty but
+        well-formed (every consumer receives a batch for its channel)."""
+        n = 3000
+        table = pa.table({"k": np.zeros(n, dtype=np.int64),
+                          "v": np.arange(n, dtype=np.float64)})
+        b = bridge.arrow_to_device(table)
+        pids = kernels.partition_ids(b, ["k"], 4)
+        for compact in (False, True):
+            parts = kernels.split_by_partition(b, pids, 4, compact=compact)
+            counts = [p.count_valid() for p in parts]
+            assert sorted(counts)[:3] == [0, 0, 0]
+            assert sum(counts) == n
+
+    def test_all_invalid_batch(self):
+        b = _batch(seed=3, invalid_frac=1.0)
+        assert b.count_valid() == 0
+        pids = kernels.partition_ids(b, ["k"], 3)
+        for compact in (False, True):
+            parts = kernels.split_by_partition(b, pids, 3, compact=compact)
+            assert [p.count_valid() for p in parts] == [0, 0, 0]
+            for p in parts:
+                assert len(_rows(p)) == 0
+
+    def test_n_parts_1_fast_path(self):
+        """Fan-in of one: the batch passes through untouched — no mask, no
+        gather, no sync."""
+        b = _batch(seed=4)
+        pids = kernels.partition_ids(b, ["k"], 1)
+        for compact in (False, True):
+            parts = kernels.split_by_partition(b, pids, 1, compact=compact)
+            assert len(parts) == 1 and parts[0] is b
+
+    def test_compacted_uniform_buckets(self):
+        """Balanced hash splits compact to ONE bucket size across all
+        partitions (the downstream shape-space collapse)."""
+        b = _batch(seed=5, invalid_frac=0.0, n_keys=1024)
+        pids = kernels.partition_ids(b, ["k"], 4)
+        parts = kernels.split_by_partition(b, pids, 4, compact=True)
+        assert len({p.padded_len for p in parts}) == 1
+
+    def test_masked_split_zero_host_syncs(self):
+        """The push-path contract the shuffle-smoke gate enforces: a masked
+        split never increments the blocking-readback counter."""
+        b = _batch(seed=6)
+        pids = kernels.partition_ids(b, ["k"], 4)
+        before = obs.REGISTRY.counter("shuffle.host_syncs").value
+        kernels.split_by_partition(b, pids, 4, compact=False)
+        assert obs.REGISTRY.counter("shuffle.host_syncs").value == before
+
+    def test_masked_counts_noted_async(self):
+        b = _batch(seed=7)
+        pids = kernels.partition_ids(b, ["k"], 2)
+        parts = kernels.split_by_partition(b, pids, 2, compact=False)
+        for p in parts:
+            assert p.nrows is None and p.nrows_dev is not None
+
+    def test_order_preserved_within_partition(self):
+        """Both modes keep source row order inside each partition (ordered
+        asof/window streams shuffle through the same kernels)."""
+        n = 4000
+        table = pa.table({"k": (np.arange(n) % 3).astype(np.int64),
+                          "t": np.arange(n, dtype=np.int64)})
+        b = bridge.arrow_to_device(table)
+        pids = kernels.partition_ids(b, ["k"], 3)
+        for compact in (False, True):
+            for p in kernels.split_by_partition(b, pids, 3, compact=compact):
+                t = _rows(p)["t"].to_numpy()
+                assert (np.diff(t) > 0).all()
+
+
+class TestAsyncSpill:
+    def test_spill_submit_and_flush_barrier(self, tmp_path):
+        """_spill_submit runs off-thread; _flush_spills makes the artifact
+        durable (the barrier checkpoint/recovery rely on)."""
+        from quokka_tpu.runtime.engine import Engine
+        from quokka_tpu.runtime.hbq import HBQ
+
+        class _G:
+            pass
+
+        eng = Engine.__new__(Engine)
+        eng.g = _G()
+        eng.g.hbq = HBQ(str(tmp_path))
+        b = bridge.arrow_to_device(pa.table({"a": [1, 2, 3]}))
+        name = (0, 0, 0, 1, 0, 0)
+        try:
+            eng._spill_submit(name, b)
+            eng._flush_spills()
+            got = eng.g.hbq.get(name)
+            assert got is not None and got.column("a").to_pylist() == [1, 2, 3]
+        finally:
+            eng._shutdown_spill()
+
+    def test_spill_error_surfaces_at_flush(self, tmp_path):
+        """A failing spill write must fail the query loudly at the next
+        barrier, never vanish into the background pool."""
+        from quokka_tpu.runtime.engine import Engine
+
+        class _BadHBQ:
+            def put(self, name, table):
+                raise OSError("disk on fire")
+
+        class _G:
+            pass
+
+        eng = Engine.__new__(Engine)
+        eng.g = _G()
+        eng.g.hbq = _BadHBQ()
+        b = bridge.arrow_to_device(pa.table({"a": [1]}))
+        try:
+            eng._spill_submit((0, 0, 0, 1, 0, 0), b)
+            with pytest.raises(OSError, match="disk on fire"):
+                eng._flush_spills()
+        finally:
+            eng._spill_pool = None  # already drained; avoid double shutdown
+
+
+def _join_query(fact, dim, **cfg):
+    # optimize=False pins the plan shape, so inject_failure channel ids are
+    # stable (same discipline as the fault-tolerance tests)
+    ctx = QuokkaContext(optimize=False)
+    for k, v in cfg.items():
+        ctx.set_config(k, v)
+    f = ctx.read_dataset(InputArrowDataset(fact, batch_rows=512))
+    d = ctx.read_dataset(InputArrowDataset(dim, batch_rows=512))
+    return (
+        f.join(d, left_on="k", right_on="pk")
+        .groupby("g").agg_sql("sum(v) as sv, count(*) as n")
+        .collect().sort_values("g").reset_index(drop=True)
+    )
+
+
+class TestSpillReplayRoundTrip:
+    def test_shuffle_spill_replay_bit_exact_under_corrupt_spill(
+            self, tmp_path):
+        """Q3-shaped join+aggregate through the new split kernels with EVERY
+        spill write corrupted and a mid-run channel loss: the round trip
+        (async spill -> quarantine -> replay/regenerate) must stay
+        bit-exact, and the detection counter must move."""
+        r = np.random.default_rng(11)
+        n = 6000
+        fact = pa.table({"k": r.integers(0, 50, n).astype(np.int64),
+                         "v": r.integers(0, 100, n).astype(np.float64)})
+        dim = pa.table({"pk": np.arange(50, dtype=np.int64),
+                        "g": (np.arange(50) % 5).astype(np.int64)})
+        baseline = _join_query(fact, dim)
+        before = obs.REGISTRY.counter("integrity.corrupt").value
+        CHAOS.configure("seed=77,corrupt_spill=1.0")
+        try:
+            got = _join_query(
+                fact, dim,
+                fault_tolerance=True, hbq_path=str(tmp_path),
+                inject_failure={"after_tasks": 14,
+                                "channels": [(2, 0)]},  # join (optimize=False)
+            )
+        finally:
+            CHAOS.disable()
+        pd.testing.assert_frame_equal(got, baseline, check_exact=True,
+                                      check_dtype=False)
+        assert obs.REGISTRY.counter("integrity.corrupt").value > before
+
+    def test_sync_spill_env_fallback(self, tmp_path, monkeypatch):
+        """QK_SPILL_ASYNC=0 restores the synchronous spill (debug escape
+        hatch): identical results, spill landed by push return."""
+        monkeypatch.setattr(config, "SPILL_ASYNC", False)
+        r = np.random.default_rng(12)
+        fact = pa.table({"k": r.integers(0, 20, 2000).astype(np.int64),
+                         "v": r.integers(0, 9, 2000).astype(np.float64)})
+        dim = pa.table({"pk": np.arange(20, dtype=np.int64),
+                        "g": (np.arange(20) % 3).astype(np.int64)})
+        baseline = _join_query(fact, dim)
+        got = _join_query(fact, dim,
+                          fault_tolerance=True, hbq_path=str(tmp_path))
+        pd.testing.assert_frame_equal(got, baseline, check_exact=True,
+                                      check_dtype=False)
