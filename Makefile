@@ -4,7 +4,7 @@
 PY ?= python
 export JAX_PLATFORMS ?= cpu
 
-.PHONY: lint lint-baseline test test-slow sanitize-demo service-smoke chaos-smoke obs-smoke bench-check shuffle-smoke
+.PHONY: lint lint-baseline test test-slow sanitize-demo service-smoke chaos-smoke obs-smoke bench-check shuffle-smoke warmup-smoke
 
 # engine-invariant static analysis; exits nonzero on findings beyond the
 # checked-in baseline (quokka_tpu/analysis/baseline.json)
@@ -60,6 +60,14 @@ bench-check:
 # sanitizer sentinel), with nonzero shuffle.bytes proving the exchange ran
 shuffle-smoke:
 	$(PY) -m quokka_tpu.runtime.shuffle_smoke
+
+# compile-plane smoke: run a Q3-shaped query in one process (populating the
+# XLA + AOT executable caches and the plan ledger), then again in a FRESH
+# process against the populated cache — the fresh replica must pay zero
+# real backend compiles and show AOT prewarm/cache hits (cross-restart
+# executable persistence, runtime/compileplane.py)
+warmup-smoke:
+	$(PY) -m quokka_tpu.runtime.warmup_smoke
 
 # chaos plane soak: >= 20 seeded mixed-fault runs (RPC drops/delays, flaky
 # store calls, worker kills, spill + checkpoint corruption) each asserting

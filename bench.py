@@ -846,6 +846,72 @@ def _print_critpath_diff(metric, base_d, cur_d, out):
             out.write(f"      {k:<10} {v * 1e3:>9.1f}ms{delta}\n")
 
 
+# Warmup gates (lower-is-better, pulled from per-query bench detail):
+# (relative growth allowed, absolute slack).  real_compiles gets integer
+# slack so a 0 -> 2 wobble on a warm cache doesn't trip the gate, while a
+# 0 -> 11 signature-space regression does.
+WARMUP_GATES = {
+    "warmup_seconds": (0.5, 1.0),
+    "real_compiles_warmup": (0.5, 2.0),
+}
+
+
+def _warmup_details(metrics):
+    """{qname: {warmup_seconds, real_compiles_warmup}} from a bench metric
+    map — prefers the geomean line's nested per-query details, falls back
+    to the per-query lines."""
+    out = {}
+    for d in metrics.values():
+        detail = d.get("detail") or {}
+        queries = detail.get("queries")
+        if isinstance(queries, dict):
+            for q, qd in queries.items():
+                for k in WARMUP_GATES:
+                    if qd and qd.get(k) is not None:
+                        out.setdefault(q, {})[k] = float(qd[k])
+    if out:
+        return out
+    for metric, d in metrics.items():
+        if not metric.startswith("tpch_q"):
+            continue
+        q = metric.split("_")[1]
+        detail = d.get("detail") or {}
+        for k in WARMUP_GATES:
+            if detail.get(k) is not None:
+                out.setdefault(q, {})[k] = float(detail[k])
+    return out
+
+
+def check_warmup_gates(base, cur, current_not_comparable=False):
+    """Per-query warmup regression rows: warmup_seconds and
+    real_compiles_warmup must not grow past their gate (lower-is-better;
+    MISSING from the current run = regression — a silently vanished warmup
+    metric is exactly how warmup regressions would hide)."""
+    b_w, c_w = _warmup_details(base), _warmup_details(cur)
+    rows, regressed = [], []
+    for q in sorted(b_w):
+        for k, (thr, slack) in WARMUP_GATES.items():
+            if k not in b_w[q]:
+                continue
+            name = f"warmup[{q}].{k}"
+            b = b_w[q][k]
+            c = (c_w.get(q) or {}).get(k)
+            if c is None:
+                if current_not_comparable:
+                    rows.append((name, b, None, None, None, "not-run"))
+                else:
+                    rows.append((name, b, None, None, thr, "MISSING"))
+                    regressed.append(name)
+                continue
+            bad = c > b * (1.0 + thr) + slack
+            delta = (c - b) / b if b else None
+            rows.append((name, b, c, delta, thr,
+                         "REGRESSED" if bad else "ok"))
+            if bad:
+                regressed.append(name)
+    return rows, regressed
+
+
 def check_regressions(base, cur, threshold=None, not_run_prefixes=()):
     """Compare {metric: line} maps; returns (report_rows, regressed_list).
     A metric present in the baseline but missing from the current run
@@ -958,6 +1024,11 @@ def check_main(argv):
 
     rows, regressed = check_regressions(base, cur, args.threshold,
                                         not_run_prefixes=not_run_prefixes)
+    # warmup gates (lower-is-better): a truncated current tail cannot carry
+    # the per-query details, so absence there reports as not-run
+    w_rows, w_regressed = check_warmup_gates(
+        base, cur, current_not_comparable=bool(not_run_prefixes == ("",)))
+    regressed += w_regressed
     out = sys.stdout
     out.write(f"bench --check: {cur_src} vs {against}\n")
     if base_truncated:
@@ -972,6 +1043,13 @@ def check_main(argv):
                   f"{d_s:>8} {t_s}\n")
         if status == "REGRESSED":
             _print_critpath_diff(metric, base[metric], cur[metric], out)
+    for metric, b, c, delta, thr, status in w_rows:
+        b_s = f"{b:.4f}" if b is not None else "-"
+        c_s = f"{c:.4f}" if c is not None else "-"
+        d_s = f"{delta:+.1%}" if delta is not None else "-"
+        t_s = f"(allow +{thr:.0%})" if thr is not None else ""
+        out.write(f"  {status:>9}  {metric:<42} {b_s:>9} -> {c_s:>9} "
+                  f"{d_s:>8} {t_s}\n")
     if regressed:
         out.write(f"REGRESSION: {len(regressed)} metric(s) regressed "
                   f"beyond threshold: {', '.join(regressed)}\n")

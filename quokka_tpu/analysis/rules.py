@@ -44,6 +44,14 @@ Rule ids:
                                 critical path must never drain the device
                                 pipeline; deliberate readbacks carry
                                 baseline rationales
+  QK012 raw-len-cache-key       jit-program cache keys built from raw
+                                (un-bucketed) batch lengths (.padded_len /
+                                .shape[0]) outside ops/sigkey.py — every
+                                raw length in a key multiplies the compile
+                                space per 2x rung; keys must derive through
+                                sigkey (bucket_rows/batch_sig/aval_sig/
+                                make_key) so warmup compiles stay counted
+                                and canonical
 
 Finding keys (``Finding.key``) are line-number-free — ``rule::relpath::
 scope::snippet[::n]`` — so a baseline survives unrelated edits above the
@@ -1087,6 +1095,87 @@ def check_push_path_host_sync(tree: ast.Module, path: str, rel: str,
     return out
 
 
+# ---------------------------------------------------------------------------
+# QK012 — jit cache keys built from raw (un-bucketed) batch lengths
+# ---------------------------------------------------------------------------
+
+# the one module allowed to turn raw lengths into key material
+_SIGKEY_EXEMPT_SUFFIX = "ops/sigkey.py"
+# receivers that are program/kernel caches: .get()/subscript on these with
+# a raw length inside the key is the flagged shape
+_PROGRAM_CACHE_NAMES = ("PROGRAMS", "CACHE", "CACHES")
+
+
+def _raw_len_in(node: ast.AST) -> Optional[str]:
+    """'.padded_len' / '.shape[0]' when the expression embeds a raw batch
+    length, else None."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr == "padded_len":
+            return ".padded_len"
+        if (isinstance(sub, ast.Subscript)
+                and isinstance(sub.value, ast.Attribute)
+                and sub.value.attr == "shape"):
+            return ".shape[...]"
+    return None
+
+
+def _cacheish(name: Optional[str]) -> bool:
+    return name is not None and any(
+        name.upper().endswith(s) for s in _PROGRAM_CACHE_NAMES)
+
+
+def check_raw_len_cache_key(tree: ast.Module, path: str, rel: str,
+                            src_lines: Sequence[str]) -> List[Finding]:
+    """The compile plane's whole premise is ONE canonical key space: a jit
+    cache key built from a raw batch length fragments per 2x rung and per
+    call site, exactly the 11-15-compiles-per-query warmup BENCH_r05
+    measured.  Flags, outside ops/sigkey.py: (a) sig/key-named tuples
+    embedding .padded_len or .shape[...], (b) .get()/subscript access on
+    *_PROGRAMS/*_CACHE receivers whose key embeds one.  Canonical lengths
+    come from sigkey.bucket_rows/batch_sig/aval_sig/make_key."""
+    if rel.replace("\\", "/").endswith(_SIGKEY_EXEMPT_SUFFIX):
+        return []
+    out: List[Finding] = []
+
+    def _flag(node: ast.AST, what: str, shape: str) -> None:
+        out.append(_mk(
+            "QK012", "raw-len-cache-key", path, rel, node,
+            _scope_of(tree, node),
+            f"{shape} builds a jit cache key from a raw (un-bucketed) "
+            f"batch length ({what}) — every raw length fragments the "
+            "compile space per 2x rung; derive key dimensions through "
+            "quokka_tpu.ops.sigkey (bucket_rows / batch_sig / aval_sig / "
+            "make_key), or baseline with a rationale",
+            src_lines))
+
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            tname = node.targets[0].id.lower()
+            if (("sig" in tname or tname.endswith("key"))
+                    and isinstance(node.value, ast.Tuple)):
+                what = _raw_len_in(node.value)
+                if what is not None:
+                    _flag(node, what, f"'{node.targets[0].id} = (...)'")
+            # subscript-store into a program cache: _CACHE[(... len ...)] = fn
+            continue
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            if node.func.attr == "get":
+                recv = _dotted(node.func.value)
+                if _cacheish(recv) and node.args:
+                    what = _raw_len_in(node.args[0])
+                    if what is not None:
+                        _flag(node, what, f"'{recv}.get(...)'")
+            continue
+        if isinstance(node, ast.Subscript):
+            recv = _dotted(node.value)
+            if _cacheish(recv):
+                what = _raw_len_in(node.slice)
+                if what is not None:
+                    _flag(node, what, f"'{recv}[...]'")
+    return out
+
+
 RULES = (
     check_module_level_jit,
     check_import_time_side_effects,
@@ -1099,6 +1188,7 @@ RULES = (
     check_unbounded_io,
     check_adhoc_counter_dict,
     check_push_path_host_sync,
+    check_raw_len_cache_key,
 )
 
 

@@ -22,12 +22,16 @@ import jax.numpy as jnp
 # (tens of seconds per program over a remote TPU runtime); cache executables on
 # disk so they amortize across processes and queries.
 def _host_fingerprint() -> str:
-    """Per-microarchitecture cache namespace: XLA:CPU AOT executables are
+    """Per-backend/topology cache namespace: XLA:CPU AOT executables are
     compiled for the build host's CPU features and the cache key does NOT
     include them, so an entry written on one machine can SIGILL on another
     (observed as cpu_aot_loader 'machine type mismatch' errors when $HOME
     moves across heterogeneous hosts).  Keying the directory on the CPU
-    flag set makes a foreign host a cache MISS instead of a crash."""
+    flag set + jax version + requested platform makes a foreign host (or a
+    jax upgrade, whose executable serialization format drifts) a cache
+    MISS instead of a crash.  Device kind/count join the fingerprint
+    lazily in runtime/compileplane.py (reading them here would initialize
+    the backend at import time)."""
     import hashlib
     import platform as _plat
 
@@ -40,6 +44,10 @@ def _host_fingerprint() -> str:
                     break
     except OSError:
         pass
+    # the env-requested platform is known without initializing the backend;
+    # jax.__version__ is a plain attribute
+    feat += "|" + os.environ.get("JAX_PLATFORMS", "")
+    feat += "|" + getattr(jax, "__version__", "")
     h = hashlib.sha256(feat.encode()).hexdigest()[:10]
     return f"{_plat.machine()}-{h}"
 
@@ -51,6 +59,9 @@ if not _cache_dir:
     # over the remote-TPU compile tunnel).  Opt out with
     # QUOKKA_JAX_CACHE_DIR=0.
     _cache_dir = os.path.expanduser("~/.cache/quokka_tpu_jax")
+# the un-fingerprinted cache root ("" when opted out): the AOT executable
+# store (runtime/compileplane.py) lives beside the XLA cache under it
+CACHE_ROOT = _cache_dir if _cache_dir and _cache_dir != "0" else ""
 if _cache_dir and _cache_dir != "0":
     try:
         _cache_dir = os.path.join(_cache_dir, _host_fingerprint())
@@ -78,19 +89,29 @@ except Exception:
 # Padding buckets
 # ---------------------------------------------------------------------------
 
-MIN_BUCKET = 256
-MAX_BUCKET = 1 << 24
+# MIN_BUCKET / MAX_BUCKET resolve lazily (module __getattr__ below) from
+# ops/sigkey — the canonical ladder.  An eager `from quokka_tpu.ops import
+# sigkey` here would execute the ops package __init__ (batch, bridge, jax
+# array machinery) while config is still half-initialized: the cycle only
+# works as long as those modules touch config strictly at call time.
 
 
 def bucket_size(n: int) -> int:
-    """Smallest padding bucket that fits n rows (next power of two, floored at
-    MIN_BUCKET). Static-shape discipline: all kernels see bucketed lengths."""
-    if n <= MIN_BUCKET:
-        return MIN_BUCKET
-    b = 1 << (int(n - 1).bit_length())
-    if b > MAX_BUCKET:
-        raise ValueError(f"batch of {n} rows exceeds max bucket {MAX_BUCKET}")
-    return b
+    """Smallest padding bucket that fits n rows.  Static-shape discipline:
+    all kernels see bucketed lengths.  The ladder (ops/sigkey.bucket_rows)
+    is pow2 with 4x rung spacing below 64Ki rows, so the compile-key space
+    over small intermediates stays half the size of a pure 2x ladder."""
+    from quokka_tpu.ops import sigkey
+
+    return sigkey.bucket_rows(n)
+
+
+def __getattr__(name: str):
+    if name in ("MIN_BUCKET", "MAX_BUCKET"):
+        from quokka_tpu.ops import sigkey
+
+        return getattr(sigkey, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 # ---------------------------------------------------------------------------

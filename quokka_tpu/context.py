@@ -347,6 +347,16 @@ class QuokkaContext:
             if pl is not None:
                 graph.actors[aid].placement = pl
         self.latest_graph = graph
+        # compile plane: fingerprint the lowered plan and start loading its
+        # persisted executables in the background — warmup overlaps the
+        # scan/admission work between here and the first dispatch
+        from quokka_tpu.runtime import compileplane
+
+        graph.plan_fp = compileplane.plan_fingerprint(graph)
+        # kept on the graph so a caller that wants a SYNCHRONOUS warm
+        # (QueryService.prewarm) joins this thread instead of racing a
+        # duplicate replay over the same executables
+        graph.prewarm_thread = compileplane.prewarm_plan(graph.plan_fp)
         return actor_of[sink_id]
 
     def lower_into(self, node_id: int, graph: TaskGraph) -> int:

@@ -50,6 +50,12 @@ _LABEL_FAMILIES: Tuple[Tuple[str, str, str, str], ...] = (
     ("counter", "cache.plan_miss.", "quokka_cache_plan_miss", "query"),
     ("counter", "chaos.", "quokka_chaos_injected", "site"),
     ("counter", "rpc.", "quokka_rpc_calls", "method"),
+    # compile plane (runtime/compileplane.py): per-query twins of the
+    # cache-hit/miss/prewarm-hit event counters
+    ("counter", "compile.cache_hit.", "quokka_compile_cache_hit", "query"),
+    ("counter", "compile.miss.", "quokka_compile_miss", "query"),
+    ("counter", "compile.prewarm_hit.", "quokka_compile_prewarm_hit",
+     "query"),
 )
 
 # Aggregate instruments that ALSO exist as a labeled per-query family: the
@@ -61,6 +67,9 @@ _EXACT_FAMILIES: Dict[Tuple[str, str], str] = {
     ("histogram", "task.latency_s"): "quokka_task_latency_all_seconds",
     ("counter", "cache.plan_hit"): "quokka_cache_plan_hit_all",
     ("counter", "cache.plan_miss"): "quokka_cache_plan_miss_all",
+    ("counter", "compile.cache_hit"): "quokka_compile_cache_hit_all",
+    ("counter", "compile.miss"): "quokka_compile_miss_all",
+    ("counter", "compile.prewarm_hit"): "quokka_compile_prewarm_hit_all",
 }
 
 

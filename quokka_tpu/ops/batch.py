@@ -356,7 +356,10 @@ def gather_columns(columns: Dict[str, "Column"], idx: jax.Array) -> Dict[str, "C
             if c.hi is not None:
                 arrays.append(c.hi)
             arrays.append(c.data)
-    gathered = iter(_gather_all(tuple(arrays), idx))
+    from quokka_tpu.runtime import compileplane
+
+    gathered = iter(compileplane.aot_kernel_call(
+        "gather", _gather_all, (tuple(arrays), idx)))
     out: Dict[str, Column] = {}
     for n, c in columns.items():
         if isinstance(c, StrCol):

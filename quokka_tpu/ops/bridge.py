@@ -435,8 +435,11 @@ def _try_fused_concat(batches, total: int, padded: int):
                                 tuple(c.hi for c in cols)))
             str_meta[name] = cols[0]  # aligned kind/unit source
     valids = tuple(jnp.asarray(b.valid) for b in batches)
-    outs, valid = _fused_concat_kernel(
-        tuple(arrs for (_n, _k, arrs) in per_col), valids, padded)
+    from quokka_tpu.runtime import compileplane
+
+    outs, valid = compileplane.aot_kernel_call(
+        "fused_concat", _fused_concat_kernel,
+        (tuple(arrs for (_n, _k, arrs) in per_col), valids), (padded,))
     out_cols = {}
     it = iter(zip(per_col, outs))
     pending_hi = {}

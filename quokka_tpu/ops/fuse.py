@@ -47,8 +47,9 @@ from quokka_tpu.expression import (
 import numpy as np
 
 from quokka_tpu import config
-from quokka_tpu.ops import expr_compile, kernels
+from quokka_tpu.ops import expr_compile, kernels, sigkey
 from quokka_tpu.ops.batch import DeviceBatch, NumCol, StrCol, gather_columns
+from quokka_tpu.runtime import compileplane
 
 
 def _is_string_dependent(e: Expr, batch: DeviceBatch) -> bool:
@@ -141,22 +142,34 @@ class _ShimBatch:
         return list(self.columns.keys())
 
 
-def _signature(batch: DeviceBatch, names: Sequence[str]) -> Tuple:
-    sig = [batch.padded_len]
-    for n in names:
-        c = batch.columns[n]
-        if isinstance(c, StrCol):
-            sig.append((n, "str"))
-        else:
-            sig.append((n, c.kind, str(c.data.dtype), c.hi is not None))
-    return tuple(sig)
-
-
 # Fused programs are cached GLOBALLY by full structural signature so separate
 # executor instances (and separate queries) reuse the same jitted callable —
 # jax's trace cache is keyed by function identity, so per-instance closures
-# would recompile on every query.
-_FUSED_PROGRAMS: Dict[Tuple, object] = {}
+# would recompile on every query.  The dict is the compile plane's program
+# store: signatures derive through ops/sigkey (canonical ladder, normalized
+# column signatures) and misses resolve through compileplane.acquire, which
+# loads a persisted executable when one exists and AOT-compiles otherwise.
+_FUSED_PROGRAMS: Dict[Tuple, object] = compileplane.PROGRAMS
+
+
+def _dispatch_program(sig, builder, args):
+    """Hot-path program dispatch: one dict get per batch; misses go through
+    the compile plane (persisted-executable load, else explicit AOT
+    compile + background persist).  A pre-warmed executable whose shapes
+    drift from this call rebuilds in place instead of erroring."""
+    fn = _FUSED_PROGRAMS.get(sig)
+    if fn is None:
+        fn = compileplane.acquire(sig, builder, args)
+    else:
+        # record the use under the current plan even on a warm hit (a new
+        # plan reusing another's programs must still prewarm them all)
+        compileplane.note_program(sig)
+    try:
+        return fn(*args)
+    except compileplane.AotMismatch:
+        fn = builder()
+        _FUSED_PROGRAMS[sig] = fn
+        return fn(*args)
 
 
 # Small-key group-by: the one-hot operand the MXU matmul contracts over is
@@ -245,9 +258,9 @@ class FusedPartialAgg:
                 if c.hi is not None:
                     key_limbs.append(c.hi)
                 key_limbs.append(c.data)
-        sig = (
+        sig = sigkey.make_key(
             "partial_agg",
-            _signature(batch, list(num_inputs)),
+            sigkey.batch_sig(batch, list(num_inputs)),
             tuple(sorted(pre.bound)),
             tuple(str(l.dtype) for l in key_limbs),
             tuple((n, e.sql()) for n, e in pre_exprs),
@@ -255,28 +268,28 @@ class FusedPartialAgg:
             bool(self.keys),
             config.use_hash_tables(),  # strategy is baked into the program
         )
-        fn = _FUSED_PROGRAMS.get(sig)
-        if fn is None:
-            fn = self._build(pre_exprs, list(num_inputs), sorted(pre.bound), len(key_limbs))
-            _FUSED_PROGRAMS[sig] = fn
+        builder = lambda: self._build(  # noqa: E731 — deferred to cache miss
+            pre_exprs, list(num_inputs), sorted(pre.bound), len(key_limbs))
         return self._invoke(
-            fn, batch, pre, num_inputs, tuple(key_limbs), batch.padded_len
+            sig, builder, batch, pre, num_inputs, tuple(key_limbs),
+            batch.padded_len,
         )
 
-    def _invoke(self, fn, batch, pre, num_inputs, key_arrays, out_pad):
+    def _invoke(self, sig, builder, batch, pre, num_inputs, key_arrays,
+                out_pad):
         """Shared dispatch tail: run the fused program and assemble the
         partial-aggregate output batch (used by both strategies)."""
         hi_arrays = tuple(
             c.hi if c.hi is not None else jnp.zeros(0, jnp.int32)
             for c in num_inputs.values()
         )
-        outs = fn(
+        outs = _dispatch_program(sig, builder, (
             tuple(c.data for c in num_inputs.values()),
             hi_arrays,
             tuple(pre.bound[k] for k in sorted(pre.bound)),
             key_arrays,
             batch.valid,
-        )
+        ))
         *agg_arrays, rep, num = outs
         cols = gather_columns({k: batch.columns[k] for k in self.keys}, rep)
         for (pname, _, _), arr in zip(self.plan.partials, agg_arrays):
@@ -322,22 +335,19 @@ class FusedPartialAgg:
     def _call_small(self, batch, pre, pre_exprs, num_inputs, dims):
         codes = tuple(batch.columns[k].codes for k in self.keys)
         out_pad = config.bucket_size(int(np.prod(dims)))
-        sig = (
+        sig = sigkey.make_key(
             "partial_agg_small",
-            _signature(batch, list(num_inputs)),
+            sigkey.batch_sig(batch, list(num_inputs)),
             tuple(sorted(pre.bound)),
             dims,
             tuple((n, e.sql()) for n, e in pre_exprs),
             tuple((p, op, tmp) for p, op, tmp in self.plan.partials),
             config.use_hash_tables(),  # strategy is baked into the program
         )
-        fn = _FUSED_PROGRAMS.get(sig)
-        if fn is None:
-            fn = self._build_small(
-                pre_exprs, list(num_inputs), sorted(pre.bound), dims, out_pad
-            )
-            _FUSED_PROGRAMS[sig] = fn
-        return self._invoke(fn, batch, pre, num_inputs, codes, out_pad)
+        builder = lambda: self._build_small(  # noqa: E731 — on cache miss
+            pre_exprs, list(num_inputs), sorted(pre.bound), dims, out_pad)
+        return self._invoke(sig, builder, batch, pre, num_inputs, codes,
+                            out_pad)
 
     def _build_small(self, pre_exprs, num_names, bound_names, dims, out_pad):
         plan = self.plan
@@ -484,7 +494,7 @@ class FusedPartialAgg:
 
 
 def _pow2(n: int) -> int:
-    return 1 << max(0, int(n - 1)).bit_length()
+    return sigkey.pow2_dim(n)
 
 
 def _pad_tail(arr, padded):
@@ -528,14 +538,14 @@ class FusedPredicate:
         if not ok:
             mask = expr_compile.evaluate_predicate(self.expr, batch)
             return kernels.apply_mask(batch, mask)
-        sig = (
+        sig = sigkey.make_key(
             "predicate",
-            _signature(batch, list(num_inputs)),
+            sigkey.batch_sig(batch, list(num_inputs)),
             tuple(sorted(pre.bound)),
             e.sql(),
         )
-        fn = _FUSED_PROGRAMS.get(sig)
-        if fn is None:
+
+        def builder():
             names, bnames = list(num_inputs), sorted(pre.bound)
 
             @jax.jit
@@ -549,11 +559,11 @@ class FusedPredicate:
                 m = valid & expr_compile.evaluate_predicate(e, shim)
                 return m, jnp.sum(m.astype(jnp.int32))
 
-            fn = fused
-            _FUSED_PROGRAMS[sig] = fn
-        mask, num = fn(
+            return fused
+
+        mask, num = _dispatch_program(sig, builder, (
             tuple(num_inputs[n].data for n in num_inputs),
             tuple(pre.bound[k] for k in sorted(pre.bound)),
             batch.valid,
-        )
+        ))
         return DeviceBatch(batch.columns, mask, None, batch.sorted_by).note_count(num)

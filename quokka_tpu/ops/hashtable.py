@@ -220,8 +220,12 @@ def _insert(limbs, valid, capbits: int):
     """(myslot, table, converged).  Traced calls cannot host-check the
     converged flag; it stays an array for the caller's program (build_table,
     the only untraced consumer, checks it and raises)."""
-    fn = _insert_body if _in_trace() else _insert_jit
-    return fn(limbs, valid, capbits)
+    if _in_trace():
+        return _insert_body(limbs, valid, capbits)
+    from quokka_tpu.runtime import compileplane
+
+    return compileplane.aot_kernel_call(
+        "ht_insert", _insert_jit, (limbs, valid), (capbits,))
 
 
 def table_rid(tbl: jax.Array) -> jax.Array:
@@ -264,8 +268,13 @@ _probe_jit = functools.partial(jax.jit, static_argnames=("capbits",))(_probe_bod
 
 
 def _probe(table, build_limbs, probe_limbs, probe_ok, capbits: int):
-    fn = _probe_body if _in_trace() else _probe_jit
-    return fn(table, build_limbs, probe_limbs, probe_ok, capbits)
+    if _in_trace():
+        return _probe_body(table, build_limbs, probe_limbs, probe_ok, capbits)
+    from quokka_tpu.runtime import compileplane
+
+    return compileplane.aot_kernel_call(
+        "ht_probe", _probe_jit, (table, build_limbs, probe_limbs, probe_ok),
+        (capbits,))
 
 
 def hash_groupby(limbs: Tuple[jax.Array, ...], arrays: Tuple[jax.Array, ...],

@@ -28,6 +28,7 @@ from jax import lax
 
 from quokka_tpu import config
 from quokka_tpu.ops import kernels
+from quokka_tpu.runtime import compileplane
 from quokka_tpu.ops.batch import (
     DeviceBatch, NumCol, StrCol, gather_columns, key_limbs, null_mask, with_nulls,
 )
@@ -119,7 +120,8 @@ def _build_sorted_cached(build: DeviceBatch, build_keys: Sequence[str]):
     if hit is None:
         limbs = key_limbs(build, build_keys)
         ok = _nonnull_valid(build, build_keys)
-        hit = cache[key] = _sort_build_keys(tuple(limbs), ok)
+        hit = cache[key] = compileplane.aot_kernel_call(
+            "sort_build_keys", _sort_build_keys, (tuple(limbs), ok))
     return hit
 
 
@@ -177,10 +179,13 @@ def hash_join_pk(
         assert len(probe_limbs) == len(sorted_limbs), \
             "join key column types must match"
         steps = max(1, int(np.ceil(np.log2(max(2, build.padded_len)))) + 1)
-        build_idx, matched = _pk_probe_sorted(
-            tuple(sorted_limbs), perm, n_valid,
-            tuple(l.astype(s.dtype) for l, s in zip(probe_limbs, sorted_limbs)),
-            probe_ok, steps,
+        build_idx, matched = compileplane.aot_kernel_call(
+            "pk_probe_sorted", _pk_probe_sorted,
+            (tuple(sorted_limbs), perm, n_valid,
+             tuple(l.astype(s.dtype)
+                   for l, s in zip(probe_limbs, sorted_limbs)),
+             probe_ok),
+            (steps,),
         )
     if how == "semi":
         return kernels.apply_mask(probe, matched)
@@ -245,7 +250,9 @@ def mm_plan_for(limbs, valid, p: int, how: str, probe_valid=None):
     """Shared many-to-many planning for the embedded AND mesh join paths:
     per-probe match counts (left joins get a synthetic row for unmatched
     probes), total output rows, and the sorted-build expansion tables."""
-    match_count, total, offsets, build_pos_sorted, rp = _mm_plan(tuple(limbs), valid, p)
+    match_count, total, offsets, build_pos_sorted, rp = \
+        compileplane.aot_kernel_call(
+            "mm_plan", _mm_plan, (tuple(limbs), valid), (p,))
     if how == "left":
         pv = valid[:p] if probe_valid is None else probe_valid
         match_count = jnp.where(pv & (match_count == 0), 1, match_count)
@@ -281,8 +288,9 @@ def hash_join_general(
     )
     ntotal = int(total)  # host sync: pick output bucket
     out_padded = config.bucket_size(ntotal)
-    probe_idx, build_idx, out_valid = _mm_expand(
-        match_count, offsets, build_pos_sorted, rp, total, out_padded
+    probe_idx, build_idx, out_valid = compileplane.aot_kernel_call(
+        "mm_expand", _mm_expand,
+        (match_count, offsets, build_pos_sorted, rp, total), (out_padded,)
     )
     cols = gather_columns(probe.columns, probe_idx)
     unmatched = None

@@ -36,8 +36,16 @@ from quokka_tpu.ops.batch import DeviceBatch, NumCol, StrCol, gather_columns, ke
 # ---------------------------------------------------------------------------
 
 
+def _aot(kind, jit_fn, args, statics=()):
+    """Kernel dispatch through the compile plane (persisted executables,
+    canonical aval keys); inlines untouched inside traces."""
+    from quokka_tpu.runtime import compileplane
+
+    return compileplane.aot_kernel_call(kind, jit_fn, args, statics)
+
+
 def apply_mask(batch: DeviceBatch, mask: jax.Array) -> DeviceBatch:
-    new_valid, num = _mask_and_count(batch.valid, mask)
+    new_valid, num = _aot("mask_count", _mask_and_count, (batch.valid, mask))
     return DeviceBatch(batch.columns, new_valid, None, batch.sorted_by).note_count(num)
 
 
@@ -60,7 +68,7 @@ def compact(batch: DeviceBatch) -> DeviceBatch:
     padded = config.bucket_size(n)
     if n == batch.padded_len and padded == batch.padded_len:
         return batch
-    idx = _compact_idx(batch.valid, padded)
+    idx = _aot("compact_idx", _compact_idx, (batch.valid,), (padded,))
     valid = jnp.arange(padded) < n
     return batch.take(idx, valid, n)
 
@@ -343,12 +351,12 @@ def _sort_perm(limbs: Tuple[jax.Array, ...], valid: jax.Array):
 
 def sort_batch(batch: DeviceBatch, by: Sequence[str], descending=None) -> DeviceBatch:
     limbs = sort_limbs(batch, by, descending)
-    perm = _sort_perm(tuple(limbs), batch.valid)
+    perm = _aot("sort_perm", _sort_perm, (tuple(limbs), batch.valid))
     out = batch.take(perm, batch.valid, batch.nrows)
     # valid rows are now contiguous at the front; derive the mask on device
     # (a host count here would cost a full round trip per sort) and start the
     # count's async host copy so a later compact/head is sync-free
-    out.valid, n = _prefix_mask(batch.valid)
+    out.valid, n = _aot("prefix_mask", _prefix_mask, (batch.valid,))
     out.nrows = batch.nrows
     out.sorted_by = list(by)
     return out.note_count(n)
@@ -388,7 +396,7 @@ def _partition_ids(limbs: Tuple[jax.Array, ...], n_parts: int):
 
 def partition_ids(batch: DeviceBatch, keys: Sequence[str], n_parts: int) -> jax.Array:
     limbs = key_limbs(batch, keys)
-    return _partition_ids(tuple(limbs), n_parts)
+    return _aot("partition_ids", _partition_ids, (tuple(limbs),), (n_parts,))
 
 
 @functools.partial(jax.jit, static_argnames=("n_parts",))
@@ -475,12 +483,14 @@ def split_by_partition(batch: DeviceBatch, part_ids: jax.Array, n_parts: int,
         compact = (batch.padded_len > (1 << 16)
                    and n_parts * batch.padded_len > config.SHUFFLE_MASKED_CAP)
     if not compact:
-        masks, counts = _split_masks(part_ids, batch.valid, n_parts)
+        masks, counts = _aot("split_masks", _split_masks,
+                             (part_ids, batch.valid), (n_parts,))
         return [
             DeviceBatch(batch.columns, m, None, batch.sorted_by).note_count(c)
             for m, c in zip(masks, counts)
         ]
-    perm, counts, offsets = _partition_plan(part_ids, batch.valid, n_parts)
+    perm, counts, offsets = _aot("partition_plan", _partition_plan,
+                                 (part_ids, batch.valid), (n_parts,))
     with contextlib.suppress(Exception):  # numpy-backed arrays lack it
         counts.copy_to_host_async()
     _shuffle_sync()
@@ -496,7 +506,8 @@ def split_by_partition(batch: DeviceBatch, part_ids: jax.Array, n_parts: int,
     for p in range(n_parts):
         cnt = int(host_counts[p])
         padded = uniform if use_uniform else config.bucket_size(cnt)
-        idx, valid = _part_window(perm, offsets[p], counts[p], padded)
+        idx, valid = _aot("part_window", _part_window,
+                          (perm, offsets[p], counts[p]), (padded,))
         out.append(batch.take(idx, valid, cnt))
     return out
 

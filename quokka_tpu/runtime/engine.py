@@ -189,7 +189,17 @@ class TaskGraph:
                                 f"cache.plan_miss.{self.query_id}",
                                 f"task.latency_s.{self.query_id}",
                                 f"shuffle.bytes.{self.query_id}",
-                                f"shuffle.host_syncs.{self.query_id}")
+                                f"shuffle.host_syncs.{self.query_id}",
+                                f"compile.cache_hit.{self.query_id}",
+                                f"compile.miss.{self.query_id}",
+                                f"compile.prewarm_hit.{self.query_id}")
+        # persist this query's program set under its plan fingerprint so the
+        # NEXT submit of the same plan shape pre-warms from disk
+        fp = getattr(self, "plan_fp", None)
+        if fp is not None:
+            from quokka_tpu.runtime import compileplane
+
+            compileplane.flush_plan(fp)
 
     def _new_actor(self, kind, channels, stage, sorted_actor=False) -> ActorInfo:
         info = ActorInfo(self._next_actor, kind, channels, stage, sorted_actor)
@@ -1410,6 +1420,14 @@ class Engine:
         self._shuffle_syncs_q = (
             obs.REGISTRY.counter(f"shuffle.host_syncs.{qid}")
             if qid is not None else None)
+        # compile-plane attribution: per-query twins of the compile.* event
+        # counters (GC'd in TaskGraph.cleanup) plus the plan fingerprint the
+        # query's program uses are recorded under (runtime/compileplane.py)
+        self._compile_counters = (
+            {ev: obs.REGISTRY.counter(f"compile.{ev}.{qid}")
+             for ev in ("cache_hit", "miss", "prewarm_hit")}
+            if qid is not None else None)
+        self._plan_fp = getattr(graph, "plan_fp", None)
 
     def _observe_latency(self, dt: float) -> None:
         """Dispatch latency into the typed histograms (resolved once in
@@ -1421,13 +1439,19 @@ class Engine:
             self._qlat_hist.observe(dt)
 
     def _dispatch(self, task) -> bool:
-        if task.name == "input":
-            return self.handle_input_task(task)
-        if task.name == "exec":
-            return self.handle_exec_task(task)
-        if task.name == "exectape":
-            return self.handle_exectape_task(task)
-        return self.handle_replay_task(task)
+        from quokka_tpu.runtime import compileplane
+
+        # every program this dispatch compiles/loads is attributed to this
+        # query (per-query compile.* counters) and recorded under its plan
+        # fingerprint for the next submit's pre-warm
+        with compileplane.query_scope(self._compile_counters, self._plan_fp):
+            if task.name == "input":
+                return self.handle_input_task(task)
+            if task.name == "exec":
+                return self.handle_exec_task(task)
+            if task.name == "exectape":
+                return self.handle_exectape_task(task)
+            return self.handle_replay_task(task)
 
     def handle_replay_task(self, task: ReplayTask) -> bool:
         """Re-push spilled post-partition objects to the (rebuilt) consumer's

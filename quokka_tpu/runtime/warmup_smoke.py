@@ -1,0 +1,124 @@
+"""Compile-plane smoke: a RESTARTED process must serve its first query warm.
+
+    python -m quokka_tpu.runtime.warmup_smoke      (or: make warmup-smoke)
+
+Two child processes share one fresh cache directory:
+
+1. **populate** — runs a seeded Q3-shaped join+aggregate (the shuffle-smoke
+   pipeline) cold: real compiles happen here, executables persist via the
+   XLA compilation cache AND the AOT executable store, the plan ledger
+   records the program set.
+2. **fresh replica** — a brand-new process runs the same query against the
+   populated cache and must show
+
+   - ZERO real backend compiles (``real_compiles`` from
+     utils/compilestats: every program answered from a persisted artifact),
+   - the compile plane engaged (``compile.prewarm_hit`` +
+     ``compile.cache_hit`` > 0 — the warm start came from the AOT store,
+     not luck), and
+   - a warmup wall no slower than the populate run (sanity).
+
+Exit nonzero on any violation with both children's stats printed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+
+def _child(data_dir: str) -> int:
+    from quokka_tpu import QuokkaContext
+    from quokka_tpu.runtime.shuffle_smoke import _make_tables, _query
+    from quokka_tpu.utils import compilestats
+
+    fp, dp = _make_tables(data_dir)
+    ctx = QuokkaContext(io_channels=2, exec_channels=2)
+    c0 = compilestats.snapshot()
+    t0 = time.time()
+    df = _query(ctx, fp, dp).collect()
+    wall = time.time() - t0
+    c1 = compilestats.snapshot()
+    assert len(df) > 0, "warmup smoke query returned no rows"
+    from quokka_tpu.runtime import compileplane
+
+    compileplane.drain_writes()
+    stats = compileplane.stats()
+    # stdout IS the child protocol here (the parent parses this line);
+    # not a diagnostic, so it bypasses obs.diag deliberately
+    sys.stdout.write(json.dumps({
+        "wall_s": round(wall, 3),
+        "real_compiles": c1["real_compiles"] - c0["real_compiles"],
+        "cache_hits": c1["cache_hits"] - c0["cache_hits"],
+        "aot_cache_hit": stats.get("cache_hit", 0),
+        "aot_miss": stats.get("miss", 0),
+        "prewarm_hit": stats.get("prewarm_hit", 0),
+        "prewarm_loaded": stats.get("prewarm_loaded", 0),
+    }) + "\n")
+    return 0
+
+
+def _run_child(data_dir: str, cache_dir: str) -> dict:
+    env = dict(os.environ)
+    env["QUOKKA_JAX_CACHE_DIR"] = cache_dir
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "quokka_tpu.runtime.warmup_smoke",
+         "--child", data_dir],
+        capture_output=True, text=True, timeout=600, env=env,
+    )
+    if r.returncode != 0:
+        raise RuntimeError(
+            f"warmup-smoke child rc={r.returncode}:\n{r.stderr[-2000:]}")
+    line = [ln for ln in r.stdout.strip().splitlines()
+            if ln.startswith("{")][-1]
+    return json.loads(line)
+
+
+def main() -> int:
+    with tempfile.TemporaryDirectory(prefix="qk-warmup-smoke-") as tmp:
+        data_dir = os.path.join(tmp, "data")
+        cache_dir = os.path.join(tmp, "cache")
+        os.makedirs(data_dir)
+        cold = _run_child(data_dir, cache_dir)
+        warm = _run_child(data_dir, cache_dir)
+        print(f"warmup-smoke: cold {cold}")
+        print(f"warmup-smoke: fresh-replica {warm}")
+        if warm["real_compiles"] != 0:
+            print(
+                f"warmup-smoke: FAIL — a fresh process against the "
+                f"populated cache paid {warm['real_compiles']} real "
+                "backend compile(s); cross-restart persistence broke "
+                "(nondeterministic program construction, a cache-key "
+                "drift, or a fingerprint mismatch)", file=sys.stderr)
+            return 1
+        if warm["prewarm_hit"] + warm["aot_cache_hit"] == 0:
+            print(
+                "warmup-smoke: FAIL — zero AOT prewarm/cache hits in the "
+                "fresh replica: the warm start came from the XLA cache "
+                "alone, the compile plane's executable store never "
+                "engaged", file=sys.stderr)
+            return 1
+        if warm["wall_s"] > cold["wall_s"]:
+            print(
+                f"warmup-smoke: FAIL — the fresh replica's first query "
+                f"({warm['wall_s']}s) was SLOWER than the cold populate "
+                f"run ({cold['wall_s']}s) despite paying zero compiles: "
+                "warmup work (prewarm loads, ledger reads) is landing on "
+                "the dispatch critical path", file=sys.stderr)
+            return 1
+    print("warmup-smoke: OK — fresh replica started warm "
+          f"(0 real compiles, {warm['prewarm_hit']} prewarm hits, "
+          f"{warm['aot_cache_hit']} AOT loads, "
+          f"wall {cold['wall_s']}s -> {warm['wall_s']}s)")
+    return 0
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 2 and sys.argv[1] == "--child":
+        sys.exit(_child(sys.argv[2]))
+    sys.exit(main())

@@ -134,6 +134,58 @@ class QueryService:
         # QK_METRICS_PORT: external scrapers watch this service live
         # (/metrics Prometheus text + /status JSON of stats())
         self.metrics_server = obs.export.start_from_env(service=self)
+        # QK_PREWARM=1: load every recorded plan's persisted executables in
+        # the background at startup, so even the first-ever submit of a
+        # known plan shape dispatches against warm programs
+        if os.environ.get("QK_PREWARM", "") not in ("", "0"):
+            from quokka_tpu.runtime import compileplane
+
+            compileplane.prewarm_all(wait=False)
+
+    def prewarm(self, streams=None, timeout: float = 120.0) -> int:
+        """Ahead-of-time warm the compile plane before traffic arrives.
+
+        ``streams``: DataStreams whose plans this service will soon run —
+        each is lowered into a throwaway graph to derive its plan
+        fingerprint, and that plan's persisted executables are loaded
+        synchronously (bounded by ``timeout``).  ``streams=None`` replays
+        EVERY plan the ledger has ever recorded and returns the number of
+        plans that loaded >= 1 persisted executable; with ``streams`` it
+        returns the number of streams whose plan warmup was dispatched (an
+        already-resident plan needs none and contributes 0).  Never raises
+        (warmup is an optimization layer)."""
+        import contextlib
+
+        from quokka_tpu.runtime import compileplane
+        from quokka_tpu.runtime.tables import ControlStore
+
+        if streams is None:
+            return compileplane.prewarm_all(wait=True, timeout=timeout)
+        n = 0
+        for stream in streams:
+            # the throwaway graph exists only to derive plan_fp: restore the
+            # context's latest_graph (introspection must keep answering from
+            # the last EXECUTED graph) and tear down its spill dirs
+            prev = getattr(stream.ctx, "latest_graph", None)
+            graph = None
+            try:
+                graph = TaskGraph(self.exec_config, store=ControlStore())
+                stream.ctx.lower_into(stream.node_id, graph)
+                # lowering already fired this plan's background replay
+                # (_lower_plan); wait on THAT thread rather than spawning
+                # a duplicate that would race it over the same .aot files
+                t = getattr(graph, "prewarm_thread", None)
+                if t is not None:
+                    t.join(timeout)
+                n += t is not None
+            except Exception as e:  # noqa: BLE001 — warm less, never fail
+                obs.diag(f"[service] prewarm of a stream failed: {e!r}")
+            finally:
+                stream.ctx.latest_graph = prev
+                if graph is not None:
+                    with contextlib.suppress(Exception):
+                        graph.cleanup()
+        return n
 
     # -- client surface ------------------------------------------------------
     def submit(self, stream, *, working_set_bytes: Optional[int] = None,

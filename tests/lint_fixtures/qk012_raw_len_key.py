@@ -1,0 +1,16 @@
+"""QK012 fixture: jit cache keys built from raw (un-bucketed) batch lengths.
+
+Three findings: a sig-named tuple carrying .padded_len, a program-cache
+.get() keyed on .shape[0], and a cache-subscript store keyed on
+.padded_len.  Canonical keys must derive through quokka_tpu.ops.sigkey.
+"""
+
+_PROGRAMS = {}
+_KERNEL_CACHE = {}
+
+
+def lookup(batch, arr, fn):
+    sig = (batch.padded_len, "f8")  # finding 1: raw length in a sig tuple
+    hit = _PROGRAMS.get((arr.shape[0], "i4"))  # finding 2: raw .shape[0] key
+    _KERNEL_CACHE[(batch.padded_len, "sum")] = fn  # finding 3: keyed store
+    return sig, hit

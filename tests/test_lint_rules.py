@@ -25,6 +25,7 @@ CASES = [
     ("QK009", "qk009_io_timeout.py", 5),     # create_connection, settimeout(None), timeout=None, fsspec.open, fs.mv
     ("QK010", "qk010_counter_dict.py", 3),   # 2x dict +=, 1x .get()+1 RMW
     ("QK011", "qk011_push_sync.py", 3),      # np.asarray, .item(), device_get
+    ("QK012", "qk012_raw_len_key.py", 3),    # sig tuple, .get key, store key
 ]
 
 
