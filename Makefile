@@ -4,7 +4,7 @@
 PY ?= python
 export JAX_PLATFORMS ?= cpu
 
-.PHONY: lint lint-baseline test test-slow sanitize-demo service-smoke chaos-smoke obs-smoke bench-check shuffle-smoke warmup-smoke
+.PHONY: lint lint-baseline test test-slow sanitize-demo service-smoke chaos-smoke obs-smoke bench-check shuffle-smoke warmup-smoke multichip-smoke
 
 # engine-invariant static analysis; exits nonzero on findings beyond the
 # checked-in baseline (quokka_tpu/analysis/baseline.json)
@@ -68,6 +68,17 @@ shuffle-smoke:
 # executable persistence, runtime/compileplane.py)
 warmup-smoke:
 	$(PY) -m quokka_tpu.runtime.warmup_smoke
+
+# timed multichip smoke: tiny-SF TPC-H Q1/Q3/Q5 + tick-asof through the
+# mesh execution plane on 8 XLA-forced host devices, each timed against the
+# single-device engine.  Exits nonzero unless the scaling artifact is
+# written, every line records the kernel strategies that ran
+# (ops/strategy.py), the timed shuffle path stays at ZERO blocking host
+# syncs, and no query fell back from the mesh to the embedded engine.
+multichip-smoke:
+	QUOKKA_BENCH_SF=0.01 QUOKKA_BENCH_CACHE=/tmp/quokka_tpu_bench_mc \
+		QUOKKA_MULTICHIP_OUT=/tmp/MULTICHIP_timed_smoke.json \
+		$(PY) bench.py --multichip --smoke
 
 # chaos plane soak: >= 20 seeded mixed-fault runs (RPC drops/delays, flaky
 # store calls, worker kills, spill + checkpoint corruption) each asserting

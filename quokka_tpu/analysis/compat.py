@@ -56,3 +56,25 @@ trace_state_clean: Callable[[], bool] = _resolve(
         ("_src.core", "trace_state_clean"),
     ),
 )
+
+
+# Size of a named mesh axis from inside a shard_map/pmap trace.  jax >= 0.5
+# exposes public ``jax.lax.axis_size``; on older jax the only source is the
+# axis-env frame (``jax.core.axis_frame(name).size``).  Shapes derive from
+# this (bucket capacity = axis size), so a wrong/defaulted answer would
+# build mis-shaped collectives — resolve loudly, never default.
+if hasattr(jax.lax, "axis_size"):
+    axis_size: Callable = jax.lax.axis_size
+else:
+    _axis_frame: Callable = _resolve(
+        "axis_frame",
+        (
+            ("core", "axis_frame"),
+            ("_src.core", "axis_frame"),
+        ),
+    )
+
+    def axis_size(axis) -> int:
+        frame = _axis_frame(axis)
+        # 0.4.37 returns the size itself; other 0.4.x return a frame object
+        return frame if isinstance(frame, int) else frame.size

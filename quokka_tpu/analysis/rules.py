@@ -52,6 +52,14 @@ Rule ids:
                                 sigkey (bucket_rows/batch_sig/aval_sig/
                                 make_key) so warmup compiles stay counted
                                 and canonical
+  QK013 platform-gate           jax.default_backend()/config._platform()
+                                probes and platform-string comparisons
+                                outside ops/strategy.py + config.py — a
+                                scattered platform gate is a kernel choice
+                                the strategy matrix cannot see, calibrate,
+                                or record, which is exactly how the bench
+                                came to measure a path the target backend
+                                never runs (VERDICT r5 #2)
 
 Finding keys (``Finding.key``) are line-number-free — ``rule::relpath::
 scope::snippet[::n]`` — so a baseline survives unrelated edits above the
@@ -1176,6 +1184,78 @@ def check_raw_len_cache_key(tree: ast.Module, path: str, rel: str,
     return out
 
 
+# ---------------------------------------------------------------------------
+# QK013 — platform probes / platform-string gates outside the strategy matrix
+# ---------------------------------------------------------------------------
+
+# the two modules allowed to ask "what backend am I on": the strategy matrix
+# (which turns the answer into a calibrated, recorded kernel choice) and
+# config.py (its delegates + dtype policy)
+_PLATFORM_EXEMPT_SUFFIXES = ("ops/strategy.py", "/config.py")
+_PLATFORM_LITERALS = {"cpu", "gpu", "tpu", "cuda", "rocm"}
+_PLATFORM_PROBE_CALLS = ("default_backend", "_platform")
+
+
+def check_platform_gate(tree: ast.Module, path: str, rel: str,
+                        src_lines: Sequence[str]) -> List[Finding]:
+    """Flags, outside ops/strategy.py + config.py: (a) direct backend
+    probes (``jax.default_backend()``, ``config._platform()``), (b)
+    comparisons of a platform/backend-named expression against a platform
+    string literal.  Kernel choices keyed on the platform must route
+    through the strategy matrix; non-strategy uses (cache namespacing)
+    carry baseline rationales."""
+    r = rel.replace("\\", "/")
+    if r.endswith(_PLATFORM_EXEMPT_SUFFIXES) or r == "config.py":
+        return []
+    out: List[Finding] = []
+    flagged: Set[int] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _dotted(node.func)
+        if name is None:
+            continue
+        last = name.rsplit(".", 1)[-1]
+        if last in _PLATFORM_PROBE_CALLS:
+            flagged.add(id(node))
+            out.append(_mk(
+                "QK013", "platform-gate", path, rel, node,
+                _scope_of(tree, node),
+                f"backend probe '{name}(...)' outside the strategy matrix "
+                "— per-backend kernel decisions belong in "
+                "quokka_tpu.ops.strategy (choice()/calibrate(), recorded "
+                "via note_used) so the bench can verify what actually ran; "
+                "non-strategy uses baseline with a rationale",
+                src_lines))
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Compare) and len(node.comparators) == 1):
+            continue
+        sides = (node.left, node.comparators[0])
+        lit = next(
+            (s for s in sides
+             if isinstance(s, ast.Constant) and isinstance(s.value, str)
+             and s.value.lower() in _PLATFORM_LITERALS), None)
+        if lit is None:
+            continue
+        other = sides[0] if lit is sides[1] else sides[1]
+        if any(id(x) in flagged for x in ast.walk(other)):
+            continue  # the probe call inside is already its own finding
+        mention = _dotted(other)
+        if mention is None and isinstance(other, ast.Call):
+            mention = _dotted(other.func)
+        txt = (mention or "").lower()
+        if "platform" in txt or "backend" in txt:
+            out.append(_mk(
+                "QK013", "platform-gate", path, rel, node,
+                _scope_of(tree, node),
+                f"platform-string gate ('{mention}' vs "
+                f"{lit.value!r}) outside the strategy matrix — route the "
+                "decision through quokka_tpu.ops.strategy.choice() or "
+                "baseline with a rationale",
+                src_lines))
+    return out
+
+
 RULES = (
     check_module_level_jit,
     check_import_time_side_effects,
@@ -1189,6 +1269,7 @@ RULES = (
     check_adhoc_counter_dict,
     check_push_path_host_sync,
     check_raw_len_cache_key,
+    check_platform_gate,
 )
 
 

@@ -120,32 +120,29 @@ def __getattr__(name: str):
 
 
 def use_hash_tables() -> bool:
-    """Whether equality-keyed kernels (group-by, PK-join probe) use the
-    device hash table (ops/hashtable.py) instead of the sort-based paths.
-    Auto: on for CPU/GPU (scatter/gather fast, sorts slow), off for TPU
-    (random scatters serialize; multi-operand sort is the idiom there)."""
-    v = os.environ.get("QUOKKA_HASH_TABLES", "auto").lower()
-    if v in ("1", "true", "yes", "on"):
-        return True
-    if v in ("0", "false", "no", "off"):
-        return False
-    return _platform() != "tpu"
+    """Whether equality-keyed group-by kernels use the device hash table
+    (ops/hashtable.py) instead of the sort-based paths.  Since PR 8 this is
+    a thin delegate to the kernel-strategy matrix (ops/strategy.py): env
+    overrides (QUOKKA_HASH_TABLES, QK_KERNEL_STRATEGY) > persisted
+    per-backend calibration > the original platform gates (on for CPU/GPU
+    where scatter/gather is fast, off for TPU where random scatters
+    serialize and the multi-operand sort is the idiom)."""
+    from quokka_tpu.ops import strategy
+
+    return strategy.choice("groupby") == "hashtable"
 
 
 def use_host_asof() -> bool:
     """Whether the as-of match runs as a native sequential merge on host
-    (ops/asof._asof_match_host -> native/columnar.cpp).  On the CPU backend
-    device arrays ARE host memory (np.asarray is zero-copy), so the O(n+m)
-    walk replaces an XLA sort bottleneck for free.  Everywhere else —
-    TPU *and* GPU — the time/key/valid columns would each pay a blocking
-    device-to-host copy first, so the sort+scan device kernel stays; the
-    env override remains for GPU experiments."""
-    v = os.environ.get("QUOKKA_HOST_ASOF", "auto").lower()
-    if v in ("1", "true", "yes", "on"):
-        return True
-    if v in ("0", "false", "no", "off"):
-        return False
-    return _platform() == "cpu"
+    (ops/asof._asof_match_host -> native/columnar.cpp).  Thin delegate to
+    the strategy matrix (ops/strategy.py) — host stays the CPU-backend
+    default (np.asarray is zero-copy there); TPU *and* GPU resolve to a
+    device kernel since every host column would pay a blocking d2h copy.
+    QUOKKA_HOST_ASOF / QK_KERNEL_STRATEGY override; calibration can flip
+    the CPU pick to the device searchsorted kernel when measured faster."""
+    from quokka_tpu.ops import strategy
+
+    return strategy.choice("asof") == "host"
 
 
 # ---------------------------------------------------------------------------

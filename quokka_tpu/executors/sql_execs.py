@@ -436,6 +436,18 @@ class BuildProbeJoinExecutor(Executor):
         self.payload = payload
         self.build = b
         self.build_unique = join_ops.build_keys_unique(b, self.right_on)
+        # the strategy that will serve every probe batch of this build is
+        # decided here — stamp it into the flight timeline so critpath /
+        # bench_obs can attribute the probe pipeline to the kernel family
+        # that actually ran (ops/strategy.py matrix)
+        from quokka_tpu.obs import RECORDER
+        from quokka_tpu.ops import strategy as kstrategy
+
+        RECORDER.record(
+            "strategy", "join_build",
+            choice=kstrategy.choice("join_build") if self.build_unique
+            else "sort", unique=bool(self.build_unique),
+        )
 
     def execute(self, batches, stream_id, channel):
         live = [b for b in batches if b is not None]

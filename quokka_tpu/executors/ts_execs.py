@@ -187,6 +187,13 @@ class SortedAsofExecutor(Executor):
         self.q_done = False
         self.payload: Optional[List[str]] = None
         self.rename: Dict[str, str] = {}
+        # renamed view of the current quote buffer, cached by buffer
+        # identity: DeviceBatch.rename builds a NEW object, which would
+        # discard the searchsorted strategy's cached quote sort
+        # (ops/asof._ss_quote_sorted) on every flush even when no quotes
+        # arrived — derived state, deliberately not checkpointed
+        self._renamed_src: Optional[DeviceBatch] = None
+        self._renamed: Optional[DeviceBatch] = None
 
     def _materialize_trades(self) -> None:
         if self._t_parts:
@@ -239,6 +246,17 @@ class SortedAsofExecutor(Executor):
             self.rename = {c: c + self.suffix for c in payload if c in probe_names}
             self.payload = [self.rename.get(c, c) for c in payload]
 
+    def _renamed_quotes(self) -> DeviceBatch:
+        """The (possibly renamed) quote buffer to join against, one rename
+        per buffer object: repeated flushes of an unchanged buffer reuse
+        the same DeviceBatch, keeping its cached quote-side sort warm."""
+        if not self.rename:
+            return self.quotes
+        if self._renamed_src is not self.quotes:
+            self._renamed_src = self.quotes
+            self._renamed = self.quotes.rename(self.rename)
+        return self._renamed
+
     def _flush(self, final: bool = False):
         self._materialize_trades()
         if self.trades is None or self.trades.count_valid() == 0:
@@ -282,7 +300,7 @@ class SortedAsofExecutor(Executor):
         self.trades = rest if rest.count_valid() > 0 else None
         self._t_rows = 0 if self.trades is None else self.trades.count_valid()
         self._setup_payload(ready.names)
-        quotes = self.quotes.rename(self.rename) if self.rename else self.quotes
+        quotes = self._renamed_quotes()
         out = asof_ops.asof_join(
             ready, quotes, self.left_on, self.right_on,
             self.left_by, self.right_by, self.payload,
@@ -310,7 +328,7 @@ class SortedAsofExecutor(Executor):
         To keep the output time-ordered, matched trades are held back until no
         earlier trade remains unmatched."""
         self._setup_payload(self.trades.names)
-        quotes = self.quotes.rename(self.rename) if self.rename else self.quotes
+        quotes = self._renamed_quotes()
         out = asof_ops.asof_join(
             self.trades, quotes, self.left_on, self.right_on,
             self.left_by, self.right_by, self.payload, direction="forward",

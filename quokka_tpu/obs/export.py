@@ -94,6 +94,16 @@ def _family(name: str, kind: str) -> Tuple[str, Optional[str]]:
     exact = _EXACT_FAMILIES.get((kind, name))
     if exact is not None:
         return exact, None
+    # strategy.<op>.<choice> (ops/strategy.note_used): two label dimensions,
+    # so the kernel-strategy matrix reads as ONE family —
+    # quokka_kernel_strategy_used_total{op="asof",choice="searchsorted"}
+    if kind == "counter" and name.startswith("strategy."):
+        rest = name[len("strategy."):]
+        op, _, choice_ = rest.partition(".")
+        if op and choice_:
+            return ("quokka_kernel_strategy_used",
+                    f'op="{escape_label_value(op)}",'
+                    f'choice="{escape_label_value(choice_)}"')
     for want_kind, prefix, fam, key in _LABEL_FAMILIES:
         if (kind == want_kind and name.startswith(prefix)
                 and len(name) > len(prefix)):

@@ -1,14 +1,25 @@
-"""As-of join kernel.
+"""As-of join kernels.
 
 The reference's SortedAsofExecutor walks trade/quote frontiers sequentially
-per batch (pyquokka/executors/ts_executors.py:324-383).  The TPU formulation is
-data-parallel: concatenate both sides, sort once by (key, time, side), then a
-segmented fill-forward scan (jax.lax.associative_scan) carries the most recent
-quote position within each key segment onto every trade row.  One sort + one
-log-depth scan — no sequential loop.
+per batch (pyquokka/executors/ts_executors.py:324-383).  Three strategies
+(ops/strategy.py picks per backend; each records what actually ran):
 
-Direction 'backward' matches quotes with time <= trade time (quotes sort before
-trades on ties); 'forward' is the mirror (run on negated times).
+- ``sort``: concatenate both sides, sort once by (key, time, side), then a
+  segmented fill-forward scan (jax.lax.associative_scan) carries the most
+  recent quote position within each key segment onto every trade row.  One
+  sort + one log-depth scan — no sequential loop.
+- ``searchsorted``: sort ONLY the quotes by (key, time) — cached on the
+  quote batch, so repeated flushes against an unchanged buffer pay it once —
+  and resolve every trade with a vectorized lexicographic binary search
+  (upper bound for backward, lower bound for forward).  ~log2(q) gathers per
+  limb instead of an (n+m)-row multi-operand sort per flush, and no
+  concat-sized intermediates.  Fully device-resident: the accelerator
+  default.
+- ``host``: the native O(n+m) sequential merge (native/columnar.cpp),
+  profitable only where np.asarray of a device array is zero-copy (CPU).
+
+Direction 'backward' matches quotes with time <= trade time (quotes sort
+before trades on ties); 'forward' is the mirror.
 """
 
 from __future__ import annotations
@@ -82,6 +93,135 @@ def _asof_match(limbs: Tuple[jax.Array, ...], times: Tuple[jax.Array, ...],
     match_orig = jnp.zeros(n, dtype=jnp.int32).at[perm].set(quote_orig)
     matched = jnp.zeros(n, dtype=bool).at[perm].set(matched_s)
     return match_orig[:t], matched[:t]
+
+
+# ---------------------------------------------------------------------------
+# searchsorted strategy: cached quote-side (key, time) sort + vectorized
+# lexicographic binary search per trade row.
+# ---------------------------------------------------------------------------
+
+
+def _lex_lt_eq(a: Tuple[jax.Array, ...], b: Tuple[jax.Array, ...]):
+    """Elementwise lexicographic (a < b, a == b) over limb tuples (the same
+    comparator join._pk_probe_sorted uses)."""
+    lt = jnp.zeros(a[0].shape, dtype=bool)
+    eq = jnp.ones(a[0].shape, dtype=bool)
+    for x, y in zip(a, b):
+        lt = lt | (eq & (x < y))
+        eq = eq & (x == y)
+    return lt, eq
+
+
+@jax.jit
+def _ss_sort_quotes(ops: Tuple[jax.Array, ...], valid: jax.Array):
+    """Sort the quote side once by (validity, key limbs..., time limbs...);
+    returns (sorted_ops, perm, n_valid).  Invalid rows sort last; ties keep
+    original order (iota operand), so among equal (key, time) quotes sorted
+    position order == original order — the tie-break both directions rely
+    on."""
+    n = valid.shape[0]
+    iota = jnp.arange(n, dtype=jnp.int32)
+    inv = (~valid).astype(jnp.int32)
+    s = lax.sort([inv, *ops, iota], num_keys=1 + len(ops))
+    return tuple(s[1:-1]), s[-1], jnp.sum(valid.astype(jnp.int32))
+
+
+@functools.partial(jax.jit, static_argnames=("steps", "upper", "nkey"))
+def _ss_probe(sorted_ops: Tuple[jax.Array, ...], perm: jax.Array,
+              n_valid: jax.Array, probe_ops: Tuple[jax.Array, ...],
+              probe_valid: jax.Array, steps: int, upper: bool, nkey: int):
+    """Per-trade binary search over the sorted quotes.  ``upper`` (backward
+    asof): upper bound of (key, time) minus one — the LAST quote with key ==
+    k and time <= t (among exact (key, time) ties the last original index,
+    pandas semantics).  Lower bound (forward): the FIRST quote with key == k
+    and time >= t.  Returns (original quote row idx clipped, matched)."""
+    p = probe_ops[0].shape[0]
+    nq = sorted_ops[0].shape[0]
+    lo = jnp.zeros(p, dtype=jnp.int32)
+    hi = jnp.broadcast_to(n_valid.astype(jnp.int32), (p,))
+    for _ in range(steps):
+        mid = (lo + hi) >> 1
+        mk = tuple(l[mid] for l in sorted_ops)
+        lt, eq = _lex_lt_eq(mk, probe_ops)
+        cond = (lt | eq) if upper else lt  # quote[mid] <= probe vs < probe
+        go = lo < hi
+        lo = jnp.where(go & cond, mid + 1, lo)
+        hi = jnp.where(go & ~cond, mid, hi)
+    pos = lo - 1 if upper else lo
+    in_range = (pos >= 0) & (pos < n_valid)
+    cpos = jnp.clip(pos, 0, nq - 1)
+    keq = jnp.ones(p, dtype=bool)
+    for s_l, p_l in zip(sorted_ops[:nkey], probe_ops[:nkey]):
+        keq = keq & (s_l[cpos] == p_l)
+    matched = probe_valid & in_range & keq
+    return jnp.clip(perm[cpos], 0, nq - 1), matched
+
+
+def _ss_quote_sorted(quotes: DeviceBatch, right_on: str,
+                     right_by: Sequence[str], wide: bool, time_dtype):
+    """(sorted_ops, perm, n_valid, nkey) for a quote batch, cached ON the
+    batch object (the streaming executor probes the same buffer on every
+    flush until new quotes concat into a fresh object — same discipline as
+    join._build_sorted_cached).  Both directions share one cache entry: the
+    search side decides backward vs forward, not the sort.  ``time_dtype``
+    (the TRADE side's time dtype, None when wide) is applied to the quote
+    time limb BEFORE sorting — the same quote->trade cast the sort kernel
+    applies pre-sort, so mixed-dtype comparisons and within-tie ordering
+    stay bit-identical to that path (probe-side casts would truncate the
+    trade times instead)."""
+    from quokka_tpu.runtime import compileplane
+
+    cache = getattr(quotes, "_asof_ss_cache", None)
+    if cache is None:
+        cache = quotes._asof_ss_cache = {}
+    key = (tuple(right_by), right_on, wide, str(time_dtype))
+    hit = cache.get(key)
+    if hit is None:
+        ql = key_limbs(quotes, list(right_by)) if right_by else []
+        qc = quotes.columns[right_on]
+        if wide:
+            from quokka_tpu.ops import timewide
+
+            qt = tuple(timewide.widen_limbs(qc))
+        else:
+            qt = (qc.data.astype(time_dtype),)
+        ops = tuple(ql) + qt
+        sorted_ops, perm, n_valid = compileplane.aot_kernel_call(
+            "asof_ss_sort", _ss_sort_quotes, (ops, quotes.valid))
+        hit = cache[key] = (sorted_ops, perm, n_valid, len(ql))
+    return hit
+
+
+def _asof_match_searchsorted(trades: DeviceBatch, quotes: DeviceBatch,
+                             left_on: str, right_on: str,
+                             left_by: Sequence[str],
+                             right_by: Sequence[str], direction: str):
+    """(quote_idx, matched) aligned to trade rows, fully on device."""
+    from quokka_tpu.runtime import compileplane
+
+    tc = trades.columns[left_on]
+    qc = quotes.columns[right_on]
+    wide = tc.hi is not None or qc.hi is not None
+    sorted_ops, perm, n_valid, nkey = _ss_quote_sorted(
+        quotes, right_on, list(right_by), wide,
+        None if wide else tc.data.dtype)
+    lt = key_limbs(trades, list(left_by)) if left_by else []
+    assert len(lt) == nkey, "asof by-key column types must match"
+    if wide:
+        from quokka_tpu.ops import timewide
+
+        tt = tuple(timewide.widen_limbs(tc))
+    else:
+        tt = (tc.data,)
+    probe_ops = tuple(
+        l.astype(s.dtype) for l, s in zip(tuple(lt) + tt, sorted_ops)
+    )
+    steps = max(1, int(np.ceil(np.log2(max(2, quotes.padded_len)))) + 1)
+    return compileplane.aot_kernel_call(
+        "asof_ss_probe", _ss_probe,
+        (sorted_ops, perm, n_valid, probe_ops, trades.valid),
+        (steps, direction == "backward", nkey),
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -189,23 +329,42 @@ def asof_join(
     right_by: Sequence[str],
     payload: Sequence[str],
     direction: str = "backward",
+    strategy: "str | None" = None,
 ) -> DeviceBatch:
     """Probe-aligned asof join: each valid trade row gains the payload of its
     most recent quote (per key).  Unmatched trades keep NaN/zero payload and a
     false mask is NOT applied (matches polars join_asof semantics: unmatched
-    rows survive with null payload — floats become NaN)."""
+    rows survive with null payload — floats become NaN).
+
+    ``strategy`` forces a kernel ("host"/"sort"/"searchsorted"); None
+    consults the per-backend matrix (ops/strategy.py).  A host pick that the
+    native library / key shape declines falls back to the device
+    searchsorted kernel — never a wrong answer, and the fallback is what
+    gets recorded as having run."""
+    from quokka_tpu.ops import strategy as kstrategy
+
     t = trades.padded_len
     if direction not in ("backward", "forward"):
         raise ValueError(direction)
+    pick = strategy or kstrategy.choice("asof")
     host = None
-    if config.use_host_asof():
+    if pick == "host":
         host = _asof_match_host(
             trades, quotes, left_on, right_on, left_by, right_by, direction
         )
+        if host is None:
+            pick = "searchsorted"  # native lib/key shape declined
     if host is not None:
         quote_idx = jnp.asarray(host[0])
         matched = jnp.asarray(host[1])
+        kstrategy.note_used("asof", "host")
+    elif pick == "searchsorted":
+        quote_idx, matched = _asof_match_searchsorted(
+            trades, quotes, left_on, right_on, left_by, right_by, direction
+        )
+        kstrategy.note_used("asof", "searchsorted")
     else:
+        kstrategy.note_used("asof", "sort")
         lt = key_limbs(trades, list(left_by)) if left_by else []
         lq = key_limbs(quotes, list(right_by)) if right_by else []
         if left_by:

@@ -48,6 +48,7 @@ import numpy as np
 
 from quokka_tpu import config
 from quokka_tpu.ops import expr_compile, kernels, sigkey
+from quokka_tpu.ops import strategy as kstrategy
 from quokka_tpu.ops.batch import DeviceBatch, NumCol, StrCol, gather_columns
 from quokka_tpu.runtime import compileplane
 
@@ -196,7 +197,7 @@ class FusedPartialAgg:
         self.keys = keys
         self.plan = plan
 
-    def _small_dims(self, batch: DeviceBatch):
+    def _small_dims(self, batch: DeviceBatch, use_tables: bool):
         """Per-key bucket counts (dict size + a null slot) when the small-key
         path applies, else None.  Dims are CANONICALIZED to the next power
         of two: raw dictionary sizes vary per file/batch, and keying the
@@ -217,7 +218,7 @@ class FusedPartialAgg:
         itemsize = 8 if config.x64_enabled() else 4
         if n_buckets > _SMALL_GROUPBY_MAX_BUCKETS:
             return None
-        if not config.use_hash_tables():
+        if not use_tables:
             # matmul-strategy gates only: the scatter strategy materializes
             # no n x B one-hot and accumulates exactly
             if batch.padded_len * n_buckets * itemsize > _SMALL_GROUPBY_MAX_BYTES:
@@ -242,9 +243,16 @@ class FusedPartialAgg:
                 continue  # bound column
             assert isinstance(c, NumCol), n
             num_inputs[n] = c
-        dims = self._small_dims(batch)
+        # the group-by strategy is resolved ONCE per dispatch and baked
+        # into the program signature (ops/strategy.py); a warm program's
+        # choice is recorded as having run without re-tracing
+        gb_choice = kstrategy.choice("groupby")
+        use_tables = gb_choice == "hashtable"
+        dims = self._small_dims(batch, use_tables)
         if dims is not None:
-            return self._call_small(batch, pre, pre_exprs, num_inputs, dims)
+            kstrategy.note_used("groupby", gb_choice)
+            return self._call_small(batch, pre, pre_exprs, num_inputs, dims,
+                                    use_tables)
         key_limbs: List[jnp.ndarray] = []
         for k in self.keys:
             c = batch.columns[k]
@@ -266,8 +274,9 @@ class FusedPartialAgg:
             tuple((n, e.sql()) for n, e in pre_exprs),
             tuple((p, op, tmp) for p, op, tmp in self.plan.partials),
             bool(self.keys),
-            config.use_hash_tables(),  # strategy is baked into the program
+            use_tables,  # strategy is baked into the program
         )
+        kstrategy.note_used("groupby", gb_choice)
         builder = lambda: self._build(  # noqa: E731 — deferred to cache miss
             pre_exprs, list(num_inputs), sorted(pre.bound), len(key_limbs))
         return self._invoke(
@@ -332,7 +341,8 @@ class FusedPartialAgg:
 
         return fused
 
-    def _call_small(self, batch, pre, pre_exprs, num_inputs, dims):
+    def _call_small(self, batch, pre, pre_exprs, num_inputs, dims,
+                    use_tables: bool):
         codes = tuple(batch.columns[k].codes for k in self.keys)
         out_pad = config.bucket_size(int(np.prod(dims)))
         sig = sigkey.make_key(
@@ -342,14 +352,16 @@ class FusedPartialAgg:
             dims,
             tuple((n, e.sql()) for n, e in pre_exprs),
             tuple((p, op, tmp) for p, op, tmp in self.plan.partials),
-            config.use_hash_tables(),  # strategy is baked into the program
+            use_tables,  # strategy is baked into the program
         )
         builder = lambda: self._build_small(  # noqa: E731 — on cache miss
-            pre_exprs, list(num_inputs), sorted(pre.bound), dims, out_pad)
+            pre_exprs, list(num_inputs), sorted(pre.bound), dims, out_pad,
+            use_tables)
         return self._invoke(sig, builder, batch, pre, num_inputs, codes,
                             out_pad)
 
-    def _build_small(self, pre_exprs, num_names, bound_names, dims, out_pad):
+    def _build_small(self, pre_exprs, num_names, bound_names, dims, out_pad,
+                     use_tables: bool):
         plan = self.plan
         n_groups = int(np.prod(dims))
         strides = []
@@ -358,7 +370,7 @@ class FusedPartialAgg:
             strides.append(s)
             s *= d
         strides = tuple(reversed(strides))
-        if config.use_hash_tables():
+        if use_tables:
             # CPU/GPU: scatter segment-sums by bucket id — no n x B one-hot,
             # exact accumulation, and none of the matmul memory gates.  TPU
             # keeps the one-hot matmul (the MXU reduces all agg columns in

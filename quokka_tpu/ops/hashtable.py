@@ -294,12 +294,16 @@ def hash_groupby(limbs: Tuple[jax.Array, ...], arrays: Tuple[jax.Array, ...],
         outs, counts, rep, num, _ = _hash_groupby_body(
             tuple(limbs), tuple(arrays), ops, valid, capbits)
         return outs, counts, rep, num
+    from quokka_tpu.ops import strategy as kstrategy
+
     outs, counts, rep, num, converged = _hash_groupby_jit(
         tuple(limbs), tuple(arrays), ops, valid, capbits)
     if not bool(converged):
         from quokka_tpu.ops import kernels
 
+        kstrategy.note_used("groupby", "sort")  # the fallback is what ran
         return kernels.sorted_groupby(tuple(limbs), tuple(arrays), ops, valid)
+    kstrategy.note_used("groupby", "hashtable")
     return outs, counts, rep, num
 
 

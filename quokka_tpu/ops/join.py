@@ -152,9 +152,11 @@ def hash_join_pk(
     no host sync.  The cached build pays ONE scalar d2h per build batch (the
     hash-table convergence check, hashtable.build_table) — a diverged build
     is remembered on the batch and every probe takes the sort path."""
+    from quokka_tpu.ops import strategy as kstrategy
+
     probe_limbs = key_limbs(probe, probe_keys)
     probe_ok = _nonnull_valid(probe, probe_keys)
-    use_tables = config.use_hash_tables()
+    use_tables = kstrategy.choice("join_build") == "hashtable"
     if use_tables:
         # hashtable is imported at module scope by kernels (imported above):
         # a first-import inside an active trace once mis-primed jit dispatch
@@ -174,7 +176,9 @@ def hash_join_pk(
                 "join key column types must match"
             build_idx, matched = hashtable.pk_probe(
                 table, probe_limbs, probe_ok)
+            kstrategy.note_used("join_build", "hashtable")
     if not use_tables:
+        kstrategy.note_used("join_build", "sort")
         sorted_limbs, perm, n_valid = _build_sorted_cached(build, build_keys)
         assert len(probe_limbs) == len(sorted_limbs), \
             "join key column types must match"
@@ -351,8 +355,10 @@ def build_keys_unique(build: DeviceBatch, build_keys: Sequence[str]) -> bool:
     the first probe batch arrives.  Null-key rows match the dense-rank
     semantics this replaces: all nulls collapse into one key, so uniqueness
     additionally requires at most one null/NaN-key row."""
+    from quokka_tpu.ops import strategy as kstrategy
+
     nvalid = build.count_valid()
-    if config.use_hash_tables():
+    if kstrategy.choice("join_build") == "hashtable":
         from quokka_tpu.ops import hashtable
 
         try:

@@ -296,10 +296,14 @@ def _min_sentinel(dtype):
 def groupby_limbs(limbs: Tuple[jax.Array, ...], arrays: Tuple[jax.Array, ...],
                   ops: Tuple[str, ...], valid: jax.Array):
     """Group rows by key limbs: the single strategy-dispatch point for every
-    group-by consumer (here, FusedPartialAgg).  Hash table on CPU/GPU,
-    multi-operand sort on TPU — see config.use_hash_tables()."""
-    if config.use_hash_tables():
+    group-by consumer (here, FusedPartialAgg).  The per-backend matrix
+    (ops/strategy.py) picks hash table vs multi-operand sort; hash_groupby
+    itself records a sort fallback when the insert diverges."""
+    from quokka_tpu.ops import strategy as kstrategy
+
+    if kstrategy.choice("groupby") == "hashtable":
         return hashtable.hash_groupby(tuple(limbs), arrays, ops, valid)
+    kstrategy.note_used("groupby", "sort")
     return sorted_groupby(tuple(limbs), arrays, ops, valid)
 
 
@@ -480,8 +484,16 @@ def split_by_partition(batch: DeviceBatch, part_ids: jax.Array, n_parts: int,
     if n_parts == 1:
         return [batch]
     if compact is None:
-        compact = (batch.padded_len > (1 << 16)
-                   and n_parts * batch.padded_len > config.SHUFFLE_MASKED_CAP)
+        from quokka_tpu.ops import strategy as kstrategy
+
+        if kstrategy.choice("shuffle") == "compacted":
+            # calibrated-compacted backends still skip the plan kernel on
+            # small batches, where its counts readback dominates
+            compact = batch.padded_len > (1 << 16)
+        else:
+            compact = (batch.padded_len > (1 << 16)
+                       and n_parts * batch.padded_len > config.SHUFFLE_MASKED_CAP)
+        kstrategy.note_used("shuffle", "compacted" if compact else "masked")
     if not compact:
         masks, counts = _aot("split_masks", _split_masks,
                              (part_ids, batch.valid), (n_parts,))

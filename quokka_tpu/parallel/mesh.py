@@ -27,12 +27,30 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from quokka_tpu import config
+from quokka_tpu.analysis import compat
 
 
 def make_mesh(n_devices: Optional[int] = None, axis: str = "dp") -> Mesh:
     devs = jax.devices()
     n = n_devices or len(devs)
     return Mesh(np.array(devs[:n]), (axis,))
+
+
+def shard_map(f, *, mesh: Mesh, in_specs, out_specs, check_vma: bool = False):
+    """Version-portable shard_map.  jax >= 0.5 exposes ``jax.shard_map``
+    (replication-check knob named ``check_vma``); older jax ships it as
+    ``jax.experimental.shard_map.shard_map`` with the same knob named
+    ``check_rep``.  Every mesh program goes through this shim so the mesh
+    layer works on both — a bare ``jax.shard_map`` call raises
+    AttributeError on 0.4.x and silently disables the whole multichip
+    plane."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental import shard_map as _sm  # jax < 0.5
+
+    return _sm.shard_map(f, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_rep=check_vma)
 
 
 # ---------------------------------------------------------------------------
@@ -90,7 +108,7 @@ def collective_hash_shuffle(
     """Inside shard_map: redistribute rows so equal-key rows land on the same
     device.  Input: per-device local columns [N]; output: [P*N] padded local
     columns after an all_to_all over the mesh axis."""
-    n_parts = lax.axis_size(axis)
+    n_parts = compat.axis_size(axis)
     frames, frame_valid = _local_bucketize(cols, valid, key_idx, n_parts)
     out_cols = []
     for f in frames:
@@ -140,7 +158,7 @@ def distributed_groupby_step(
         )
         return fkeys + fvals + (fvalid,)
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         step,
         mesh=mesh,
         in_specs=P(axis),
@@ -171,7 +189,7 @@ def distributed_join_groupby_step(mesh: Mesh, axis: str = "dp"):
         rows = lax.psum(jnp.sum(matched.astype(jnp.int32)), axis)
         return total, rows
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         step,
         mesh=mesh,
         in_specs=P(axis),

@@ -40,7 +40,7 @@ from quokka_tpu.ops.batch import (
     DeviceBatch, NumCol, StrCol, VecCol, _int_sentinel, key_limbs, with_nulls,
 )
 from quokka_tpu.ops.expr_compile import evaluate_predicate, evaluate_to_column
-from quokka_tpu.parallel.mesh import collective_hash_shuffle
+from quokka_tpu.parallel.mesh import collective_hash_shuffle, shard_map
 
 
 class MeshUnsupported(Exception):
@@ -138,6 +138,9 @@ def mesh_groupby(
     """partials: (out_name, op, input_column|None).  Returns a sharded batch
     of unique groups carrying key columns + partial outputs (already
     recombined across shards)."""
+    from quokka_tpu.ops import strategy as kstrategy
+
+    kstrategy.note_used("groupby", "sort")  # mesh programs embed the sort kernel
     limbs = key_limbs(batch, keys)  # hash limbs: consistent across dictionaries
     nlimb = len(limbs)
     carried, slices = _flatten_cols(batch, keys)
@@ -171,7 +174,7 @@ def mesh_groupby(
         return fcarry + tuple(fouts) + (fvalid,)
 
     fn = jax.jit(
-        jax.shard_map(step, mesh=mesh, in_specs=P(axis), out_specs=P(axis),
+        shard_map(step, mesh=mesh, in_specs=P(axis), out_specs=P(axis),
                       check_vma=False)
     )
     outs = fn(*limbs, *carried, *vals, batch.valid)
@@ -213,6 +216,9 @@ def mesh_join(
     many-to-many kernel with a STATIC per-device output capacity — overflow
     is psum-counted and raises MeshUnsupported so the caller falls back to
     the embedded engine (shapes inside shard_map cannot be data-dependent)."""
+    from quokka_tpu.ops import strategy as kstrategy
+
+    kstrategy.note_used("join_build", "sort")  # mesh joins are rank-based
     pl = key_limbs(probe, left_on)
     bl = key_limbs(build, right_on)
     if len(pl) != len(bl):
@@ -267,7 +273,7 @@ def mesh_join(
         return out_pc + payload_g + (out_valid, matched, overflow)
 
     fn = jax.jit(
-        jax.shard_map(step, mesh=mesh, in_specs=P(axis), out_specs=P(axis),
+        shard_map(step, mesh=mesh, in_specs=P(axis), out_specs=P(axis),
                       check_vma=False)
     )
     outs = fn(
@@ -349,7 +355,9 @@ def mesh_asof(
     (pyquokka/executors/ts_executors.py:324-383); here the per-shard match is
     one sort + one log-depth associative scan — no sequential walk."""
     from quokka_tpu.ops.asof import _asof_match
+    from quokka_tpu.ops import strategy as kstrategy
 
+    kstrategy.note_used("asof", "sort")  # per-shard sort+scan kernel
     if not left_by:
         raise MeshUnsupported("by-less asof join on mesh (no shuffle key)")
     tl = key_limbs(trades, left_by)
@@ -419,7 +427,7 @@ def mesh_asof(
         return tuple(c[perm] for c in out_cols) + (sorted_[0] == 0,)
 
     fn = jax.jit(
-        jax.shard_map(step, mesh=mesh, in_specs=P(axis), out_specs=P(axis),
+        shard_map(step, mesh=mesh, in_specs=P(axis), out_specs=P(axis),
                       check_vma=False)
     )
     outs = fn(*tl, *t_times, *t_carry, trades.valid,
@@ -515,7 +523,7 @@ def mesh_window_agg(
         return fcarry + (fwid,) + tuple(fouts) + (fvalid,)
 
     fn = jax.jit(
-        jax.shard_map(step, mesh=mesh, in_specs=P(axis), out_specs=P(axis),
+        shard_map(step, mesh=mesh, in_specs=P(axis), out_specs=P(axis),
                       check_vma=False)
     )
     outs = fn(*limbs, time_data, *carried, *vals, batch.valid)
@@ -652,7 +660,7 @@ def mesh_session_window(
         return gcarry + tuple(outs) + (gvalid,)
 
     fn = jax.jit(
-        jax.shard_map(step, mesh=mesh, in_specs=P(axis), out_specs=P(axis),
+        shard_map(step, mesh=mesh, in_specs=P(axis), out_specs=P(axis),
                       check_vma=False)
     )
     outs = fn(*limbs, time_data, *carried, *vals, batch.valid)
@@ -767,7 +775,7 @@ def mesh_sliding_window(
         return out_ca + tuple(outs) + (valid_s,)
 
     fn = jax.jit(
-        jax.shard_map(step, mesh=mesh, in_specs=P(axis), out_specs=P(axis),
+        shard_map(step, mesh=mesh, in_specs=P(axis), out_specs=P(axis),
                       check_vma=False)
     )
     outs = fn(*limbs, time_data, *carried, batch.valid)
@@ -872,7 +880,7 @@ def mesh_shift(
         return out_ca + tuple(shifted) + (valid_s,)
 
     fn = jax.jit(
-        jax.shard_map(step, mesh=mesh, in_specs=P(axis), out_specs=P(axis),
+        shard_map(step, mesh=mesh, in_specs=P(axis), out_specs=P(axis),
                       check_vma=False)
     )
     outs = fn(*limbs, *tlimbs, *carried, batch.valid)

@@ -11,6 +11,11 @@ os.environ.setdefault(
     "QUOKKA_JAX_CACHE_DIR", os.path.expanduser("~/.cache/quokka_tpu_test_jax")
 )
 os.environ.setdefault("QUOKKA_JAX_CACHE_MIN_SECS", "0")
+# Kernel-strategy calibration must never leak into tests: a developer box
+# whose bench calibrated (ops/strategy.py) would otherwise flip which
+# kernels tests exercise.  "" disables profile load/persist; tests that
+# exercise calibration point QK_STRATEGY_DIR at a tmp dir and reset().
+os.environ.setdefault("QK_STRATEGY_DIR", "")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,16 @@
+"""QK013 fixture: platform probes / platform-string gates outside the
+strategy matrix.
+
+Three findings: a direct jax.default_backend() probe, a .platform attribute
+compared against a platform literal, and a config._platform() probe.
+Per-backend kernel decisions must route through quokka_tpu.ops.strategy.
+"""
+
+
+def pick_kernel(jax, config, device):
+    if jax.default_backend() == "tpu":  # finding 1: direct backend probe
+        return "sort"
+    if device.platform == "cpu":  # finding 2: platform-string gate
+        return "hashtable"
+    config._platform()  # finding 3: probe via the config helper
+    return "sort"

@@ -177,6 +177,61 @@ class TestCheckMain:
         assert rc == 2
 
 
+class TestStrategyHonesty:
+    """ISSUE 8 satellite: --check fails when a benched line records a
+    kernel strategy its platform gates off (the VERDICT r5 finding —
+    host-asof timings presented as the accelerator path)."""
+
+    def test_gated_off_strategy_fails(self, bench, tmp_path, capsys):
+        lines = _baseline_lines()
+        lines.append(_line(
+            "tick_asof_rows_per_s_per_chip", 0.48,
+            {"platform": "tpu", "strategy": {"asof": "host"}}))
+        p = _write_lines(tmp_path / "a.json", lines)
+        rc = bench.check_main(["--against", p, "--current", p])
+        assert rc == 1
+        out = capsys.readouterr().out
+        assert "GATED-OFF" in out and "asof=host" in out
+
+    def test_runnable_strategy_passes(self, bench, tmp_path, capsys):
+        lines = _baseline_lines()
+        lines.append(_line(
+            "tick_asof_rows_per_s_per_chip", 0.48,
+            {"platform": "cpu", "strategy": {"asof": "host"}}))
+        lines.append(_line(
+            "tpch_q5_speedup_vs_ref_per_chip", 0.5,
+            {"platform": "tpu", "strategy": {
+                "join_build": "sort", "groupby": "sort",
+                "shuffle": "masked"}}))
+        p = _write_lines(tmp_path / "b.json", lines)
+        rc = bench.check_main(["--against", p, "--current", p])
+        assert rc == 0, capsys.readouterr().out
+
+    def test_nested_geomean_strategies_validated(self, bench, tmp_path,
+                                                 capsys):
+        lines = _baseline_lines()
+        lines.append(_line(
+            "tpch_q135_speedup_geomean_per_chip2", 0.6,
+            {"platform": "gpu", "queries": {
+                "q3": {"strategy": {"asof": "host"}}}}))
+        p = _write_lines(tmp_path / "c.json", lines)
+        rc = bench.check_main(["--against", p, "--current", p])
+        assert rc == 1
+        assert "GATED-OFF" in capsys.readouterr().out
+
+    def test_fresh_run_requires_strategy(self, bench):
+        """In fresh-run mode the join/asof lines MUST carry strategies;
+        exercised via check_strategy_honesty directly (a real fresh run is
+        the full bench)."""
+        cur = {m: _line(m, 0.5, {"platform": "cpu"})
+               for m in bench.STRATEGY_REQUIRED_METRICS}
+        rows, bad = bench.check_strategy_honesty(cur, require=True)
+        assert len(bad) == len(bench.STRATEGY_REQUIRED_METRICS)
+        assert all("MISSING" == status for _, status, _ in rows)
+        rows, bad = bench.check_strategy_honesty(cur, require=False)
+        assert not bad
+
+
 def test_cli_subprocess_roundtrip(tmp_path):
     """The real `python bench.py --check` entry point, end to end."""
     import subprocess

@@ -26,6 +26,7 @@ CASES = [
     ("QK010", "qk010_counter_dict.py", 3),   # 2x dict +=, 1x .get()+1 RMW
     ("QK011", "qk011_push_sync.py", 3),      # np.asarray, .item(), device_get
     ("QK012", "qk012_raw_len_key.py", 3),    # sig tuple, .get key, store key
+    ("QK013", "qk013_platform_gate.py", 3),  # probe, string gate, _platform
 ]
 
 

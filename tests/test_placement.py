@@ -119,8 +119,17 @@ class TestPlacedQuery:
         )
         assert got.n.iloc[0] == 5000
 
-    def test_single_channel_stateful_transform_two_workers(self):
+    def test_single_channel_stateful_transform_two_workers(self, monkeypatch):
         t = self._data()
+
+        # Regression guard for the round-5 600s hang (Worker crashed on its
+        # first dispatch because PR5's _lat_hist was never initialized —
+        # Worker bypasses Engine.__init__; fixed by the shared
+        # _init_latency_hists).  A healthy run finishes in seconds; if the
+        # coordinator ever wedges again, the QK_COORD_TIMEOUT stall
+        # detector shoots it in ~60s WITH a merged-timeline stall dump
+        # naming the stuck worker, instead of 600s of silence.
+        monkeypatch.setenv("QK_COORD_TIMEOUT", "60")
 
         def run(ctx):
             return (
