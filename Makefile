@@ -4,7 +4,7 @@
 PY ?= python
 export JAX_PLATFORMS ?= cpu
 
-.PHONY: lint lint-baseline test test-slow sanitize-demo service-smoke chaos-smoke obs-smoke bench-check shuffle-smoke warmup-smoke multichip-smoke
+.PHONY: lint lint-baseline test test-slow sanitize-demo service-smoke chaos-smoke obs-smoke bench-check shuffle-smoke warmup-smoke multichip-smoke stream-smoke
 
 # engine-invariant static analysis; exits nonzero on findings beyond the
 # checked-in baseline (quokka_tpu/analysis/baseline.json)
@@ -79,6 +79,15 @@ multichip-smoke:
 	QUOKKA_BENCH_SF=0.01 QUOKKA_BENCH_CACHE=/tmp/quokka_tpu_bench_mc \
 		QUOKKA_MULTICHIP_OUT=/tmp/MULTICHIP_timed_smoke.json \
 		$(PY) bench.py --multichip --smoke
+
+# streaming-plane smoke: a continuous asof join + a continuous windowed
+# aggregate over tailed CSV sources, under a seeded QK_CHAOS kill plan AND
+# a SIGKILL of the hosting service mid-stream; the parent resumes both
+# streams from their incremental-checkpoint manifests and the merged pane
+# deltas must be BIT-EXACT vs the one-shot batch runs, with the resume
+# replaying only the post-frontier segment tail (never the whole stream)
+stream-smoke:
+	$(PY) -m quokka_tpu.streaming.smoke
 
 # chaos plane soak: >= 20 seeded mixed-fault runs (RPC drops/delays, flaky
 # store calls, worker kills, spill + checkpoint corruption) each asserting

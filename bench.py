@@ -422,12 +422,22 @@ def _span_breakdown(span_stats):
     return {k: round(v, 4) for k, v in buckets.items()}
 
 
+def bench_out_dir() -> str:
+    """Per-run bench artifacts (bench_obs.json, timed multichip JSON) land
+    under ONE gitignored output dir instead of littering the repo root —
+    override the dir with QUOKKA_BENCH_OUT."""
+    d = os.environ.get("QUOKKA_BENCH_OUT", "bench_out")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
 def _write_obs_summary(obs_per_query):
     """Per-query span/counter breakdown JSON next to the timing output
     (BENCH_*.json gains compile-vs-compute-vs-transfer visibility)."""
     from quokka_tpu import obs
 
-    path = os.environ.get("QUOKKA_BENCH_OBS", "bench_obs.json")
+    path = os.environ.get("QUOKKA_BENCH_OBS") or os.path.join(
+        bench_out_dir(), "bench_obs.json")
     try:
         with open(path, "w", encoding="utf-8") as f:
             json.dump({"per_query": obs_per_query,
@@ -1281,8 +1291,9 @@ def multichip_main(argv):
     ap.add_argument("--smoke", action="store_true",
                     help="single timed rep + assertions (CI)")
     ap.add_argument("--out",
-                    default=os.environ.get("QUOKKA_MULTICHIP_OUT",
-                                           "MULTICHIP_timed.json"))
+                    default=os.environ.get("QUOKKA_MULTICHIP_OUT")
+                    or os.path.join(bench_out_dir(),
+                                    "MULTICHIP_timed.json"))
     args = ap.parse_args(argv)
     ensure_data()
     env = dict(os.environ)

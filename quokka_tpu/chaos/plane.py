@@ -330,6 +330,29 @@ class ChaosPlane:
         self._record("kill", f"embedded plan={plan}")
         return plan
 
+    def plan_stream_kills(self, exec_channels: Sequence[Tuple[int, int]]
+                          ) -> List[Tuple[int, List[Tuple[int, int]]]]:
+        """Standing queries: ``[(after_tasks, [(actor, ch), ...]), ...]`` —
+        a seeded, RE-ARMING kill plan over a stream's checkpointable
+        operator channels.  ``kill`` kills land at cumulative handled-task
+        thresholds spread from ``kill_after`` onward, each recovered through
+        the tape-replay protocol while the stream keeps flowing."""
+        cfg = self.config
+        if cfg is None or cfg.kill <= 0 or not exec_channels:
+            return []
+        rng = self._rng("stream_kill")
+        plan = []
+        after = cfg.kill_after
+        for _ in range(cfg.kill):
+            after += rng.randrange(0, 15)
+            k = min(len(exec_channels), 1 + int(rng.random() < 0.25))
+            plan.append((after, sorted(rng.sample(list(exec_channels), k))))
+            # standing queries keep running: later kills need the stream to
+            # have made real progress since the recovery
+            after += 12
+        self._record("kill", f"stream plan={plan}")
+        return plan
+
     def record_kill(self, label: str) -> None:
         self._record("kill", label)
 

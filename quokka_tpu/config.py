@@ -207,6 +207,9 @@ SHUFFLE_MASKED_CAP = int(os.environ.get("QUOKKA_SHUFFLE_MASKED_CAP", 1 << 25))
 # (1 keeps spill-file write order identical to submission order, which the
 # seeded chaos corruption streams key off); QK_SPILL_INFLIGHT bounds the
 # device batches pinned by pending spills.
+# streaming plane: minimum seconds between source polls of an idle standing
+# query (bounds filesystem stats when no data is arriving)
+STREAM_POLL_S = float(os.environ.get("QK_STREAM_POLL_S", "0.05"))
 SPILL_ASYNC = os.environ.get("QK_SPILL_ASYNC", "1") not in ("0", "false", "no")
 SPILL_POOL = int(os.environ.get("QK_SPILL_POOL", "1"))
 SPILL_INFLIGHT = int(os.environ.get("QK_SPILL_INFLIGHT", "4"))

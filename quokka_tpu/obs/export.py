@@ -56,6 +56,14 @@ _LABEL_FAMILIES: Tuple[Tuple[str, str, str, str], ...] = (
     ("counter", "compile.miss.", "quokka_compile_miss", "query"),
     ("counter", "compile.prewarm_hit.", "quokka_compile_prewarm_hit",
      "query"),
+    # streaming plane (quokka_tpu/streaming/): standing-query pane/late
+    # counters + watermark-staleness gauge, per-query twins GC'd with the
+    # namespace exactly like the shuffle/compile families
+    ("counter", "stream.panes.", "quokka_stream_panes", "query"),
+    ("counter", "stream.late_dropped.", "quokka_stream_late_dropped",
+     "query"),
+    ("gauge", "stream.watermark_lag_s.", "quokka_stream_watermark_lag_seconds",
+     "query"),
 )
 
 # Aggregate instruments that ALSO exist as a labeled per-query family: the
@@ -70,6 +78,10 @@ _EXACT_FAMILIES: Dict[Tuple[str, str], str] = {
     ("counter", "compile.cache_hit"): "quokka_compile_cache_hit_all",
     ("counter", "compile.miss"): "quokka_compile_miss_all",
     ("counter", "compile.prewarm_hit"): "quokka_compile_prewarm_hit_all",
+    ("counter", "stream.panes"): "quokka_stream_panes_all",
+    ("counter", "stream.late_dropped"): "quokka_stream_late_dropped_all",
+    ("gauge", "stream.watermark_lag_s"):
+        "quokka_stream_watermark_lag_all_seconds",
 }
 
 

@@ -45,6 +45,21 @@ class ResultDataset:
         t = self.to_arrow()
         return None if t is None else t.to_pandas()
 
+    def items_since(self, cursor: Dict[int, int]) -> List:
+        """Delta view for standing queries (StreamingHandle.poll_deltas):
+        ``(channel, seq, table)`` entries with seq > cursor.get(channel, -1),
+        in (channel, seq) order.  Replay re-emissions overwrite their seq
+        with byte-identical tables, so a cursor-based reader sees each seq
+        exactly once."""
+        out: List = []
+        with self._lock:
+            for ch in sorted(self._tables):
+                floor = cursor.get(ch, -1)
+                for s in sorted(self._tables[ch]):
+                    if s > floor:
+                        out.append((ch, s, self._tables[ch][s]))
+        return out
+
 
 def _decode_dicts(t: pa.Table) -> pa.Table:
     cols = []

@@ -153,16 +153,22 @@ class TaskGraph:
                 self.ckpt_dir = tempfile.mkdtemp(prefix="ckpt-", dir=base)
                 self._private_spill = True
 
-    def cleanup(self) -> None:
+    def cleanup(self, preserve_durable: bool = False) -> None:
+        """``preserve_durable``: keep the on-disk recovery trio (HBQ spill,
+        checkpoint snapshots, stream resume manifest) while still GC'ing
+        every in-memory namespace.  Set by the service for a standing query
+        torn down by failure/shutdown, whose stream a restarted replica will
+        resume from the manifest."""
         import shutil
 
-        if self.hbq is not None:
+        if self.hbq is not None and not preserve_durable:
             self.hbq.wipe()  # namespaced: only this query's files go
             if self._private_spill:
                 shutil.rmtree(self.hbq.path, ignore_errors=True)
-        if self.ckpt_dir is not None and self._private_spill:
+        if self.ckpt_dir is not None and self._private_spill \
+                and not preserve_durable:
             shutil.rmtree(self.ckpt_dir, ignore_errors=True)
-        if self.query_id is not None:
+        if self.query_id is not None and not preserve_durable:
             # GC this query's checkpoints from wherever they actually went:
             # exec_config["checkpoint_store"] (an external/shared root that
             # outlives the graph) wins over the spill-dir default — a
@@ -176,6 +182,12 @@ class TaskGraph:
 
                 CheckpointStore(ckpt_root,
                                 namespace=self.query_id).wipe_namespace()
+            manifest = getattr(self, "stream_manifest", None)
+            if manifest:  # a cleanly stopped stream is complete: no resume
+                import contextlib
+
+                with contextlib.suppress(OSError):
+                    os.remove(manifest)
         if self.query_id is not None:
             # the one-shot path and the service both land here: a finished
             # query's tables, queues, metrics and cache accounting all GC
@@ -192,7 +204,10 @@ class TaskGraph:
                                 f"shuffle.host_syncs.{self.query_id}",
                                 f"compile.cache_hit.{self.query_id}",
                                 f"compile.miss.{self.query_id}",
-                                f"compile.prewarm_hit.{self.query_id}")
+                                f"compile.prewarm_hit.{self.query_id}",
+                                f"stream.panes.{self.query_id}",
+                                f"stream.late_dropped.{self.query_id}",
+                                f"stream.watermark_lag_s.{self.query_id}")
         # persist this query's program set under its plan fingerprint so the
         # NEXT submit of the same plan shape pre-warms from disk
         fp = getattr(self, "plan_fp", None)
@@ -462,7 +477,17 @@ class Engine:
         for info in graph.actors.values():
             if info.kind == "exec":
                 for ch in range(info.channels):
-                    self.execs[(info.id, ch)] = info.executor_factory()
+                    self.execs[(info.id, ch)] = self._bind_executor(
+                        info.executor_factory())
+
+    def _bind_executor(self, executor):
+        """Streaming executors resolve their pane/late counters (global +
+        per-query twins) against the live registry here — after the
+        per-channel factory copy, so instruments are never deep-copied and
+        never ride a checkpoint."""
+        if hasattr(executor, "bind_query"):
+            executor.bind_query(getattr(self.g, "query_id", None))
+        return executor
 
     # -- partition function lowering (quokka_runtime.py:215-312) ------------
     def _partition_fn(self, src_actor: int, tgt_actor: int) -> Callable:
@@ -546,6 +571,12 @@ class Engine:
         info = self.g.actors[actor]
         from quokka_tpu.runtime.cache import _batch_nbytes
 
+        # streaming plane: persist the batch's watermark under its seq (SWM)
+        # so recovery replay re-presents the same watermark trail, and stamp
+        # every partition (splits build new DeviceBatch objects)
+        stream_wm = getattr(batch, "_stream_wm", None)
+        if stream_wm is not None:
+            self.store.tset("SWM", (actor, channel, seq), stream_wm)
         # the sync scope carries this engine's once-resolved per-query
         # counter, so a split blocking inside the partition fn attributes to
         # THIS query even when neighbors dispatch concurrently
@@ -553,6 +584,10 @@ class Engine:
             for tgt_actor in info.targets:
                 fn = self._partition_fn(actor, tgt_actor)
                 parts = fn(batch, channel)
+                if stream_wm is not None:
+                    for part in parts.values():
+                        part._stream_wm = stream_wm
+                        part._stream_ch = channel
                 if len(parts) > 1:
                     # shuffle volume: bytes entering a real exchange
                     # (fan-out > 1), counted once per edge from the parent
@@ -733,6 +768,11 @@ class Engine:
         info = self.g.actors[task.actor]
         seq = task.current_seq()
         if seq is None:
+            # unbounded sources never exhaust their tape: poll for appended
+            # segments until a stop flag turns the channel finite
+            streamed = self._stream_advance(info, task)
+            if streamed is not None:
+                return streamed
             self.store.sadd("DST", (task.actor, task.channel), "done")
             return True
         if self._throttled(info, task.channel, seq):
@@ -742,6 +782,9 @@ class Engine:
         if info.predicate is not None:
             with tracing.span("source.predicate"):
                 batch = info.predicate(batch)
+        if getattr(info.reader, "UNBOUNDED", False):
+            batch = self._stamp_input_wm(info, task.actor, task.channel,
+                                         seq, batch)
         with tracing.span("push.input"):
             self.push(task.actor, task.channel, seq, batch)
         from quokka_tpu.runtime.cache import _batch_nbytes
@@ -754,6 +797,11 @@ class Engine:
             self.store.sadd("GIT", (task.actor, task.channel), seq)
         nxt = task.advance()
         if nxt.tape:
+            self.store.ntt_push(task.actor, nxt)
+        elif (getattr(info.reader, "UNBOUNDED", False)
+              and not self.store.tget("SST", task.actor)):
+            # exhausted tape on an un-stopped standing source: requeue so
+            # the next dispatch polls for appended segments
             self.store.ntt_push(task.actor, nxt)
         else:
             self.store.sadd("DST", (task.actor, task.channel), "done")
@@ -774,6 +822,126 @@ class Engine:
                 w = self.store.tget("EWT", (info.id, src_ch, tgt_actor, tgt_ch), -1)
                 watermark = w if watermark is None else min(watermark, w)
         return watermark is not None and seq > watermark + max_pipeline
+
+    # -- streaming plane (quokka_tpu/streaming/) ------------------------------
+    # An input actor whose reader declares UNBOUNDED never finishes on its
+    # own: when its tape runs dry the engine polls the reader for appended
+    # segments (recording each discovery in the control store, so recovery
+    # and the resume manifest see the same frozen lineage) until a stop flag
+    # (SST, set by StreamingHandle.stop) turns the channel finite and the
+    # normal end-of-input finalization drains every open pane.
+
+    def _stream_advance(self, info: ActorInfo, task: TapedInputTask):
+        """Returns None (not streaming / stopped -> finite end-of-input),
+        True (new segments discovered and queued: progress), or False
+        (nothing new: requeued, idle)."""
+        reader = info.reader
+        if info.kind != "input" or not getattr(reader, "UNBOUNDED", False):
+            return None
+        a, ch = task.actor, task.channel
+        if self.store.tget("SST", a):
+            return None
+        polls = getattr(self, "_stream_poll_at", None)
+        if polls is None:
+            with _LAZY_INIT_LOCK:
+                polls = getattr(self, "_stream_poll_at", None)
+                if polls is None:
+                    polls = self._stream_poll_at = {}
+        now = time.time()
+        if now - polls.get((a, ch), 0.0) < config.STREAM_POLL_S:
+            self.store.ntt_push(a, task)
+            return False
+        polls[(a, ch)] = now
+        new = reader.poll(ch)  # StreamTruncatedError propagates LOUDLY
+        if not new:
+            self._stream_lag_update(a, ch, advanced=False)
+            self.store.ntt_push(a, task)
+            return False
+        last = self.store.tget("LIT", (a, ch), -1)
+        with self.store.transaction():
+            for i, lineage in enumerate(new):
+                self.store.tset("LT", (a, ch, last + 1 + i), lineage)
+            self.store.tset("LIT", (a, ch), last + len(new))
+        self.store.ntt_push(
+            a, TapedInputTask(a, ch,
+                              list(range(last + 1, last + 1 + len(new)))))
+        obs.RECORDER.record("stream.segments", f"a{a}c{ch}", a=a, c=ch,
+                            n=len(new), **(
+                                {"q": self.g.query_id}
+                                if getattr(self.g, "query_id", None) else {}))
+        return True
+
+    def _stamp_input_wm(self, info: ActorInfo, a: int, ch: int, seq: int,
+                        batch: DeviceBatch) -> DeviceBatch:
+        """Attach the channel's event-time watermark to an unbounded
+        source's batch.  Derived host-side from the lineage's recorded max
+        event time (never a device sync), persisted per seq (SWM) so
+        recovery replay re-presents the identical watermark sequence, and
+        monotone per channel (SWMC high-water)."""
+        wm = self.store.tget("SWM", (a, ch, seq))
+        if wm is None:
+            lineage = self.store.tget("LT", (a, ch, seq))
+            delay = float(getattr(info.reader, "watermark_delay", 0.0))
+            wm = float(info.reader.lineage_time_max(lineage)) - delay
+            prev = self.store.tget("SWMC", (a, ch))
+            if prev is not None:
+                wm = max(wm, prev)
+            with self.store.transaction():
+                self.store.tset("SWM", (a, ch, seq), wm)
+                self.store.tset("SWMC", (a, ch), wm)
+            self._stream_lag_update(a, ch, advanced=True)
+        batch._stream_wm = wm
+        batch._stream_ch = ch
+        return batch
+
+    def _stream_lag_update(self, a: int, ch: int, advanced: bool) -> None:
+        """stream.watermark_lag_s gauge: wall seconds since the source
+        watermark last ADVANCED (0 while it moves) — the standing query's
+        staleness signal.  Instruments resolved once per engine, same
+        no-resurrection discipline as the latency histograms."""
+        gauges = getattr(self, "_stream_lag_gauges", None)
+        if gauges is None:
+            with _LAZY_INIT_LOCK:
+                gauges = getattr(self, "_stream_lag_gauges", None)
+                if gauges is None:
+                    qid = getattr(self.g, "query_id", None)
+                    insts = [obs.REGISTRY.gauge("stream.watermark_lag_s")]
+                    if qid is not None:
+                        insts.append(obs.REGISTRY.gauge(
+                            f"stream.watermark_lag_s.{qid}"))
+                    self._stream_wm_advanced_at = {}
+                    gauges = self._stream_lag_gauges = insts
+        now = time.time()
+        if advanced or (a, ch) not in self._stream_wm_advanced_at:
+            self._stream_wm_advanced_at[(a, ch)] = now
+        lag = now - min(self._stream_wm_advanced_at.values())
+        for g in gauges:
+            g.set(lag)
+
+    def _stamp_exec_wm(self, executor, out, channel: int) -> None:
+        """Streaming executors' emissions carry the operator watermark so
+        chained streaming stages clock off their upstream."""
+        if out is None:
+            return
+        fn = getattr(executor, "current_watermark", None)
+        if fn is None:
+            return
+        wm = fn(channel)
+        if wm is not None and wm != float("-inf"):
+            out._stream_wm = wm
+            out._stream_ch = channel
+
+    def _attach_stream_wm(self, name: Tuple, b):
+        """Replay/recovery resolution path: re-attach the watermark recorded
+        for this object's producing seq (batch attrs do not survive the
+        arrow round trip through the HBQ spill)."""
+        if b is None:
+            return b
+        wm = self.store.tget("SWM", (name[0], name[1], name[2]))
+        if wm is not None:
+            b._stream_wm = wm
+            b._stream_ch = name[1]
+        return b
 
     # -- exec task (core.py:484-700) -----------------------------------------
     def handle_exec_task(self, task: ExecutorTask) -> bool:
@@ -796,6 +964,7 @@ class Engine:
                 # a full host round trip); empty batches flow and are harmless
                 emitted = extra is not None
                 if emitted:
+                    self._stamp_exec_wm(executor, extra, task.channel)
                     self._emit(info, task.channel, out_seq, extra)
                     self._metric(task.actor, task.channel, self._rows_of(extra), 0)
                     out_seq += 1
@@ -814,6 +983,7 @@ class Engine:
                 outs = out  # list or generator
             for o in outs:
                 if o is not None:
+                    self._stamp_exec_wm(executor, o, task.channel)
                     self._emit(info, task.channel, out_seq, o)
                     self._metric(task.actor, task.channel, self._rows_of(o), 0)
                     out_seq += 1
@@ -848,6 +1018,7 @@ class Engine:
         out_seq = task.out_seq
         emitted = out is not None
         if emitted:
+            self._stamp_exec_wm(executor, out, task.channel)
             with tracing.span("push.exec"):
                 self._emit(info, task.channel, out_seq, out)
             out_seq += 1
@@ -1021,6 +1192,15 @@ class Engine:
         # checkpoint (no shared spill disk is assumed).  Tape entries are
         # small host tuples — the reference similarly keeps full lineage in
         # Redis for the run's lifetime.
+        #
+        # Standing queries additionally persist a resume manifest (source
+        # segment log + watermark trail + this recovery point) so a FULL
+        # process restart — not just an in-process kill — resumes from here
+        # instead of offset zero (quokka_tpu/streaming/manifest.py).
+        if getattr(self.g, "stream_manifest", None):
+            from quokka_tpu.streaming import manifest as _smanifest
+
+            _smanifest.update(self.g)
 
     def simulate_failure_and_recover(self, failed: List[Tuple[int, int]]) -> None:
         """Kill the given exec (actor, channel) workers — losing executor
@@ -1063,6 +1243,11 @@ class Engine:
             remaining = [s for s in range(last + 1) if s not in done]
             if remaining:
                 self.store.ntt_push(a, TapedInputTask(a, ch, remaining))
+            elif (getattr(info.reader, "UNBOUNDED", False)
+                  and not self.store.tget("SST", a)):
+                # a fully committed UNBOUNDED channel is idle, not done:
+                # requeue an empty tape so the poll loop keeps tailing
+                self.store.ntt_push(a, TapedInputTask(a, ch, []))
             else:
                 self.store.sadd("DST", (a, ch), "done")
             return
@@ -1120,14 +1305,17 @@ class Engine:
 
     def _resolve_lost_object(self, name: Tuple):
         """cache -> any live HBQ -> input re-read; None if irrecoverable
-        right now (the producer's tape replay may still regenerate it)."""
+        right now (the producer's tape replay may still regenerate it).
+        Watermarks re-attach from the SWM trail: batch attrs do not survive
+        the arrow round trip, and replay determinism needs the exact
+        original watermark sequence."""
         b = self.cache.get(name)
         if b is not None:
-            return b
+            return self._attach_stream_wm(name, b)
         table = self._hbq_fetch(name)
         if table is not None:
-            return bridge.arrow_to_device(table)
-        return self._recompute_object(name)
+            return self._attach_stream_wm(name, bridge.arrow_to_device(table))
+        return self._attach_stream_wm(name, self._recompute_object(name))
 
     def _hbq_contains(self, name: Tuple) -> bool:
         """Listing-level probe; the distributed Worker overrides this to also
@@ -1212,7 +1400,8 @@ class Engine:
                 if not self._object_available(name):
                     return _requeue_waiting(name)
                 probed.add(name)
-        self.execs[(a, ch)] = self.g.actors[a].executor_factory()
+        self.execs[(a, ch)] = self._bind_executor(
+            self.g.actors[a].executor_factory())
         try:
             blob = self._ckpt_store().load(a, ch, task.state_seq)
         except CorruptArtifactError:
@@ -1520,6 +1709,7 @@ class Engine:
                 re_emitted = out is not None
                 assert re_emitted == emitted, "non-deterministic replay"
                 if re_emitted:
+                    self._stamp_exec_wm(executor, out, ch)
                     self._emit(info, ch, out_seq, out)
                     out_seq += 1
                 for name in names:
@@ -1534,6 +1724,7 @@ class Engine:
                 re_emitted = extra is not None
                 assert re_emitted == emitted, "non-deterministic replay"
                 if re_emitted:
+                    self._stamp_exec_wm(executor, extra, ch)
                     self._emit(info, ch, out_seq, extra)
                     out_seq += 1
         return state_seq, out_seq

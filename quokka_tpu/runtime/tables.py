@@ -46,6 +46,11 @@ from typing import Any, Dict, List, Optional, Tuple
 TABLE_NAMES = (
     "CT", "NOT", "PT", "NTT", "GIT", "LT", "DST", "LCT", "EST", "CLT",
     "FOT", "IRT", "SAT", "PFT", "AST", "LIT", "EWT", "CMT",
+    # streaming plane: SWM = per-(actor, ch, seq) watermark stamped at push
+    # (recovery replay re-presents the exact watermark sequence); SWMC =
+    # per-(actor, ch) watermark high-water mark; SST = stop flags of
+    # standing-query source actors (StreamingHandle.stop)
+    "SWM", "SWMC", "SST",
 )
 
 

@@ -197,18 +197,7 @@ class QueryService:
             if self._shutdown:
                 raise ServiceShutdown("QueryService is shut down")
         ctx = stream.ctx
-        cfg = dict(self.exec_config)
-        # overlay only the context's NON-default keys: every QuokkaContext
-        # carries the full default dict, so a blind update() would silently
-        # revert the service-level exec_config to defaults on every submit
-        from quokka_tpu import config as qconfig
-
-        defaults = qconfig.DEFAULT_EXEC_CONFIG
-        for k, v in ctx.exec_config.items():
-            if k not in defaults or defaults[k] != v:
-                cfg[k] = v
-        if exec_config:
-            cfg.update(exec_config)
+        cfg = self._merged_config(ctx, exec_config)
         qid = new_query_id()
         graph = TaskGraph(cfg, store=self.store,
                           cache=BatchCache(owner=qid), query_id=qid,
@@ -219,13 +208,7 @@ class QueryService:
                    else estimate_working_set(graph))
             session = QuerySession(qid, graph, sink_actor, est,
                                    self.inflight_per_query)
-            with self._lock:
-                if self._shutdown:
-                    raise ServiceShutdown("QueryService is shut down")
-                self.admission.offer(qid, est)
-                self._sessions[qid] = session
-                self._queued[qid] = session
-                self._wake.notify_all()
+            self._enqueue_session(session)
         except BaseException:
             graph.cleanup()
             raise
@@ -235,6 +218,136 @@ class QueryService:
         obs.RECORDER.record("service.submit", qid, q=qid, est_bytes=est)
         return session.handle
 
+    def _enqueue_session(self, session: QuerySession) -> None:
+        """Charge admission and queue a freshly built session — the one
+        locked shutdown-recheck/offer/queue/notify block both submit paths
+        share (a raced shutdown() must never strand an offered session)."""
+        with self._lock:
+            if self._shutdown:
+                raise ServiceShutdown("QueryService is shut down")
+            self.admission.offer(session.query_id, session.est_bytes)
+            self._sessions[session.query_id] = session
+            self._queued[session.query_id] = session
+            self._wake.notify_all()
+
+    def _merged_config(self, ctx, exec_config: Optional[dict]) -> dict:
+        """Service config overlaid with the context's NON-default keys (every
+        QuokkaContext carries the full default dict, so a blind update()
+        would silently revert the service-level exec_config to defaults on
+        every submit), then any per-submit overrides."""
+        from quokka_tpu import config as qconfig
+
+        cfg = dict(self.exec_config)
+        defaults = qconfig.DEFAULT_EXEC_CONFIG
+        for k, v in ctx.exec_config.items():
+            if k not in defaults or defaults[k] != v:
+                cfg[k] = v
+        if exec_config:
+            cfg.update(exec_config)
+        return cfg
+
+    def submit_continuous(self, stream, *,
+                          resume_from: Optional[str] = None,
+                          delivered_floor: Optional[int] = None,
+                          manifest_path: Optional[str] = None,
+                          working_set_bytes: Optional[int] = None,
+                          exec_config: Optional[dict] = None):
+        """Run ``stream`` as a STANDING query over its unbounded sources
+        (quokka_tpu/streaming/): batches keep flowing as the tailed inputs
+        grow, windowed/asof operators emit finalized panes incrementally as
+        the event-time watermark advances, and the returned
+        ``StreamingHandle`` delivers them via ``poll_deltas()`` until
+        ``stop()`` drains the stream (final state bit-exact with the
+        equivalent one-shot batch run).
+
+        With ``fault_tolerance`` on, incremental checkpoints (operator
+        state + source offsets + watermark snapshot) flow through the normal
+        checksummed atomic checkpoint path and additionally persist a resume
+        manifest; ``resume_from=<manifest>`` resubmits the SAME plan after a
+        full service restart and continues from the last checkpointed pane
+        boundary — only post-frontier segments replay, never the whole
+        stream.  A client that durably captured N delta tables before the
+        crash passes ``delivered_floor=N`` so the resume point never
+        postdates its capture frontier (closing the output-commit gap —
+        every uncaptured pane re-emits, deduped by pane identity).
+        Restart survival requires a stable ``spill_dir`` (and/or
+        ``checkpoint_store``); standing queries share admission and fair
+        scheduling with batch queries but are exempt from the query-stall
+        timeout (idle is healthy).  Under an active ``QK_CHAOS`` kill spec,
+        seeded kills of the streaming operators are injected and recovered
+        through the tape-replay protocol, exactly-once."""
+        from quokka_tpu.chaos import CHAOS
+        from quokka_tpu.streaming import manifest as smanifest
+        from quokka_tpu.streaming.handle import StreamingHandle
+
+        with self._lock:
+            if self._shutdown:
+                raise ServiceShutdown("QueryService is shut down")
+        ctx = stream.ctx
+        cfg = self._merged_config(ctx, exec_config)
+        if resume_from and not cfg.get("fault_tolerance"):
+            raise ValueError(
+                "resume_from needs fault_tolerance=True: the resumed "
+                "stream restores executor checkpoints and replays spilled "
+                "segments, neither of which exists without it")
+        resume = smanifest.load(resume_from) if resume_from else None
+        qid = resume["query_id"] if resume else new_query_id()
+        with self._lock:
+            if qid in self._sessions:
+                # a duplicate resume of a LIVE stream would run two engines
+                # against one store/spill/checkpoint namespace — interleaved
+                # seq assignments and conflicting pane deltas, silently
+                raise ValueError(
+                    f"stream {qid} is already running in this service — "
+                    "stop it before resuming its manifest again")
+        graph = TaskGraph(cfg, store=self.store,
+                          cache=BatchCache(owner=qid), query_id=qid,
+                          spill_dir=self._spill_dir)
+        resume_info = None
+        try:
+            sink_actor = ctx.lower_into(stream.node_id, graph)
+            if not any(getattr(info.reader, "UNBOUNDED", False)
+                       for info in graph.actors.values()
+                       if info.kind == "input"):
+                raise ValueError(
+                    "submit_continuous needs at least one UNBOUNDED source "
+                    "(a streaming.TailingCsvReader / TailingParquetDirReader"
+                    "); use submit() for finite plans")
+            if cfg.get("fault_tolerance"):
+                graph.stream_manifest = (
+                    manifest_path or smanifest.default_path(graph))
+            if resume is not None:
+                resume_info = smanifest.apply_resume(
+                    graph, resume, delivered_floor=delivered_floor)
+            est = (int(working_set_bytes) if working_set_bytes is not None
+                   else estimate_working_set(graph))
+            session = QuerySession(qid, graph, sink_actor, est,
+                                   self.inflight_per_query)
+            session.streaming = True
+            # seeded chaos: standing queries take REPEATED kills of their
+            # checkpointable streaming operators over the stream's lifetime
+            if CHAOS.enabled and cfg.get("fault_tolerance"):
+                chans = sorted(
+                    (a, ch) for (a, ch), e in session.engine.execs.items()
+                    if getattr(e, "SUPPORTS_CHECKPOINT", False))
+                plan = CHAOS.plan_stream_kills(chans)
+                if plan:
+                    session.inject_plan = [
+                        {"after_tasks": after, "channels": channels}
+                        for after, channels in plan]
+                    if session.inject is None:
+                        session.inject = session.inject_plan.pop(0)
+            self._enqueue_session(session)
+        except BaseException:
+            # an aborted submit never ran: durable resume state (if any)
+            # must survive for the next attempt
+            graph.cleanup(preserve_durable=resume_from is not None)
+            raise
+        self._admit_pending()
+        obs.RECORDER.record("service.submit_continuous", qid, q=qid,
+                            est_bytes=est, resumed=resume is not None)
+        return StreamingHandle(session, resume_info=resume_info)
+
     def stats(self) -> Dict:
         from quokka_tpu.runtime import scancache
 
@@ -243,6 +356,7 @@ class QueryService:
         # resurrect the just-GC'd per-query histogram (it would leak one
         # empty labeled family per finished query, forever)
         hists = obs.REGISTRY.histograms()
+        counters = obs.REGISTRY.snapshot()
         with self._lock:
             sessions = {}
             for qid, s in self._sessions.items():
@@ -260,6 +374,27 @@ class QueryService:
                     "task_p95_s": lat["p95"],
                     "tasks": lat["count"],
                 }
+                if s.streaming:
+                    # standing-query row: source watermarks + pane/late
+                    # counters (snapshot lookups — a scrape must never
+                    # resurrect a GC'd per-query instrument)
+                    wms = {}
+                    for info in s.graph.actors.values():
+                        if info.kind != "input" or not getattr(
+                                info.reader, "UNBOUNDED", False):
+                            continue
+                        for ch in range(info.channels):
+                            wms[f"{info.id}.{ch}"] = s.graph.store.tget(
+                                "SWMC", (info.id, ch))
+                    sessions[qid].update({
+                        "streaming": True,
+                        "watermarks": wms,
+                        "watermark_lag_s": counters.get(
+                            f"stream.watermark_lag_s.{qid}", 0.0),
+                        "panes": counters.get(f"stream.panes.{qid}", 0),
+                        "late_dropped": counters.get(
+                            f"stream.late_dropped.{qid}", 0),
+                    })
         return {
             "pool_size": self.pool_size,
             "workers_alive": sum(t.is_alive() for t in self._threads),
@@ -394,7 +529,13 @@ class QueryService:
                 if due:
                     self._maybe_inject(session)
             else:  # "wait" / "idle": the query is blocked on its own pipeline
-                if time.time() - session.last_progress > self.query_timeout:
+                # standing queries are exempt from the stall timeout — one
+                # waiting for data is healthy, and keeps its slot
+                # indefinitely (watermark-lag / /status surface staleness);
+                # they share the batch queries' backoff below
+                if (not session.streaming and
+                        time.time() - session.last_progress
+                        > self.query_timeout):
                     self._finish(session, QueryStallTimeout(
                         f"query {session.query_id} made no progress for "
                         f"{self.query_timeout:.0f}s "
@@ -435,7 +576,11 @@ class QueryService:
                                 q=session.query_id,
                                 channels=repr(inj["channels"]))
             session.engine.simulate_failure_and_recover(inj["channels"])
-            session.inject = None
+            # standing queries re-arm from the seeded stream-kill plan:
+            # kills keep landing over the stream's lifetime, each recovered
+            # through the tape-replay protocol
+            session.inject = (session.inject_plan.pop(0)
+                              if session.inject_plan else None)
         except BaseException as e:  # noqa: BLE001
             err = e
         finally:

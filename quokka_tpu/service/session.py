@@ -57,6 +57,14 @@ class QuerySession:
         # fault-injection hook (the test_fault_tolerance.py discipline):
         # {"after_tasks": n, "channels": [(actor, ch), ...]} — consumed once
         self.inject = dict(graph.exec_config.get("inject_failure") or {}) or None
+        # standing queries re-arm injection from this queue after each kill
+        # (the chaos plane's seeded stream-kill plan) — cumulative
+        # after_tasks thresholds, consumed in order
+        self.inject_plan: list = []
+        # submit_continuous sets True: exempt from the query-stall timeout
+        # (an idle standing query is healthy), torn down with its durable
+        # recovery state preserved, surfaced as a standing row in /status
+        self.streaming = False
         # snapshotted at finish, before the namespace GC
         self.scan_stats: Optional[Dict] = None
 
@@ -89,7 +97,12 @@ class QuerySession:
             self.latency_stats = (h.stats() if h is not None
                                   else obs.Histogram.empty_stats())
             try:
-                self.graph.cleanup()  # metrics snapshot + drop_namespace
+                # a standing query that FAILED (or was shut down mid-stream)
+                # keeps its durable recovery trio — checkpoints, HBQ spill,
+                # resume manifest — so a restarted replica resumes it; a
+                # cleanly stopped stream is complete and GCs everything
+                self.graph.cleanup(preserve_durable=(
+                    self.streaming and error is not None))
             except Exception as e:  # noqa: BLE001 — teardown must not kill
                 from quokka_tpu import obs  # the pool thread running it
 

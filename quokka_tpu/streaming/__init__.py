@@ -1,0 +1,58 @@
+"""Streaming plane: standing queries over unbounded sources.
+
+The batch engine runs a fixed lineage tape to completion; this package turns
+the same push-based runtime into a continuous one (ROADMAP item 4 — the
+reference's whole identity: time-series asof joins, windowed aggregates, CEP,
+the rottnest backtester, all push-based over *arriving* data):
+
+- **unbounded sources** (``source.py``): a tailing reader watches a growing
+  CSV file or a directory of appended Parquet segments and emits new batches
+  with monotone segment offsets; every discovered segment is recorded in the
+  control store (and the resume manifest), so a segment is read exactly once
+  per consumption and re-reads are byte-identical (the lineage discipline).
+- **event-time watermarks** (``watermark.py``): each source batch carries the
+  watermark ``max_event_time_seen - delay``; the engine threads it through
+  the partitioned push path and recovery replay, and streaming executors
+  combine per-channel watermarks with a min-clock.
+- **incremental executors** (``executors.py``): windowed aggregation and asof
+  join that emit *finalized panes* as the watermark passes them instead of
+  waiting for end-of-input, drop-and-count late data, and checkpoint through
+  the engine's existing checksummed atomic snapshot path.
+- **chaos-survivable resume** (``manifest.py``): every incremental checkpoint
+  also writes an atomic, integrity-framed stream manifest (source offsets +
+  executor recovery points).  A ``QK_CHAOS``-killed worker recovers through
+  the normal tape-replay protocol; a full service restart resumes the stream
+  from the manifest — replaying only the segments past the checkpointed
+  frontier, never the whole stream.
+- **service surface** (``service/server.py``):
+  ``QueryService.submit_continuous(stream) -> StreamingHandle`` with
+  ``poll_deltas()`` / ``stop()``; standing queries coexist with batch
+  queries under the same admission/fair-scheduling planes.
+
+Capstone: ``make stream-smoke`` (``python -m quokka_tpu.streaming.smoke``).
+"""
+
+from quokka_tpu.streaming.executors import (
+    StreamingAsofJoinExecutor,
+    StreamingWindowAggExecutor,
+)
+from quokka_tpu.streaming.handle import StreamingHandle
+from quokka_tpu.streaming.plan import tail_asof_join, tail_window_agg
+from quokka_tpu.streaming.source import (
+    StreamTruncatedError,
+    TailingCsvReader,
+    TailingParquetDirReader,
+)
+from quokka_tpu.streaming.watermark import WatermarkClock
+
+__all__ = [
+    "StreamTruncatedError",
+    "StreamingAsofJoinExecutor",
+    "StreamingHandle",
+    "StreamingWindowAggExecutor",
+    "TailingCsvReader",
+    "TailingParquetDirReader",
+    "WatermarkClock",
+    "tail_asof_join",
+    "tail_window_agg",
+]

@@ -1,0 +1,380 @@
+"""Incremental streaming executors: watermark-driven pane emission.
+
+Both executors follow the engine's normal Executor protocol — watermark
+handling rides entirely on the batches (``_stream_wm`` / ``_stream_ch``
+attrs stamped by the engine, persisted per seq in the control store so
+recovery replay re-presents the identical watermark sequence).  That keeps
+``execute()`` a pure function of (restored state, batch sequence): the tape
+replay's determinism assertion holds for streams exactly as it does for
+batch queries.
+
+Emission model: each ``execute`` call may return ONE delta batch — the panes
+the current watermark just finalized.  ``done`` flushes everything that
+remains (end-of-stream finalizes all state), which is what makes a stopped
+stream bit-exact with the equivalent one-shot batch query.  Late events —
+rows belonging to an already-finalized pane — are dropped and counted
+(``stream.late_dropped``; a per-query twin GCs with the namespace).
+
+State is host-side (pandas) and picklable: these operators are bounded by
+the number of OPEN panes / pending rows, not by stream length, and their
+``checkpoint()``/``restore()`` ride the engine's checksummed atomic
+checkpoint path (SUPPORTS_CHECKPOINT).  Counters are resolved at
+``bind_query`` time (called by the engine after the per-channel factory
+copy), never deep-copied, and never included in checkpoints — replayed
+drops may recount, which is the usual at-least-once counter semantic.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+
+from quokka_tpu.executors.base import Executor
+from quokka_tpu.ops import bridge
+from quokka_tpu.ops.batch import DeviceBatch
+from quokka_tpu.streaming.watermark import WatermarkClock
+
+_AGG_FNS = ("sum", "count", "min", "max")
+
+
+class _StreamingExecutor(Executor):
+    SUPPORTS_CHECKPOINT = True
+
+    def bind_query(self, query_id: Optional[str]) -> None:
+        """Resolve pane/late counters once per live instance (global family
+        plus per-query twins, GC'd with the namespace in TaskGraph.cleanup)."""
+        from quokka_tpu import obs
+
+        self._counters = {}
+        for name in ("stream.panes", "stream.late_dropped"):
+            insts = [obs.REGISTRY.counter(name)]
+            if query_id is not None:
+                insts.append(obs.REGISTRY.counter(f"{name}.{query_id}"))
+            self._counters[name] = insts
+
+    def _count(self, name: str, n: int) -> None:
+        for c in getattr(self, "_counters", {}).get(name, ()):
+            c.inc(n)
+
+    def _observe_batch(self, clock: WatermarkClock, batch: DeviceBatch,
+                       stream_id: int) -> None:
+        wm = getattr(batch, "_stream_wm", None)
+        if wm is not None:
+            clock.observe(stream_id, getattr(batch, "_stream_ch", 0), wm)
+
+    @staticmethod
+    def _to_table(df: pd.DataFrame) -> DeviceBatch:
+        return bridge.arrow_to_device(
+            pa.Table.from_pandas(df, preserve_index=False))
+
+
+class StreamingWindowAggExecutor(_StreamingExecutor):
+    """Tumbling-window aggregation with incremental, watermark-driven pane
+    emission.
+
+    ``aggs``: ``[(out_name, fn, col), ...]`` with fn in sum/count/min/max
+    (combinable partials — pane state is one scalar per agg per key, bounded
+    by open panes, never by stream length).  Output schema:
+    ``[window_start, window_end, *by, *out_names]``; a pane (one window,
+    every key) is emitted exactly once, in window order, when the watermark
+    passes its end.  Pane identity for client-side delta dedup is
+    ``(window_start, *by)``.
+    """
+
+    def __init__(self, time_col: str, by: Sequence[str], size,
+                 aggs: Sequence[Tuple[str, str, Optional[str]]],
+                 n_source_channels: int = 1):
+        for name, fn, _col in aggs:
+            if fn not in _AGG_FNS:
+                raise ValueError(f"agg {name}={fn!r} not in {_AGG_FNS}")
+        self.time_col = time_col
+        self.by = list(by)
+        self.size = size
+        self.aggs = [(n, f, c) for n, f, c in aggs]
+        self.clock = WatermarkClock({0: n_source_channels})
+        # (window_id, key_tuple) -> [partial per agg]
+        self.panes: Dict[Tuple, List] = {}
+        self.finalized_upto: float = -math.inf  # window ids below are closed
+        self.late_rows = 0
+
+    def plan_signature(self):
+        """Stable operator identity for the resume manifest's plan check."""
+        return ("winagg", self.time_col, tuple(self.by), self.size,
+                tuple(self.aggs))
+
+    # -- engine protocol -----------------------------------------------------
+    def current_watermark(self, channel: int) -> float:
+        return self.clock.current()
+
+    def execute(self, batches: List[DeviceBatch], stream_id: int,
+                channel: int) -> Optional[DeviceBatch]:
+        for b in batches:
+            df = bridge.to_pandas(b)
+            if df is not None and len(df):
+                self._absorb(df)
+            # the batch's own rows are never late against its own watermark:
+            # absorb first, then advance the clock
+            self._observe_batch(self.clock, b, stream_id)
+        return self._finalize(self.clock.current())
+
+    def source_done(self, stream_id: int, channel: int) -> Optional[DeviceBatch]:
+        self.clock.stream_done(stream_id)
+        return self._finalize(self.clock.current())
+
+    def done(self, channel: int) -> Optional[DeviceBatch]:
+        return self._finalize(None, flush_all=True)
+
+    # -- state ----------------------------------------------------------------
+    def checkpoint(self):
+        return {
+            "clock": self.clock.snapshot(),
+            "panes": {k: list(v) for k, v in self.panes.items()},
+            "finalized_upto": self.finalized_upto,
+            "late_rows": self.late_rows,
+        }
+
+    def restore(self, state) -> None:
+        self.clock.restore(state["clock"])
+        self.panes = {k: list(v) for k, v in state["panes"].items()}
+        self.finalized_upto = state["finalized_upto"]
+        self.late_rows = state["late_rows"]
+
+    # -- internals -------------------------------------------------------------
+    def _absorb(self, df: pd.DataFrame) -> None:
+        t = df[self.time_col].to_numpy()
+        wid = np.floor_divide(t, self.size)
+        late = wid < self.finalized_upto
+        n_late = int(late.sum())
+        if n_late:
+            self.late_rows += n_late
+            self._count("stream.late_dropped", n_late)
+            df = df.loc[~late]
+            wid = wid[~late]
+        if not len(df):
+            return
+        # de-duplicated selection: two aggs over one column (min+max) or an
+        # agg column doubling as a key would otherwise produce duplicate
+        # labels, and gdf[col] would hand back a DataFrame instead of a
+        # Series (a Series-valued pane partial poisons finalization)
+        cols = list(dict.fromkeys(
+            [c for _n, _f, c in self.aggs if c is not None] + self.by))
+        work = df[cols].copy() if self.by else df.copy()
+        work["__wid"] = wid
+        grouped = work.groupby(["__wid"] + self.by, sort=True)
+        for gkey, gdf in grouped:
+            gkey = gkey if isinstance(gkey, tuple) else (gkey,)
+            pane = (gkey[0], tuple(gkey[1:]))
+            cur = self.panes.get(pane)
+            if cur is None:
+                cur = self.panes[pane] = [None] * len(self.aggs)
+            for i, (_name, fn, col) in enumerate(self.aggs):
+                if fn == "count":
+                    part = len(gdf)
+                    cur[i] = part if cur[i] is None else cur[i] + part
+                    continue
+                vals = gdf[col]
+                part = getattr(vals, fn)()
+                if cur[i] is None:
+                    cur[i] = part
+                elif fn == "sum":
+                    cur[i] = cur[i] + part
+                elif fn == "min":
+                    cur[i] = min(cur[i], part)
+                else:
+                    cur[i] = max(cur[i], part)
+
+    def _finalize(self, wm: Optional[float],
+                  flush_all: bool = False) -> Optional[DeviceBatch]:
+        if flush_all:
+            close = sorted(self.panes)
+        else:
+            if wm is None or wm == -math.inf:
+                return None
+            # pane [w*size, (w+1)*size) is complete once every event time
+            # strictly below the watermark is final: end <= wm closes it
+            close = sorted(k for k in self.panes if (k[0] + 1) * self.size <= wm)
+        if not close:
+            return None
+        rows = []
+        for key in close:
+            wid, gkey = key
+            partials = self.panes.pop(key)
+            rows.append((wid * self.size, (wid + 1) * self.size)
+                        + gkey + tuple(partials))
+        if not flush_all:
+            self.finalized_upto = max(self.finalized_upto, close[-1][0] + 1)
+        else:
+            self.finalized_upto = math.inf
+        names = (["window_start", "window_end"] + self.by
+                 + [n for n, _f, _c in self.aggs])
+        df = pd.DataFrame.from_records(rows, columns=names)
+        self._count("stream.panes", len(close))
+        return self._to_table(df)
+
+
+class StreamingAsofJoinExecutor(_StreamingExecutor):
+    """Continuous backward asof join (trades ⟕ last quote at-or-before).
+
+    Streams: 0 = left (probe, e.g. trades), 1 = right (reference, e.g.
+    quotes).  Rows finalize when the combined watermark passes their event
+    time: every quote at or before a finalized trade has, by the watermark
+    claim, already arrived — so the pandas ``merge_asof`` over the finalized
+    slice matches what the one-shot batch asof produces (pandas tie
+    semantics, the same contract the batch asof kernels are tested against).
+
+    Right-side state is pruned to the last quote per key at the finalized
+    boundary plus everything after it — bounded by key cardinality + open
+    disorder window.  Late rows on either side (event time below the
+    finalized boundary) are dropped and counted: a late quote could rewrite
+    already-emitted joins, which exactly-once delivery forbids.
+    """
+
+    def __init__(self, on: str, left_by: Sequence[str],
+                 right_by: Sequence[str], left_cols: Sequence[str],
+                 right_cols: Sequence[str], suffix: str = "_2",
+                 n_left_channels: int = 1, n_right_channels: int = 1):
+        self.on = on
+        self.left_by = list(left_by)
+        self.right_by = list(right_by)
+        self.left_cols = list(left_cols)
+        self.right_cols = list(right_cols)
+        self.suffix = suffix
+        self.rpayload = [c for c in self.right_cols
+                         if c not in set(self.right_by) and c != on]
+        self.out_cols = self.left_cols + [
+            c + suffix if c in set(self.left_cols) else c
+            for c in self.rpayload
+        ]
+        self.clock = WatermarkClock({0: n_left_channels,
+                                     1: n_right_channels})
+        self.left_buf: List[pd.DataFrame] = []
+        self.right_buf: List[pd.DataFrame] = []
+        self.finalized_to: float = -math.inf
+        self.late_rows = 0
+
+    def plan_signature(self):
+        """Stable operator identity for the resume manifest's plan check."""
+        return ("stream_asof", self.on, tuple(self.left_by),
+                tuple(self.right_by), tuple(self.left_cols),
+                tuple(self.right_cols), self.suffix)
+
+    # -- engine protocol -----------------------------------------------------
+    def current_watermark(self, channel: int) -> float:
+        return self.clock.current()
+
+    def execute(self, batches: List[DeviceBatch], stream_id: int,
+                channel: int) -> Optional[DeviceBatch]:
+        for b in batches:
+            df = bridge.to_pandas(b)
+            if df is not None and len(df):
+                self._absorb(df, stream_id)
+            self._observe_batch(self.clock, b, stream_id)
+        return self._finalize(self.clock.current())
+
+    def source_done(self, stream_id: int, channel: int) -> Optional[DeviceBatch]:
+        self.clock.stream_done(stream_id)
+        return self._finalize(self.clock.current())
+
+    def done(self, channel: int) -> Optional[DeviceBatch]:
+        return self._finalize(None, flush_all=True)
+
+    # -- state ----------------------------------------------------------------
+    def checkpoint(self):
+        return {
+            "clock": self.clock.snapshot(),
+            "left": list(self.left_buf),
+            "right": list(self.right_buf),
+            "finalized_to": self.finalized_to,
+            "late_rows": self.late_rows,
+        }
+
+    def restore(self, state) -> None:
+        self.clock.restore(state["clock"])
+        self.left_buf = list(state["left"])
+        self.right_buf = list(state["right"])
+        self.finalized_to = state["finalized_to"]
+        self.late_rows = state["late_rows"]
+
+    # -- internals -------------------------------------------------------------
+    def _absorb(self, df: pd.DataFrame, stream_id: int) -> None:
+        late = df[self.on].to_numpy() < self.finalized_to
+        n_late = int(late.sum())
+        if n_late:
+            self.late_rows += n_late
+            self._count("stream.late_dropped", n_late)
+            df = df.loc[~late]
+        if not len(df):
+            return
+        (self.left_buf if stream_id == 0 else self.right_buf).append(df)
+
+    def _finalize(self, wm: Optional[float],
+                  flush_all: bool = False) -> Optional[DeviceBatch]:
+        if flush_all:
+            boundary = math.inf
+        else:
+            if wm is None or wm == -math.inf:
+                return None
+            boundary = wm
+        if boundary <= self.finalized_to and not flush_all:
+            return None
+        trades = (pd.concat(self.left_buf, ignore_index=True)
+                  if self.left_buf else None)
+        if trades is not None:
+            # events strictly below the watermark are final; == wm may still
+            # gain an earlier quote, so it stays pending
+            fin = trades[self.on].to_numpy() < boundary
+            chunk, rest = trades.loc[fin], trades.loc[~fin]
+            self.left_buf = [rest.reset_index(drop=True)] if len(rest) else []
+        else:
+            chunk = None
+        quotes = (pd.concat(self.right_buf, ignore_index=True)
+                  if self.right_buf else None)
+        usable = None
+        if quotes is not None:
+            qfin = quotes[self.on].to_numpy() < boundary
+            usable = quotes.loc[qfin]
+            # prune: the last usable quote per key still answers future
+            # trades; everything at/after the boundary stays whole
+            keep = []
+            if len(usable):
+                tail = (usable.sort_values(self.on, kind="mergesort")
+                        .groupby(self.right_by, sort=False).tail(1)
+                        if self.right_by else
+                        usable.sort_values(self.on, kind="mergesort").tail(1))
+                keep.append(tail)
+            pend = quotes.loc[~qfin]
+            if len(pend):
+                keep.append(pend)
+            self.right_buf = ([pd.concat(keep, ignore_index=True)]
+                              if keep else [])
+        self.finalized_to = max(self.finalized_to, boundary)
+        if chunk is None or not len(chunk):
+            return None
+        out = self._join(chunk, usable)
+        self._count("stream.panes", 1)
+        return self._to_table(out)
+
+    def _join(self, chunk: pd.DataFrame,
+              usable: Optional[pd.DataFrame]) -> pd.DataFrame:
+        chunk = chunk.sort_values(self.on, kind="mergesort") \
+                     .reset_index(drop=True)
+        if usable is None or not len(usable):
+            out = chunk.copy()
+            for c in self.rpayload:
+                name = c + self.suffix if c in set(self.left_cols) else c
+                out[name] = np.nan
+            return out[self.out_cols]
+        usable = usable.sort_values(self.on, kind="mergesort") \
+                       .reset_index(drop=True)
+        kw = {}
+        if self.left_by:
+            kw = {"left_by": self.left_by, "right_by": self.right_by}
+        out = pd.merge_asof(
+            chunk, usable[[self.on] + self.right_by + self.rpayload],
+            on=self.on, direction="backward",
+            suffixes=("", self.suffix), **kw)
+        return out[self.out_cols]

@@ -1,0 +1,108 @@
+"""StreamingHandle: the client surface of a standing query.
+
+What ``QueryService.submit_continuous`` returns.  Deltas are the finalized
+panes the sink has received, delivered in (channel, seq) order and at most
+once per seq within this handle's lifetime (recovery replay OVERWRITES seqs
+with byte-identical tables, so the cursor also makes redelivery invisible).
+
+Across a full service restart, delivery is at-least-once with deterministic
+pane identities: the resumed stream re-emits everything after the last
+incremental checkpoint, and each windowed-agg row carries its
+``(window_start, *keys)`` pane key (asof rows carry their probe row) — a
+client that merges deltas by pane key converges to the exactly-once final
+state, which is what ``make stream-smoke`` asserts bit-exactly against the
+one-shot batch run.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+
+class StreamingHandle:
+    """Poll deltas, stop, and observe a standing query.  Thread-safe for a
+    single polling consumer."""
+
+    def __init__(self, session, resume_info: Optional[Dict] = None):
+        self._s = session
+        self._cursor: Dict[int, int] = {}
+        self.resume_info = resume_info
+
+    # -- identity / status ----------------------------------------------------
+    @property
+    def query_id(self) -> str:
+        return self._s.query_id
+
+    @property
+    def status(self) -> str:
+        return self._s.status
+
+    @property
+    def done(self) -> bool:
+        return self._s.finished
+
+    @property
+    def error(self):
+        return self._s.error
+
+    @property
+    def manifest_path(self) -> Optional[str]:
+        return getattr(self._s.graph, "stream_manifest", None)
+
+    def watermark(self) -> Optional[float]:
+        """Min source watermark across the query's unbounded inputs (None
+        until every channel has produced)."""
+        wms = []
+        g = self._s.graph
+        for info in g.actors.values():
+            if info.kind != "input" or not getattr(info.reader, "UNBOUNDED",
+                                                   False):
+                continue
+            for ch in range(info.channels):
+                wms.append(g.store.tget("SWMC", (info.id, ch)))
+        if not wms or any(w is None for w in wms):
+            return None
+        return min(wms)
+
+    # -- deltas ---------------------------------------------------------------
+    def poll_deltas(self) -> List:
+        """New finalized-pane tables since the last poll (possibly []).
+        Non-blocking; tables are pyarrow, in sink (channel, seq) order."""
+        ds = self._s.graph.result(self._s.sink_actor)
+        if ds is None:
+            return []
+        out = []
+        for ch, seq, table in ds.items_since(self._cursor):
+            self._cursor[ch] = max(self._cursor.get(ch, -1), seq)
+            out.append(table)
+        return out
+
+    # -- lifecycle ------------------------------------------------------------
+    def stop(self, timeout: Optional[float] = 120.0) -> "StreamingHandle":
+        """Graceful end-of-stream: sources stop at their currently
+        discovered segments, every open pane flushes through the normal
+        end-of-input path, and the query completes — final state is the
+        bit-exact equivalent of a one-shot batch run over everything
+        consumed.  Blocks until drained; re-raises the query's error."""
+        g = self._s.graph
+        for info in g.actors.values():
+            if info.kind == "input" and getattr(info.reader, "UNBOUNDED",
+                                                False):
+                g.store.tset("SST", info.id, True)
+        if not self._s.wait(timeout):
+            raise TimeoutError(
+                f"standing query {self.query_id} did not drain within "
+                f"{timeout}s of stop() (status={self.status})")
+        if self._s.error is not None:
+            raise self._s.error
+        return self
+
+    def wait(self, timeout: Optional[float] = None) -> "StreamingHandle":
+        if not self._s.wait(timeout):
+            raise TimeoutError(
+                f"standing query {self.query_id} still running after "
+                f"{timeout}s (status={self.status})")
+        return self
+
+    def metrics(self) -> Dict:
+        return self._s.graph.metrics()

@@ -1,0 +1,363 @@
+"""Stream resume manifest: what survives a full service restart.
+
+The in-process recovery protocol (chaos kills) replays from the control
+store's tapes — but the control store is memory.  For a standing query to
+survive a PROCESS death, the durable trio is:
+
+- executor snapshots (CheckpointStore — already durable, checksummed,
+  atomic),
+- the HBQ spill (already durable when the service runs on a stable
+  ``spill_dir``),
+- and this manifest: the source segment log (seq -> frozen lineage), the
+  per-seq watermarks, and each checkpointed exec channel's recovery point
+  ``(state_seq, out_seq)`` + input frontier (the IRT rows).
+
+The engine rewrites the manifest atomically (tmp + integrity frame +
+rename) after EVERY successful incremental checkpoint; a crash between
+checkpoints resumes from the previous manifest, whose checkpoint blobs are
+still on disk (snapshots are only GC'd at clean stream teardown).
+
+``apply_resume`` performs the restart surgery on a freshly lowered graph
+(same plan -> same actor ids, verified via the compile plane's structural
+plan fingerprint): seed LT/LIT/SWM/IRT/LCT, seed the tailing readers'
+discovery state from the recorded segmentation, and replace the initial
+NTT tasks with a TapedExecutorTask per checkpointed channel (empty-tape
+replay = restore snapshot, then live) plus a TapedInputTask covering only
+the segments at/after the checkpointed frontier — zero full-stream
+recomputation by construction.
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+import pickle
+import time
+from typing import Dict, Optional
+
+from quokka_tpu import obs
+from quokka_tpu.runtime import integrity
+from quokka_tpu.runtime.task import ExecutorTask, TapedExecutorTask, TapedInputTask
+
+MANIFEST_VERSION = 1
+
+
+class StreamResumeError(RuntimeError):
+    """The manifest cannot resume this plan (fingerprint mismatch, missing
+    actors, or an unreadable manifest) — loud, never a silent fresh start."""
+
+
+def _exec_desc(factory) -> str:
+    """Stable description of an executor factory: streaming executors expose
+    ``plan_signature()`` (operator config, no object addresses); everything
+    else describes by type."""
+    import functools
+
+    fn = factory
+    parts = []
+    while isinstance(fn, functools.partial):
+        parts.extend(type(a).__name__ for a in fn.args
+                     if not callable(a) or hasattr(a, "plan_signature"))
+        for a in fn.args:
+            sig = getattr(a, "plan_signature", None)
+            if sig is not None:
+                return repr(sig())
+        fn = fn.func
+    name = getattr(fn, "__name__", type(fn).__name__)
+    return "/".join([name] + parts)
+
+
+def stream_plan_fingerprint(graph) -> str:
+    """Structural fingerprint for resume verification.  Unlike the compile
+    plane's ``plan_fingerprint`` it must be stable across process restarts
+    of the SAME standing query — so no reader size buckets (a tailed file
+    grows between restarts) and no object reprs, just topology + operator
+    configuration."""
+    import hashlib
+
+    parts = []
+    for aid in sorted(graph.actors):
+        info = graph.actors[aid]
+        desc = [str(aid), info.kind, str(info.channels), str(info.stage)]
+        if info.reader is not None:
+            desc.append(type(info.reader).__name__)
+        if info.executor_factory is not None:
+            desc.append(_exec_desc(info.executor_factory))
+        desc.append(",".join(
+            f"{stream}:{src}"
+            for src, stream in sorted(info.source_streams.items())))
+        parts.append("|".join(desc))
+    return hashlib.sha256(";".join(parts).encode()).hexdigest()[:16]
+
+
+def default_path(graph) -> str:
+    root = graph.exec_config.get("checkpoint_store") or graph.ckpt_dir
+    if root is None or "://" in str(root):
+        # remote checkpoint roots keep their manifest next to the spill
+        root = graph.ckpt_dir or "."
+    return os.path.join(root, f"stream-{graph.query_id}.manifest")
+
+
+def _stream_inputs(graph):
+    for info in graph.actors.values():
+        if info.kind == "input" and getattr(info.reader, "UNBOUNDED", False):
+            yield info
+
+
+def update(graph) -> None:
+    """Write the current resume point; called by the engine after each
+    successful incremental checkpoint.  A failed write is a SKIPPED manifest
+    (the previous one stays valid), never a dead stream."""
+    path = getattr(graph, "stream_manifest", None)
+    if not path:
+        return
+    store = graph.store
+    m: Dict = {
+        "version": MANIFEST_VERSION,
+        "query_id": graph.query_id,
+        "plan_fp": stream_plan_fingerprint(graph),
+        "written_at": time.time(),
+        "inputs": {},
+        "execs": {},
+    }
+    with store.transaction():
+        for info in graph.actors.values():
+            if info.kind != "exec":
+                continue
+            for ch in range(info.channels):
+                lct = store.tget("LCT", (info.id, ch))
+                if lct is None:
+                    continue
+                irts = {}
+                for hist in [(0, 0, 0)] + [
+                        tuple(h) for h in
+                        (store.tget("LT", ("ckpts", info.id, ch)) or [])]:
+                    reqs = store.tget("IRT", (info.id, ch, hist[0]))
+                    if reqs is not None:
+                        irts[hist[0]] = {a: dict(c) for a, c in reqs.items()}
+                m["execs"][(info.id, ch)] = {
+                    "lct": tuple(lct),
+                    "ckpts": [tuple(h) for h in
+                              (store.tget("LT", ("ckpts", info.id, ch))
+                               or [])],
+                    "irts": irts,
+                }
+        # retained-history floor per input channel: the oldest segment any
+        # RECORDED checkpoint's frontier can still ask for.  Serializing
+        # only from there keeps the per-checkpoint manifest work (and its
+        # on-disk size) proportional to the checkpointed tail, not the
+        # stream's age; a delivered_floor rewind below it fails loudly in
+        # apply_resume rather than starving on unlogged segments.
+        retain: Dict = {}
+        for e in m["execs"].values():
+            for hist in e["ckpts"]:
+                for src, chans_ in e["irts"].get(hist[0], {}).items():
+                    for sch, nxt in chans_.items():
+                        key = (src, sch)
+                        retain[key] = min(retain.get(key, nxt), nxt)
+        for info in _stream_inputs(graph):
+            chans = {}
+            for ch in range(info.channels):
+                last = store.tget("LIT", (info.id, ch), -1)
+                # never trim the NEWEST segment: the readers re-derive
+                # their discovery position (byte offset / max filename)
+                # from the retained tail, which must not be empty
+                first = min(retain.get((info.id, ch), 0), max(last, 0))
+                segments = []
+                swm = {}
+                for s in range(first, last + 1):
+                    lin = store.tget("LT", (info.id, ch, s))
+                    if lin is None:
+                        continue
+                    segments.append((s, lin))
+                    wm = store.tget("SWM", (info.id, ch, s))
+                    if wm is not None:
+                        swm[s] = wm
+                chans[ch] = {"segments": segments, "swm": swm,
+                             "last": last,  # true LIT: the tail may be empty
+                             "wm": store.tget("SWMC", (info.id, ch))}
+            m["inputs"][info.id] = chans
+    try:
+        integrity.write_framed_atomic(path, pickle.dumps(m), site="ckpt")
+    except OSError as e:
+        obs.REGISTRY.counter("stream.manifest_skipped").inc()
+        obs.diag(f"[stream] manifest write to {path} skipped: {e!r}")
+
+
+def load(path: str) -> Dict:
+    """Read and verify a manifest; loud on corruption or version drift —
+    resume is an explicit operator request, never a best-effort guess."""
+    try:
+        m = pickle.loads(integrity.read_framed(path))
+    except (OSError, pickle.UnpicklingError) as e:
+        raise StreamResumeError(
+            f"stream manifest {path} unreadable: {e!r}") from e
+    if m.get("version") != MANIFEST_VERSION:
+        raise StreamResumeError(
+            f"stream manifest {path} has version {m.get('version')}, "
+            f"this build expects {MANIFEST_VERSION}")
+    return m
+
+
+def apply_resume(graph, m: Dict, delivered_floor: Optional[int] = None) -> Dict:
+    """Rewire a freshly lowered graph to continue from the manifest.
+    Returns a resume report: segments replayed per input channel, restored
+    exec recovery points.  The graph must have been built with the
+    manifest's query_id (checkpoint/spill namespaces must line up).
+
+    ``delivered_floor`` closes the output-commit gap for HARD crashes: a
+    pane can be finalized, checkpointed, and lost with the dying process
+    before the client ever polled it — resuming from the NEWEST checkpoint
+    would then never re-emit it.  A client that durably captured N delta
+    tables passes ``delivered_floor=N``; each exec channel restores from
+    its newest recovery point whose out_seq <= N (ultimately (0,0,0)), so
+    every delta at-or-after the client's capture frontier re-emits
+    (at-least-once, deduped downstream by pane identity).  The extra
+    replay is bounded by how far the client's capture lagged the
+    checkpointer — one poll interval in practice."""
+    if graph.query_id != m["query_id"]:
+        raise StreamResumeError(
+            f"graph namespace {graph.query_id!r} != manifest namespace "
+            f"{m['query_id']!r}")
+    fp = stream_plan_fingerprint(graph)
+    if m.get("plan_fp") is not None and fp != m["plan_fp"]:
+        raise StreamResumeError(
+            "the resubmitted plan's structural fingerprint differs from the "
+            "manifest's — resuming a DIFFERENT query from this checkpoint "
+            f"state would corrupt it (manifest {m['plan_fp']!r}, "
+            f"plan {fp!r})")
+    if graph.hbq is not None:
+        # The dead incarnation's spill is NOT replayable across a restart:
+        # segments it discovered after the last manifest write carry seq
+        # numbers this incarnation will re-assign to DIFFERENTLY-SPLIT
+        # re-discoveries, and the seq-keyed cache/HBQ names would collide
+        # across incarnations — mixed coverage reads as silent row loss
+        # plus a watermark jumped past unconsumed data (rows then drop as
+        # late).  Nothing below the restored frontiers is ever consumed,
+        # and everything at/after them regenerates deterministically from
+        # the manifest's frozen lineages + fresh discovery: wipe the
+        # namespace spill and let this incarnation own its own names.
+        graph.hbq.wipe()
+    store = graph.store
+    missing = [a for a in m["inputs"] if a not in graph.actors] + [
+        a for (a, _ch) in m["execs"] if a not in graph.actors]
+    if missing:
+        raise StreamResumeError(
+            f"manifest actors {sorted(set(missing))} are not in the lowered "
+            "plan — actor ids diverged")
+    if delivered_floor is not None:
+        for e in m["execs"].values():
+            hist = [(0, 0, 0)] + [tuple(h) for h in e["ckpts"]]
+            best = max((h for h in hist if h[1] <= delivered_floor),
+                       key=lambda h: h[0])
+            e["lct"] = (best[0], best[1], 0)
+    # the checkpointed input frontier: the minimum next-seq any restored
+    # exec channel still needs from each (input actor, channel)
+    frontier: Dict = {}
+    for (_a, _ch), e in m["execs"].items():
+        state_seq = e["lct"][0]
+        for src, chans in e["irts"].get(state_seq, {}).items():
+            for sch, nxt in chans.items():
+                key = (src, sch)
+                frontier[key] = min(frontier.get(key, nxt), nxt)
+    report = {"inputs": {}, "execs": {}, "frontier": dict(frontier)}
+    # -- inputs: seed segment log + watermark trail, replay from frontier --
+    for aid, chans in m["inputs"].items():
+        info = graph.actors[aid]
+        all_segments = []
+        for ch, rec in chans.items():
+            store.ntt_remove_channel(aid, ch)
+            start = frontier.get((aid, ch), 0)
+            logged = [s for s, _l in rec["segments"]]
+            last = rec.get("last", max(logged, default=-1))
+            if start <= last and (not logged or start < min(logged)):
+                raise StreamResumeError(
+                    f"resume of input ({aid}, {ch}) needs segments from "
+                    f"{start} but the manifest retains only "
+                    f"{min(logged) if logged else 'none'}..{last} — the "
+                    "delivered_floor rewinds past the retained history "
+                    "(the client's capture lagged too far behind the "
+                    "checkpointer)")
+            with store.transaction():
+                for s, lin in rec["segments"]:
+                    if s >= start:
+                        store.tset("LT", (aid, ch, s), lin)
+                store.tset("LIT", (aid, ch), last)
+                if rec.get("wm") is not None:
+                    store.tset("SWMC", (aid, ch), rec["wm"])
+                for s, wm in rec["swm"].items():
+                    if s >= start:
+                        store.tset("SWM", (aid, ch, s), wm)
+            tape = sorted(s for s, _l in rec["segments"] if s >= start)
+            store.ntt_push(aid, TapedInputTask(aid, ch, tape))
+            all_segments.extend(lin for _s, lin in rec["segments"])
+            report["inputs"][(aid, ch)] = {
+                "replayed_segments": len(tape),
+                "skipped_segments": last + 1 - len(tape),
+            }
+        if hasattr(info.reader, "seed"):
+            info.reader.seed(all_segments)
+    # -- checkpointed exec channels: empty-tape replay restores the snapshot
+    for (a, ch), e in m["execs"].items():
+        store.ntt_remove_channel(a, ch)
+        state_seq, out_seq, _old_tape = e["lct"]
+        reqs = {s: dict(c)
+                for s, c in e["irts"].get(state_seq, {}).items()}
+        with store.transaction():
+            # tape positions from the dead process are meaningless against
+            # the fresh (empty) tape: every recovery point re-bases to 0
+            store.tset("LCT", (a, ch), (state_seq, out_seq, 0))
+            for hist in e["ckpts"]:
+                store.tappend("LT", ("ckpts", a, ch),
+                              (hist[0], hist[1], 0))
+            for s, r in e["irts"].items():
+                store.tset("IRT", (a, ch, s),
+                           {src: dict(c) for src, c in r.items()})
+            # restore the consumption watermarks (EWT = consumed-1): the
+            # producer throttle compares ABSOLUTE seqs against EWT +
+            # max_pipeline, so a fresh store's -1 would deadlock any
+            # source whose checkpointed frontier is past the pipeline cap
+            for src, chans in reqs.items():
+                for sch, nxt in chans.items():
+                    store.tset("EWT", (src, sch, a, ch), nxt - 1)
+        store.ntt_push(a, TapedExecutorTask(
+            a, ch, state_seq, out_seq, state_seq, copy.deepcopy(reqs), 0))
+        report["execs"][(a, ch)] = {"state_seq": state_seq,
+                                    "out_seq": out_seq}
+    # -- unmanifested exec channels (sinks / stateless passthroughs): their
+    # consumption frontier fast-forwards to each resumed producer's out_seq
+    # (everything before it was delivered pre-restart)
+    for info in graph.actors.values():
+        if info.kind != "exec":
+            continue
+        for ch in range(info.channels):
+            if (info.id, ch) in m["execs"]:
+                continue
+            reqs = store.tget("IRT", (info.id, ch, 0))
+            if reqs is None:
+                continue
+            reqs = {a: dict(c) for a, c in reqs.items()}
+            changed = False
+            for src in reqs:
+                for sch in reqs[src]:
+                    prod = m["execs"].get((src, sch))
+                    if prod is not None:
+                        reqs[src][sch] = max(reqs[src][sch],
+                                             prod["lct"][1])
+                        changed = True
+            if not changed:
+                continue
+            store.ntt_remove_channel(info.id, ch)
+            with store.transaction():
+                store.tset("IRT", (info.id, ch, 0), copy.deepcopy(reqs))
+                for src, chans in reqs.items():  # same EWT re-basing
+                    for sch, nxt in chans.items():
+                        store.tset("EWT", (src, sch, info.id, ch), nxt - 1)
+            store.ntt_push(info.id,
+                           ExecutorTask(info.id, ch, 0, 0, reqs))
+    obs.RECORDER.record(
+        "stream.resume", graph.query_id, q=graph.query_id,
+        replayed=sum(r["replayed_segments"]
+                     for r in report["inputs"].values()),
+        execs=len(report["execs"]))
+    return report

@@ -1,0 +1,79 @@
+"""Plan helpers for standing queries.
+
+Streaming plans are ordinary logical plans — a SourceNode over a tailing
+reader feeding StatefulNodes that hold streaming executors — so they lower
+through the normal context machinery and coexist with batch queries in the
+service.  The helpers here pin the v1 streaming shape: ONE source channel
+per unbounded reader (a tail is one monotone sequence) and ONE channel per
+streaming operator (what makes the resume manifest's frontier arithmetic
+exact; parallelism lives inside the batch kernels, as everywhere else in
+this engine).
+"""
+
+from __future__ import annotations
+
+import copy
+import functools
+from typing import Optional, Sequence, Tuple
+
+from quokka_tpu import logical
+from quokka_tpu.streaming.executors import (
+    StreamingAsofJoinExecutor,
+    StreamingWindowAggExecutor,
+)
+from quokka_tpu.target_info import HashPartitioner, PassThroughPartitioner
+
+
+def _single_channel_source(ctx, reader):
+    ds = ctx.read_dataset(reader)
+    ds._node.channels = 1
+    return ds
+
+
+def tail_window_agg(ctx, reader, *, size,
+                    aggs: Sequence[Tuple[str, str, Optional[str]]],
+                    by=None, time_col: Optional[str] = None):
+    """Continuous tumbling-window aggregation over a tailed source.
+
+    ``aggs``: ``[(out_name, fn, col), ...]`` with fn in sum/count/min/max.
+    Output stream schema: ``[window_start, window_end, *by, *out_names]``;
+    panes emit incrementally as the source watermark passes each window end.
+    """
+    time_col = time_col or getattr(reader, "time_col", None)
+    if time_col is None:
+        raise ValueError("time_col is required (reader carries none)")
+    by = [by] if isinstance(by, str) else list(by or [])
+    src = _single_channel_source(ctx, reader)
+    ex = StreamingWindowAggExecutor(time_col, by, size, aggs,
+                                    n_source_channels=1)
+    out_schema = (["window_start", "window_end"] + by
+                  + [n for n, _f, _c in aggs])
+    ds = src.stateful_transform(ex, out_schema, by=by or None)
+    ds._node.channels = 1
+    return ds
+
+
+def tail_asof_join(ctx, left_reader, right_reader, *, on: str, by=None,
+                   suffix: str = "_2"):
+    """Continuous backward asof join of two tailed sources (probe stream 0,
+    reference stream 1), emitting joined probe rows as the combined
+    watermark finalizes them.  Mirrors ``OrderedStream.join_asof`` schema
+    conventions (right payload, clash-suffixed)."""
+    by = [by] if isinstance(by, str) else list(by or [])
+    left = _single_channel_source(ctx, left_reader)
+    right = _single_channel_source(ctx, right_reader)
+    left_cols, right_cols = list(left.schema), list(right.schema)
+    ex = StreamingAsofJoinExecutor(on, by, by, left_cols, right_cols,
+                                   suffix=suffix,
+                                   n_left_channels=1, n_right_channels=1)
+    part = (HashPartitioner(by) if by else PassThroughPartitioner())
+    node = logical.StatefulNode(
+        [left.node_id, right.node_id],
+        list(ex.out_cols),
+        functools.partial(copy.deepcopy, ex),
+        partitioners={0: part,
+                      1: HashPartitioner(by) if by else
+                      PassThroughPartitioner()},
+    )
+    node.channels = 1
+    return left._child(node)

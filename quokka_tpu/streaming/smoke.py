@@ -1,0 +1,400 @@
+"""stream-smoke: chaos-survivable standing queries, end to end.
+
+    python -m quokka_tpu.streaming.smoke [--seed N] [--dir D]
+
+Two standing queries — a continuous tumbling-window aggregate and a
+continuous asof join — run over tailed CSV sources that a writer thread
+keeps appending to, under a seeded ``QK_CHAOS`` kill plan, THROUGH a hard
+process death:
+
+1. ground truth: both queries run one-shot through the batch engine over
+   the complete inputs (integer-valued f64 workloads: sums are order-exact,
+   so "bit-exact" is a real claim);
+2. phase A: a CHILD process hosts a QueryService (stable spill dir),
+   submits both standing queries, and streams every delta it polls to
+   JSONL.  Seeded chaos kills land on the streaming operators mid-stream
+   and recover through the tape-replay protocol.  Once both resume
+   manifests exist and deltas are flowing, the parent SIGKILLs the child —
+   a real crash, not a graceful shutdown;
+3. phase B: the parent resumes BOTH streams from their manifests in a
+   fresh service while the writers are still appending, waits for the
+   watermarks to catch up, stops, and merges phase A + B deltas by pane
+   identity (duplicate deliveries must be byte-identical — that is the
+   exactly-once state claim);
+4. asserts: merged final state BIT-EXACT vs the one-shot batch runs, zero
+   late drops, and the resume replayed only the post-frontier segment tail
+   (bounded by the checkpoint interval), never the whole stream.
+
+Exit nonzero on any violation; prints the seed for replay.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+
+WINDOW = 200
+CKPT_INTERVAL = 4
+N_EVENTS = 9000
+N_TRADES = 5000
+N_QUOTES = 4000
+N_KEYS = 6
+T_MAX = 4000
+
+
+def _datasets(seed: int):
+    r = np.random.default_rng(seed)
+    ev = pd.DataFrame({
+        "t": np.sort(r.integers(0, T_MAX, N_EVENTS)),
+        "k": r.integers(0, N_KEYS, N_EVENTS),
+        "v": r.integers(0, 100, N_EVENTS).astype(np.float64),
+    })
+    tr = pd.DataFrame({
+        "t": np.sort(r.integers(10, T_MAX, N_TRADES)),
+        "k": r.integers(0, N_KEYS, N_TRADES),
+        "tid": np.arange(N_TRADES, dtype=np.int64),
+        "size": r.integers(1, 50, N_TRADES).astype(np.float64),
+    })
+    qt = np.concatenate([np.zeros(N_KEYS, np.int64),
+                         np.sort(r.integers(0, T_MAX, N_QUOTES))])
+    qk = np.concatenate([np.arange(N_KEYS),
+                         r.integers(0, N_KEYS, N_QUOTES)])
+    px = np.concatenate([np.full(N_KEYS, 100.0),
+                         r.integers(100, 200, N_QUOTES).astype(np.float64)])
+    order = np.argsort(qt, kind="stable")
+    qu = pd.DataFrame({"t": qt[order], "k": qk[order], "px": px[order]})
+    return ev, tr, qu
+
+
+def _csv_rows(df: pd.DataFrame):
+    return [",".join(str(x) for x in row) + "\n"
+            for row in df.itertuples(index=False)]
+
+
+_EV_SCHEMA = pa.schema([("t", pa.int64()), ("k", pa.int64()),
+                        ("v", pa.float64())])
+_TR_SCHEMA = pa.schema([("t", pa.int64()), ("k", pa.int64()),
+                        ("tid", pa.int64()), ("size", pa.float64())])
+_QU_SCHEMA = pa.schema([("t", pa.int64()), ("k", pa.int64()),
+                        ("px", pa.float64())])
+
+
+def _build_queries(d: str):
+    """The standing queries — ONE shared definition so the child (phase A)
+    and the resuming parent (phase B) lower byte-identical plans."""
+    from quokka_tpu import QuokkaContext
+    from quokka_tpu.streaming import (
+        TailingCsvReader,
+        tail_asof_join,
+        tail_window_agg,
+    )
+
+    ctx = QuokkaContext()
+    agg = tail_window_agg(
+        ctx, TailingCsvReader(os.path.join(d, "events.csv"), _EV_SCHEMA, "t"),
+        size=WINDOW, by="k",
+        aggs=[("s", "sum", "v"), ("n", "count", None)])
+    ctx2 = QuokkaContext()
+    asof = tail_asof_join(
+        ctx2,
+        TailingCsvReader(os.path.join(d, "trades.csv"), _TR_SCHEMA, "t"),
+        TailingCsvReader(os.path.join(d, "quotes.csv"), _QU_SCHEMA, "t"),
+        on="t", by="k")
+    return agg, asof
+
+
+def _service(d: str):
+    from quokka_tpu.service import QueryService
+
+    return QueryService(
+        pool_size=2, spill_dir=os.path.join(d, "spill"),
+        exec_config={"fault_tolerance": True,
+                     "checkpoint_interval": CKPT_INTERVAL})
+
+
+def _truth(ev: pd.DataFrame, tr: pd.DataFrame, qu: pd.DataFrame):
+    """One-shot batch runs through the ENGINE (not pandas): the smoke's
+    equivalence claim is streaming-vs-batch of this repo, not vs a model."""
+    from quokka_tpu import QuokkaContext
+
+    ctx = QuokkaContext()
+    ev2 = ev.copy()
+    ev2["ws"] = (ev2.t // WINDOW) * WINDOW
+    agg_truth = (
+        ctx.from_arrow(pa.Table.from_pandas(ev2, preserve_index=False))
+        .groupby(["ws", "k"]).agg_sql("sum(v) as s, count(*) as n")
+        .collect().sort_values(["ws", "k"]).reset_index(drop=True))
+    lt = ctx.from_arrow_sorted(pa.Table.from_pandas(tr, preserve_index=False),
+                               "t")
+    rt = ctx.from_arrow_sorted(pa.Table.from_pandas(qu, preserve_index=False),
+                               "t")
+    asof_truth = (lt.join_asof(rt, on="t", by="k").collect()
+                  .sort_values("tid").reset_index(drop=True))
+    return agg_truth, asof_truth
+
+
+# -- child (phase A): killed with SIGKILL mid-stream --------------------------
+
+def run_child(d: str) -> None:
+    agg, asof = _build_queries(d)
+    svc = _service(d)
+    h_agg = svc.submit_continuous(agg)
+    h_asof = svc.submit_continuous(asof)
+    with open(os.path.join(d, "child_manifests"), "w") as f:
+        json.dump({"agg": h_agg.manifest_path,
+                   "asof": h_asof.manifest_path}, f)
+    os.replace(os.path.join(d, "child_manifests"),
+               os.path.join(d, "childready"))
+    fa = open(os.path.join(d, "deltas_agg.jsonl"), "w")
+    fz = open(os.path.join(d, "deltas_asof.jsonl"), "w")
+    while True:  # until SIGKILL
+        for h, f in ((h_agg, fa), (h_asof, fz)):
+            if h.error is not None:
+                raise h.error
+            # ONE JSON line per delta TABLE: complete lines == the durably
+            # captured delta count, which phase B passes as delivered_floor
+            # (a SIGKILL mid-write leaves a torn last line the parent drops)
+            for tb in h.poll_deltas():
+                f.write(json.dumps({"rows": tb.to_pylist()}) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+        time.sleep(0.05)
+
+
+# -- delta merging ------------------------------------------------------------
+
+def _merge(rows, key_of, what: str):
+    merged = {}
+    for row in rows:
+        key = key_of(row)
+        val = tuple(sorted(row.items()))
+        if key in merged and merged[key] != val:
+            raise AssertionError(
+                f"{what}: pane {key} delivered twice with DIFFERENT "
+                f"content:\n  {merged[key]}\n  {val}")
+        merged[key] = val
+    return pd.DataFrame([dict(v) for v in merged.values()])
+
+
+def _exact(got: pd.DataFrame, want: pd.DataFrame, sort_by, what: str) -> None:
+    got = got.sort_values(sort_by).reset_index(drop=True)[want.columns.tolist()]
+    want = want.sort_values(sort_by).reset_index(drop=True)
+    for c in want.columns:
+        got[c] = got[c].astype(np.float64)
+        want[c] = want[c].astype(np.float64)
+    pd.testing.assert_frame_equal(got, want, check_exact=True, obj=what)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--seed", type=int, default=20260804)
+    ap.add_argument("--dir", default=None,
+                    help="stable working dir (default: a fresh tempdir)")
+    ap.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+    if args.child:
+        run_child(args.dir)
+        return 0
+
+    d = args.dir or tempfile.mkdtemp(prefix="stream-smoke-")
+    os.makedirs(d, exist_ok=True)
+    seed = args.seed
+    print(f"[stream-smoke] dir={d} seed={seed}", flush=True)
+    ev, tr, qu = _datasets(seed)
+    t0 = time.time()
+    agg_truth, asof_truth = _truth(ev, tr, qu)
+    print(f"[stream-smoke] one-shot batch baselines in "
+          f"{time.time() - t0:.1f}s ({len(agg_truth)} panes, "
+          f"{len(asof_truth)} joined trades)", flush=True)
+
+    # tailed files start with a prefix; writers append the rest in chunks
+    streams = [("events.csv", _csv_rows(ev), 400, 280),
+               ("trades.csv", _csv_rows(tr), 250, 170),
+               ("quotes.csv", _csv_rows(qu), 250, 140)]
+    for name, rows, prefix, _chunk in streams:
+        with open(os.path.join(d, name), "w") as f:
+            f.writelines(rows[:prefix])
+
+    go = threading.Event()
+
+    def writer(name, rows, prefix, chunk):
+        go.wait()
+        i = prefix
+        while i < len(rows):
+            j = min(i + chunk, len(rows))
+            with open(os.path.join(d, name), "a") as f:
+                f.writelines(rows[i:j])
+            i = j
+            time.sleep(0.12)
+
+    threads = [threading.Thread(target=writer, args=s, daemon=True)
+               for s in streams]
+    for th in threads:
+        th.start()
+
+    # -- phase A: child service under seeded chaos, SIGKILLed mid-stream ----
+    env = dict(os.environ)
+    env["QK_CHAOS"] = f"seed={seed},kill=3,kill_after=8"
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    child = subprocess.Popen(
+        [sys.executable, "-m", "quokka_tpu.streaming.smoke",
+         "--child", "--dir", d],
+        env=env, cwd=os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))))
+    ready = os.path.join(d, "childready")
+    deadline = time.time() + 120
+    while not os.path.exists(ready):
+        if child.poll() is not None:
+            print("[stream-smoke] FAIL: child died before submitting "
+                  f"(rc={child.returncode})", flush=True)
+            return 1
+        if time.time() > deadline:
+            child.kill()
+            print("[stream-smoke] FAIL: child never became ready", flush=True)
+            return 1
+        time.sleep(0.2)
+    manifests = json.load(open(ready))
+    go.set()  # start the writers only once the standing queries are live
+
+    def _tables(name):
+        """Durably captured delta tables (torn trailing line dropped)."""
+        out = []
+        try:
+            with open(os.path.join(d, name)) as f:
+                for ln in f:
+                    try:
+                        out.append(json.loads(ln)["rows"])
+                    except (json.JSONDecodeError, KeyError):
+                        break  # SIGKILL tore this line; nothing follows
+        except OSError:
+            return out  # child hasn't created the file yet: zero captured
+        return out
+
+    # kill once both manifests exist and deltas are flowing — mid-stream,
+    # with the writers still appending
+    while True:
+        if child.poll() is not None:
+            print(f"[stream-smoke] FAIL: child exited early "
+                  f"(rc={child.returncode})", flush=True)
+            return 1
+        if time.time() > deadline:
+            child.kill()
+            print("[stream-smoke] FAIL: no checkpointed progress before "
+                  "deadline", flush=True)
+            return 1
+        if (os.path.exists(manifests["agg"])
+                and os.path.exists(manifests["asof"])
+                and len(_tables("deltas_agg.jsonl")) >= 3
+                and len(_tables("deltas_asof.jsonl")) >= 3):
+            break
+        time.sleep(0.2)
+    os.kill(child.pid, signal.SIGKILL)
+    child.wait()
+    a_agg, a_asof = _tables("deltas_agg.jsonl"), _tables("deltas_asof.jsonl")
+    print(f"[stream-smoke] child SIGKILLed mid-stream: {len(a_agg)} agg + "
+          f"{len(a_asof)} asof delta tables captured before the crash",
+          flush=True)
+
+    # -- phase B: resume from the manifests in a fresh service.  The
+    # delivered_floor (tables the JSONL durably captured) pins each resume
+    # point at-or-before the capture frontier: a pane checkpointed in the
+    # instant between the child's last flush and the SIGKILL re-emits
+    # instead of vanishing (the output-commit gap).
+    agg, asof = _build_queries(d)
+    svc = _service(d)
+    h_agg = svc.submit_continuous(agg, resume_from=manifests["agg"],
+                                  delivered_floor=len(a_agg))
+    h_asof = svc.submit_continuous(asof, resume_from=manifests["asof"],
+                                   delivered_floor=len(a_asof))
+    for h, what in ((h_agg, "agg"), (h_asof, "asof")):
+        rep = sum(r["replayed_segments"]
+                  for r in h.resume_info["inputs"].values())
+        skip = sum(r["skipped_segments"]
+                   for r in h.resume_info["inputs"].values())
+        print(f"[stream-smoke] resume[{what}]: replayed {rep} segments, "
+              f"skipped {skip}, restored "
+              f"{ {k: v['state_seq'] for k, v in h.resume_info['execs'].items()} }",
+              flush=True)
+        if skip == 0:
+            print(f"[stream-smoke] FAIL: {what} resume replayed from offset "
+                  "zero (full-stream recomputation)", flush=True)
+            return 1
+        # bounded replay: the un-checkpointed tail is at most the checkpoint
+        # interval's worth of batch-sets per exec channel (+1 in-flight),
+        # plus the delivered_floor's capture lag (a few poll intervals)
+        bound = (CKPT_INTERVAL + 1) * max(
+            1, len(h.resume_info["execs"])) * 2 + 8
+        if rep > bound:
+            print(f"[stream-smoke] FAIL: {what} replayed {rep} segments "
+                  f"( > bound {bound}) — checkpoint frontier not honored",
+                  flush=True)
+            return 1
+    for th in threads:
+        th.join()
+    final_wm = {"agg": float(ev.t.max()), "asof": float(min(tr.t.max(),
+                                                            qu.t.max()))}
+    b_agg, b_asof = [], []
+    deadline = time.time() + 180
+    while time.time() < deadline:
+        b_agg.extend(t.to_pylist() for t in h_agg.poll_deltas())
+        b_asof.extend(t.to_pylist() for t in h_asof.poll_deltas())
+        wa, wz = h_agg.watermark(), h_asof.watermark()
+        if (wa is not None and wa >= final_wm["agg"]
+                and wz is not None and wz >= final_wm["asof"]):
+            break
+        time.sleep(0.2)
+    else:
+        print("[stream-smoke] FAIL: watermarks never caught up "
+              f"(agg={h_agg.watermark()}, asof={h_asof.watermark()})",
+              flush=True)
+        return 1
+    h_agg.stop(timeout=180)
+    h_asof.stop(timeout=180)
+    b_agg.extend(t.to_pylist() for t in h_agg.poll_deltas())
+    b_asof.extend(t.to_pylist() for t in h_asof.poll_deltas())
+    svc.shutdown()
+
+    # -- merge phase A + B by pane identity and compare bit-exactly ---------
+    agg_rows = [r for tb in a_agg + b_agg for r in tb]
+    asof_rows = [r for tb in a_asof + b_asof for r in tb]
+    try:
+        got_agg = _merge(agg_rows,
+                         lambda r: (r["window_start"], r["k"]), "window-agg")
+        got_asof = _merge(asof_rows, lambda r: r["tid"], "asof")
+        want_agg = agg_truth.rename(columns={"ws": "window_start"})
+        got_agg = got_agg.drop(columns=["window_end"])
+        _exact(got_agg, want_agg, ["window_start", "k"],
+               "continuous window-agg vs one-shot batch")
+        _exact(got_asof, asof_truth, ["tid"],
+               "continuous asof vs one-shot batch")
+    except AssertionError as e:
+        print(f"[stream-smoke] FAIL: {e}", flush=True)
+        print(f"[stream-smoke] replay: python -m quokka_tpu.streaming.smoke "
+              f"--seed {seed}", flush=True)
+        return 1
+    from quokka_tpu import obs
+
+    late = obs.REGISTRY.snapshot().get("stream.late_dropped", 0)
+    if late:
+        print(f"[stream-smoke] FAIL: {late} rows dropped as late on an "
+              "in-order source", flush=True)
+        return 1
+    print(f"[stream-smoke] OK: {len(got_agg)} panes + {len(got_asof)} "
+          "joined trades bit-exact vs one-shot batch, through seeded kills "
+          "+ SIGKILL + manifest resume, 0 late drops", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,275 @@
+"""Unbounded sources: tailing readers over growing inputs.
+
+Same reader protocol as ``dataset/readers.py`` (``get_own_state`` /
+``execute``) plus the streaming extensions the engine drives:
+
+- ``UNBOUNDED = True`` marks the reader as a standing source: when the input
+  task's tape runs dry the engine calls ``poll(channel)`` for newly appended
+  data instead of marking the channel done.
+- ``poll(channel)`` returns NEW lineage entries (monotone: each covers bytes
+  / files strictly after everything previously discovered).  A lineage, once
+  discovered, is FROZEN — ``execute`` re-reads exactly those bytes, so fault-
+  tolerant replay and the scan path see byte-identical tables.
+- ``lineage_time_max(lineage)`` answers the segment's max event time (parsed
+  once at discovery), which the engine turns into the channel watermark
+  ``max_seen - watermark_delay`` without any device sync on the push path.
+- ``seed(segments)`` (resume): re-adopts a manifest's segment log so
+  discovery continues from the recorded offset with the recorded
+  segmentation — a restarted replica never re-splits (and never re-reads)
+  bytes an executor checkpoint already covers.
+
+Truncation (the tailed file shrinking, or a recorded segment's bytes
+changing length) is detected LOUDLY via ``StreamTruncatedError`` — a tailing
+source that silently re-reads different bytes would poison exactly-once
+recovery.
+"""
+
+from __future__ import annotations
+
+import glob as globmod
+import os
+from typing import Dict, List, Optional, Sequence
+
+import pyarrow as pa
+import pyarrow.csv as pacsv
+import pyarrow.parquet as pq
+
+
+class StreamTruncatedError(RuntimeError):
+    """The tailed input lost bytes it already emitted (file truncated or a
+    segment rewritten) — the stream's lineage contract is broken and no
+    silent recovery is possible."""
+
+
+class TailingCsvReader:
+    """Tail a growing headerless CSV file.
+
+    ``schema``: a ``pa.Schema`` naming + typing the columns (no header row in
+    the tailed file — appends are raw data rows).  ``time_col`` names the
+    event-time column; ``watermark_delay`` is the allowed disorder in the
+    time column's own units (events may arrive up to ``delay`` behind the
+    max time seen; anything later is dropped-and-counted by the executors).
+
+    Segments split at newline boundaries; a partial trailing line (an append
+    racing the poll) is left unread until its newline lands, so a segment's
+    bytes never change after discovery.  Lineage: ``("tail", offset, length,
+    t_max)``.
+    """
+
+    UNBOUNDED = True
+
+    def __init__(self, path: str, schema: pa.Schema, time_col: str,
+                 watermark_delay: float = 0.0,
+                 min_segment_bytes: int = 1):
+        if time_col not in schema.names:
+            raise ValueError(f"time_col {time_col!r} not in schema "
+                             f"{schema.names}")
+        self.path = path
+        self.schema = schema
+        self.time_col = time_col
+        self.watermark_delay = float(watermark_delay)
+        self.min_segment_bytes = int(min_segment_bytes)
+        self._next_offset = 0
+
+    # -- reader protocol -----------------------------------------------------
+    def get_own_state(self, num_channels: int) -> Dict[int, List]:
+        """All segments go to channel 0: a tailed stream is one monotone
+        sequence (the streaming plan helpers pin source channels to 1)."""
+        out: Dict[int, List] = {ch: [] for ch in range(num_channels)}
+        out[0] = self.poll(0) or []
+        return out
+
+    def poll(self, channel: int) -> List:
+        """Discover bytes appended since the last poll; returns new lineage
+        entries (or []).  Only channel 0 produces."""
+        if channel != 0:
+            return []
+        try:
+            size = os.path.getsize(self.path)
+        except OSError:
+            return []  # not created yet: an empty stream so far
+        if size < self._next_offset:
+            raise StreamTruncatedError(
+                f"tailed file {self.path} shrank to {size} bytes below the "
+                f"already-emitted offset {self._next_offset} — segment "
+                "lineage is no longer replayable")
+        if size - self._next_offset < self.min_segment_bytes:
+            return []
+        with open(self.path, "rb") as f:
+            f.seek(self._next_offset)
+            chunk = f.read(size - self._next_offset)
+        # never consume a partial trailing line: the writer may still be
+        # mid-append; the segment freezes only at a newline boundary
+        end = chunk.rfind(b"\n")
+        if end < 0:
+            return []
+        chunk = chunk[: end + 1]
+        t_max = self._chunk_time_max(chunk)
+        lineage = ("tail", self._next_offset, len(chunk), t_max)
+        self._next_offset += len(chunk)
+        return [lineage]
+
+    def execute(self, channel: int, lineage) -> pa.Table:
+        _, offset, length, _t_max = lineage
+        try:
+            with open(self.path, "rb") as f:
+                f.seek(offset)
+                data = f.read(length)
+        except OSError as e:
+            raise StreamTruncatedError(
+                f"tailed file {self.path} unreadable for segment at "
+                f"{offset}+{length}: {e}") from e
+        if len(data) != length:
+            raise StreamTruncatedError(
+                f"tailed file {self.path} segment at {offset} expected "
+                f"{length} bytes, got {len(data)} — file was truncated "
+                "under a live stream")
+        return self._parse(data)
+
+    def lineage_time_max(self, lineage) -> float:
+        return float(lineage[3])
+
+    def size_hint(self) -> int:
+        try:
+            return os.path.getsize(self.path)
+        except OSError:
+            return 0
+
+    # -- resume ---------------------------------------------------------------
+    def seed(self, segments: Sequence) -> None:
+        """Adopt a manifest's segment log: discovery continues from the end
+        of the recorded segmentation."""
+        nxt = 0
+        for lin in segments:
+            if lin[0] != "tail":
+                raise ValueError(f"foreign lineage {lin!r} for a CSV tail")
+            nxt = max(nxt, int(lin[1]) + int(lin[2]))
+        self._next_offset = nxt
+
+    # -- internals -------------------------------------------------------------
+    def _parse(self, data: bytes) -> pa.Table:
+        import io
+
+        return pacsv.read_csv(
+            io.BytesIO(data),
+            read_options=pacsv.ReadOptions(column_names=self.schema.names),
+            convert_options=pacsv.ConvertOptions(
+                column_types={f.name: f.type for f in self.schema}),
+        )
+
+    def _chunk_time_max(self, chunk: bytes) -> float:
+        # one extra parse per segment at discovery time (host-side, off the
+        # push path) buys a sync-free watermark: the engine reads t_max from
+        # the lineage instead of reducing the device column
+        t = self._parse(chunk)
+        col = t.column(self.time_col)
+        import pyarrow.compute as pc
+
+        v = pc.max(col).as_py()
+        return float(v) if v is not None else float("-inf")
+
+
+class TailingParquetDirReader:
+    """Tail a directory of appended Parquet segment files.
+
+    The writer contract is atomic appends: each segment file appears fully
+    written (write-to-temp + rename).  New files are discovered in sorted
+    filename order — the append order must be filename-monotone (e.g.
+    zero-padded sequence numbers).  Lineage: ``("pqseg", filename, t_max)``
+    with ``t_max`` taken from row-group statistics (or a column scan when
+    stats are absent).
+    """
+
+    UNBOUNDED = True
+
+    def __init__(self, path: str, time_col: str,
+                 watermark_delay: float = 0.0, pattern: str = "*.parquet"):
+        self.path = path
+        self.time_col = time_col
+        self.watermark_delay = float(watermark_delay)
+        self.pattern = pattern
+        self._seen: set = set()
+
+    @property
+    def schema(self) -> pa.Schema:
+        files = self._list()
+        if not files:
+            raise ValueError(
+                f"cannot derive a schema from empty segment dir {self.path}; "
+                "write at least one segment first")
+        return pq.ParquetFile(os.path.join(self.path, files[0])).schema_arrow
+
+    def get_own_state(self, num_channels: int) -> Dict[int, List]:
+        out: Dict[int, List] = {ch: [] for ch in range(num_channels)}
+        out[0] = self.poll(0) or []
+        return out
+
+    def poll(self, channel: int) -> List:
+        if channel != 0:
+            return []
+        new = []
+        for f in self._list():
+            if f in self._seen:
+                continue
+            self._seen.add(f)
+            new.append(("pqseg", f, self._file_time_max(f)))
+        return new
+
+    def execute(self, channel: int, lineage) -> pa.Table:
+        _, fname, _t_max = lineage
+        full = os.path.join(self.path, fname)
+        try:
+            return pq.read_table(full)
+        except (OSError, pa.ArrowInvalid) as e:
+            raise StreamTruncatedError(
+                f"parquet segment {full} vanished or became unreadable "
+                f"under a live stream: {e}") from e
+
+    def lineage_time_max(self, lineage) -> float:
+        return float(lineage[2])
+
+    def size_hint(self) -> int:
+        total = 0
+        for f in self._list():
+            try:
+                total += os.path.getsize(os.path.join(self.path, f))
+            except OSError:
+                continue  # segment raced a writer rename: skip the estimate
+        return total
+
+    def seed(self, segments: Sequence) -> None:
+        names = {lin[1] for lin in segments}
+        self._seen = set(names)
+        if names:
+            # the manifest's segment log may be trimmed to the retained
+            # checkpoint tail: discovery is filename-monotone, so anything
+            # sorting at/below the newest logged segment was consumed by
+            # the previous incarnation and must not re-discover
+            hi = max(names)
+            self._seen.update(f for f in self._list() if f <= hi)
+
+    def _list(self) -> List[str]:
+        try:
+            return sorted(
+                os.path.basename(p)
+                for p in globmod.glob(os.path.join(self.path, self.pattern)))
+        except OSError:
+            return []
+
+    def _file_time_max(self, fname: str) -> float:
+        pf = pq.ParquetFile(os.path.join(self.path, fname))
+        idx = pf.schema_arrow.names.index(self.time_col)
+        best: Optional[float] = None
+        for rg in range(pf.metadata.num_row_groups):
+            st = pf.metadata.row_group(rg).column(idx).statistics
+            if st is None or not st.has_min_max:
+                best = None
+                break
+            v = float(st.max)
+            best = v if best is None else max(best, v)
+        if best is None:  # no stats: scan the one column
+            import pyarrow.compute as pc
+
+            v = pc.max(pf.read([self.time_col]).column(0)).as_py()
+            best = float(v) if v is not None else float("-inf")
+        return best

@@ -1,0 +1,62 @@
+"""Event-time watermarks for standing queries.
+
+A watermark is a claim: *no future batch on this channel carries an event
+with time < wm*.  Sources derive it as ``max_event_time_seen -
+watermark_delay`` (tailing readers record each segment's max event time in
+its lineage at discovery); the engine stamps it onto every pushed batch and
+persists it per output seq in the control store (``SWM``), so fault-tolerant
+tape replay re-presents the exact watermark sequence and replayed emission
+decisions stay deterministic.
+
+Executors combine per-channel watermarks with :class:`WatermarkClock` — the
+min across every feeding channel of every live input stream (Flink's
+low-watermark rule).  A finalized pane is one whose window end ``<=`` the
+clock; events that arrive for an already-finalized pane are late and are
+dropped-and-counted (``stream.late_dropped``).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Tuple
+
+
+class WatermarkClock:
+    """Min-combine of per-(stream, channel) watermark high-water marks.
+
+    ``channels_per_stream`` declares every feeding channel up front, so the
+    clock stays at ``-inf`` until EVERY channel has reported (a pane must
+    never finalize because a slow channel hasn't spoken yet).  A stream
+    marked done contributes ``+inf`` (its channels are complete).  Picklable:
+    snapshots ride executor checkpoints.
+    """
+
+    def __init__(self, channels_per_stream: Dict[int, int]):
+        self._wm: Dict[Tuple[int, int], float] = {
+            (s, ch): -math.inf
+            for s, n in channels_per_stream.items() for ch in range(n)
+        }
+        self._done: set = set()
+
+    def observe(self, stream: int, channel: int, wm: float) -> None:
+        """Record a channel watermark; watermarks only move forward."""
+        key = (stream, channel)
+        cur = self._wm.get(key, -math.inf)
+        if wm > cur:
+            self._wm[key] = float(wm)
+
+    def stream_done(self, stream: int) -> None:
+        """An exhausted stream stops gating the clock (contributes +inf)."""
+        self._done.add(stream)
+
+    def current(self) -> float:
+        live = [wm for (s, _ch), wm in self._wm.items() if s not in self._done]
+        return min(live) if live else math.inf
+
+    # -- checkpoint ----------------------------------------------------------
+    def snapshot(self) -> Dict:
+        return {"wm": dict(self._wm), "done": sorted(self._done)}
+
+    def restore(self, snap: Dict) -> None:
+        self._wm = dict(snap["wm"])
+        self._done = set(snap["done"])

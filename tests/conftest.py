@@ -11,6 +11,13 @@ os.environ.setdefault(
     "QUOKKA_JAX_CACHE_DIR", os.path.expanduser("~/.cache/quokka_tpu_test_jax")
 )
 os.environ.setdefault("QUOKKA_JAX_CACHE_MIN_SECS", "0")
+# Bound the distributed coordinator's run timeout for the whole suite: the
+# default 600s means one wedged kill-recovery race (a known, pre-existing
+# flake in the adopter's lost-object wait — see ROADMAP) eats the entire
+# tier-1 budget before failing.  120s is ~5x the slowest healthy
+# distributed test on a loaded 1-core box; a genuine wedge now fails THAT
+# test loudly (with its stall dump) instead of timing out the suite.
+os.environ.setdefault("QK_COORD_TIMEOUT", "120")
 # Kernel-strategy calibration must never leak into tests: a developer box
 # whose bench calibrated (ops/strategy.py) would otherwise flip which
 # kernels tests exercise.  "" disables profile load/persist; tests that

@@ -161,7 +161,11 @@ class TestNullStrings:
         ctx = QuokkaContext()
         df = t.to_pandas()
         got = ctx.from_arrow(t).filter_sql("s not like 'a%'").collect()
-        exp = df[df.s.notna() & ~df.s.str.startswith("a")]
+        # `.str.startswith` keeps None for null rows (object dtype), and
+        # newer pandas refuses `~` over object blocks containing None —
+        # fill the nulls (excluded by notna() anyway) before inverting
+        startswith_a = df.s.str.startswith("a").fillna(False).astype(bool)
+        exp = df[df.s.notna() & ~startswith_a]
         assert len(got) == len(exp)
 
 

@@ -1,0 +1,504 @@
+"""Streaming plane: watermark semantics, tailing sources, standing queries.
+
+Covers the contracts the smoke relies on, at unit granularity:
+- WatermarkClock min-combine / stream-done / snapshot round trip;
+- pane semantics under late, duplicate-delivery and out-of-order batches,
+  and pane finalization ordering (each pane exactly once, window order);
+- tailing reader: append-while-reading (partial trailing line untouched),
+  truncation detected LOUDLY, frozen-lineage re-reads byte-identical;
+- end-to-end standing queries through QueryService.submit_continuous:
+  incremental deltas, stop()-drain bit-exact vs pandas, kill-mid-stream
+  recovery, manifest resume across a service teardown.
+"""
+
+import math
+import os
+import threading
+import time
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from quokka_tpu.ops import bridge
+from quokka_tpu.streaming import (
+    StreamTruncatedError,
+    StreamingWindowAggExecutor,
+    TailingCsvReader,
+    WatermarkClock,
+    tail_window_agg,
+)
+
+EV_SCHEMA = pa.schema([("t", pa.int64()), ("k", pa.int64()),
+                       ("v", pa.float64())])
+
+
+def _batch(rows, wm=None, ch=0):
+    t = pa.table({"t": pa.array([r[0] for r in rows], pa.int64()),
+                  "k": pa.array([r[1] for r in rows], pa.int64()),
+                  "v": pa.array([float(r[2]) for r in rows], pa.float64())})
+    b = bridge.arrow_to_device(t)
+    if wm is not None:
+        b._stream_wm = float(wm)
+        b._stream_ch = ch
+    return b
+
+
+def _win_exec(size=10):
+    ex = StreamingWindowAggExecutor(
+        "t", ["k"], size, [("s", "sum", "v"), ("n", "count", None)])
+    ex.bind_query(None)
+    return ex
+
+
+def _panes(out):
+    if out is None:
+        return []
+    df = bridge.to_pandas(out)
+    return [tuple(r) for r in
+            df[["window_start", "k", "s", "n"]].itertuples(index=False)]
+
+
+class TestWatermarkClock:
+    def test_min_across_channels_and_streams(self):
+        c = WatermarkClock({0: 2, 1: 1})
+        assert c.current() == -math.inf
+        c.observe(0, 0, 10.0)
+        assert c.current() == -math.inf  # two channels still silent
+        c.observe(0, 1, 7.0)
+        c.observe(1, 0, 5.0)
+        assert c.current() == 5.0
+        c.observe(1, 0, 20.0)
+        assert c.current() == 7.0
+
+    def test_watermarks_never_regress(self):
+        c = WatermarkClock({0: 1})
+        c.observe(0, 0, 10.0)
+        c.observe(0, 0, 3.0)  # a replayed/duplicate lower mark is a no-op
+        assert c.current() == 10.0
+
+    def test_stream_done_contributes_inf(self):
+        c = WatermarkClock({0: 1, 1: 1})
+        c.observe(0, 0, 4.0)
+        c.stream_done(1)  # never spoke: complete anyway
+        assert c.current() == 4.0
+        c.stream_done(0)
+        assert c.current() == math.inf
+
+    def test_snapshot_roundtrip(self):
+        c = WatermarkClock({0: 2})
+        c.observe(0, 0, 9.0)
+        c.stream_done(0)
+        c2 = WatermarkClock({0: 2})
+        c2.restore(c.snapshot())
+        assert c2.current() == c.current() == math.inf
+
+
+class TestWindowPaneSemantics:
+    def test_incremental_finalization_in_window_order(self):
+        ex = _win_exec(10)
+        # batch 1: windows 0 and 1 open, wm 9 -> nothing closes (end 10 > 9)
+        assert ex.execute([_batch([(1, 0, 2), (12, 0, 3)], wm=9)], 0, 0) is None
+        # wm 20 closes window 0 AND window 1 ([10,20) end == 20 <= 20)
+        got = _panes(ex.execute([_batch([(25, 0, 1)], wm=20)], 0, 0))
+        assert got == [(0, 0, 2.0, 1), (10, 0, 3.0, 1)]
+        # done(): flush the remaining open pane
+        assert _panes(ex.done(0)) == [(20, 0, 1.0, 1)]
+        assert ex.panes == {}
+
+    def test_out_of_order_within_delay_is_not_late(self):
+        ex = _win_exec(10)
+        ex.execute([_batch([(15, 0, 5)], wm=8)], 0, 0)  # wm lags max t
+        out = ex.execute([_batch([(9, 0, 7)], wm=9)], 0, 0)  # behind 15, fine
+        assert out is None
+        got = _panes(ex.execute([_batch([(40, 0, 1)], wm=30)], 0, 0))
+        assert (0, 0, 7.0, 1) in got and (10, 0, 5.0, 1) in got
+        assert ex.late_rows == 0
+
+    def test_late_rows_dropped_and_counted(self):
+        from quokka_tpu import obs
+
+        before = obs.REGISTRY.counter("stream.late_dropped").value
+        ex = _win_exec(10)
+        ex.execute([_batch([(5, 0, 1)], wm=25)], 0, 0)  # closes w0, w1
+        out = ex.execute([_batch([(3, 0, 99), (26, 0, 4)], wm=25)], 0, 0)
+        assert ex.late_rows == 1  # t=3 belongs to the closed window 0
+        assert obs.REGISTRY.counter("stream.late_dropped").value == before + 1
+        assert out is None
+        assert _panes(ex.done(0)) == [(20, 0, 4.0, 1)]
+
+    def test_duplicate_batch_replay_is_deterministic(self):
+        """Identical (state, batch sequence) -> identical emissions: the
+        tape-replay determinism the engine asserts during recovery."""
+        batches = [
+            [_batch([(1, 0, 2), (4, 1, 3)], wm=4)],
+            [_batch([(11, 0, 1)], wm=11)],
+            [_batch([(25, 1, 6)], wm=22)],
+        ]
+        def run():
+            ex = _win_exec(10)
+            outs = [_panes(ex.execute(bs, 0, 0)) for bs in batches]
+            outs.append(_panes(ex.done(0)))
+            return outs
+        assert run() == run()
+
+    def test_two_aggs_over_one_column(self):
+        # min+max over the same column: the per-batch selection must not
+        # produce duplicate labels (a Series-valued partial poisons
+        # finalization)
+        ex = StreamingWindowAggExecutor(
+            "t", ["k"], 10, [("lo", "min", "v"), ("hi", "max", "v"),
+                             ("n", "count", None)])
+        ex.bind_query(None)
+        outs = [ex.execute([_batch([(1, 0, 5), (3, 0, 2), (14, 0, 9)],
+                                   wm=14)], 0, 0), ex.done(0)]
+        got = pd.concat([bridge.to_pandas(o) for o in outs if o is not None],
+                        ignore_index=True)
+        assert got[["lo", "hi", "n"]].values.tolist() == [[2.0, 5.0, 2],
+                                                          [9.0, 9.0, 1]]
+
+    def test_checkpoint_restore_continues_exactly(self):
+        ex = _win_exec(10)
+        ex.execute([_batch([(1, 0, 2), (12, 1, 3)], wm=11)], 0, 0)
+        snap = ex.checkpoint()
+        rest = StreamingWindowAggExecutor(
+            "t", ["k"], 10, [("s", "sum", "v"), ("n", "count", None)])
+        rest.bind_query(None)
+        rest.restore(snap)
+        a = _panes(ex.execute([_batch([(30, 0, 1)], wm=25)], 0, 0)) \
+            + _panes(ex.done(0))
+        b = _panes(rest.execute([_batch([(30, 0, 1)], wm=25)], 0, 0)) \
+            + _panes(rest.done(0))
+        assert a == b
+
+
+class TestTailingCsvReader:
+    def _write(self, path, text, mode="w"):
+        with open(path, mode) as f:
+            f.write(text)
+
+    def test_append_while_reading(self, tmp_path):
+        p = str(tmp_path / "e.csv")
+        self._write(p, "1,0,2.0\n5,1,3.0\n")
+        r = TailingCsvReader(p, EV_SCHEMA, "t")
+        segs = r.poll(0)
+        assert len(segs) == 1 and r.lineage_time_max(segs[0]) == 5.0
+        assert r.poll(0) == []  # nothing new
+        self._write(p, "9,0,4.0\n", mode="a")
+        seg2 = r.poll(0)
+        assert len(seg2) == 1
+        t = r.execute(0, seg2[0])
+        assert t.column("t").to_pylist() == [9]
+
+    def test_partial_trailing_line_left_unread(self, tmp_path):
+        p = str(tmp_path / "e.csv")
+        self._write(p, "1,0,2.0\n5,1,")  # append race: no trailing newline
+        r = TailingCsvReader(p, EV_SCHEMA, "t")
+        segs = r.poll(0)
+        assert len(segs) == 1
+        assert r.execute(0, segs[0]).num_rows == 1  # only the complete row
+        self._write(p, "3.0\n", mode="a")  # the line completes
+        seg2 = r.poll(0)
+        assert len(seg2) == 1
+        assert r.execute(0, seg2[0]).column("t").to_pylist() == [5]
+
+    def test_frozen_lineage_rereads_identically(self, tmp_path):
+        p = str(tmp_path / "e.csv")
+        self._write(p, "1,0,2.0\n5,1,3.0\n")
+        r = TailingCsvReader(p, EV_SCHEMA, "t")
+        seg = r.poll(0)[0]
+        first = r.execute(0, seg)
+        self._write(p, "9,9,9.0\n", mode="a")  # appends must not change it
+        assert r.execute(0, seg).equals(first)
+
+    def test_truncation_detected_loudly(self, tmp_path):
+        p = str(tmp_path / "e.csv")
+        self._write(p, "1,0,2.0\n5,1,3.0\n")
+        r = TailingCsvReader(p, EV_SCHEMA, "t")
+        seg = r.poll(0)[0]
+        self._write(p, "1,0,2.0\n")  # file shrinks below emitted offset
+        with pytest.raises(StreamTruncatedError):
+            r.poll(0)
+        with pytest.raises(StreamTruncatedError):
+            r.execute(0, seg)
+
+    def test_seed_resumes_discovery_past_log(self, tmp_path):
+        p = str(tmp_path / "e.csv")
+        self._write(p, "1,0,2.0\n5,1,3.0\n")
+        r = TailingCsvReader(p, EV_SCHEMA, "t")
+        log = r.poll(0)
+        self._write(p, "9,0,4.0\n", mode="a")
+        r2 = TailingCsvReader(p, EV_SCHEMA, "t")
+        r2.seed(log)  # adopts the manifest's segmentation
+        segs = r2.poll(0)
+        assert len(segs) == 1
+        assert r2.execute(0, segs[0]).column("t").to_pylist() == [9]
+
+
+def _truth(df, size=100):
+    d = df.copy()
+    d["window_start"] = (d.t // size) * size
+    out = (d.groupby(["window_start", "k"])
+           .agg(s=("v", "sum"), n=("v", "count")).reset_index())
+    return out.sort_values(["window_start", "k"]).reset_index(drop=True)
+
+
+def _merge_deltas(tables):
+    merged = {}
+    for tb in tables:
+        for r in tb.to_pylist():
+            key = (r["window_start"], r["k"])
+            val = (r["s"], r["n"])
+            assert merged.get(key, val) == val, \
+                f"pane {key} re-delivered with different content"
+            merged[key] = val
+    return pd.DataFrame(
+        [(ws, k, s, n) for (ws, k), (s, n) in merged.items()],
+        columns=["window_start", "k", "s", "n"],
+    ).sort_values(["window_start", "k"]).reset_index(drop=True)
+
+
+def _assert_exact(got, want):
+    for c in want.columns:
+        got[c] = got[c].astype(np.float64)
+        want[c] = want[c].astype(np.float64)
+    pd.testing.assert_frame_equal(got[want.columns.tolist()], want,
+                                  check_exact=True)
+
+
+class TestStandingQueryService:
+    def _run(self, tmp_path, n=3000, inject=None, chaos=None):
+        from quokka_tpu import QuokkaContext
+        from quokka_tpu.chaos import publish_env
+        from quokka_tpu.service import QueryService
+
+        rng = np.random.default_rng(13)
+        df = pd.DataFrame({
+            "t": np.sort(rng.integers(0, 1000, n)),
+            "k": rng.integers(0, 4, n),
+            "v": rng.integers(0, 50, n).astype(np.float64),
+        })
+        rows = [f"{r.t},{r.k},{r.v}\n" for r in df.itertuples(index=False)]
+        p = str(tmp_path / "events.csv")
+        with open(p, "w") as f:
+            f.writelines(rows[:400])
+
+        def appender():
+            i = 400
+            while i < n:
+                j = min(i + 300, n)
+                with open(p, "a") as f:
+                    f.writelines(rows[i:j])
+                i = j
+                time.sleep(0.04)
+
+        th = threading.Thread(target=appender, daemon=True)
+        ecfg = {"fault_tolerance": True, "checkpoint_interval": 3}
+        if inject:
+            ecfg["inject_failure"] = inject
+        if chaos:
+            publish_env(chaos)
+        try:
+            svc = QueryService(pool_size=2, spill_dir=str(tmp_path / "spill"),
+                               exec_config=ecfg)
+            ctx = QuokkaContext()
+            ds = tail_window_agg(
+                ctx, TailingCsvReader(p, EV_SCHEMA, "t"), size=100, by="k",
+                aggs=[("s", "sum", "v"), ("n", "count", None)])
+            h = svc.submit_continuous(ds)
+            th.start()
+            deltas, polls_with_data = [], 0
+            th.join()
+            t0 = time.time()
+            while time.time() - t0 < 30:
+                got = h.poll_deltas()
+                if got:
+                    polls_with_data += 1
+                    deltas.extend(got)
+                wm = h.watermark()
+                if wm is not None and wm >= float(df.t.max()):
+                    break
+                time.sleep(0.05)
+            h.stop(timeout=60)
+            deltas.extend(h.poll_deltas())
+            _assert_exact(_merge_deltas(deltas), _truth(df))
+            assert polls_with_data >= 1, \
+                "no incremental delivery before end-of-stream"
+            svc.shutdown()
+            return h
+        finally:
+            if chaos:
+                publish_env(None)
+
+    def test_continuous_agg_bit_exact_and_incremental(self, tmp_path):
+        self._run(tmp_path)
+
+    def test_kill_mid_stream_recovers_exactly_once(self, tmp_path):
+        # the scripted service-injection discipline: kill the streaming
+        # operator after N tasks; recovery replays its tape and the merged
+        # deltas stay exactly-once
+        self._run(tmp_path, inject={"after_tasks": 6, "channels": [(1, 0)]})
+
+    def test_chaos_kills_rearm_on_streams(self, tmp_path):
+        from quokka_tpu import obs
+
+        before = obs.REGISTRY.snapshot().get("chaos.kill", 0)
+        self._run(tmp_path, chaos="seed=5,kill=2,kill_after=5")
+        assert obs.REGISTRY.snapshot().get("chaos.kill", 0) > before
+
+    def test_manifest_resume_after_service_teardown(self, tmp_path):
+        from quokka_tpu import QuokkaContext
+        from quokka_tpu.service import QueryService
+        from quokka_tpu.service.server import ServiceShutdown
+
+        rng = np.random.default_rng(29)
+        n = 3000
+        df = pd.DataFrame({
+            "t": np.sort(rng.integers(0, 1000, n)),
+            "k": rng.integers(0, 4, n),
+            "v": rng.integers(0, 50, n).astype(np.float64),
+        })
+        rows = [f"{r.t},{r.k},{r.v}\n" for r in df.itertuples(index=False)]
+        p = str(tmp_path / "events.csv")
+        with open(p, "w") as f:
+            f.writelines(rows[:400])
+        ecfg = {"fault_tolerance": True, "checkpoint_interval": 1}
+
+        def make_stream():
+            ctx = QuokkaContext()
+            return tail_window_agg(
+                ctx, TailingCsvReader(p, EV_SCHEMA, "t"), size=100, by="k",
+                aggs=[("s", "sum", "v"), ("n", "count", None)])
+
+        svc = QueryService(pool_size=2, spill_dir=str(tmp_path / "spill"),
+                           exec_config=ecfg)
+        h = svc.submit_continuous(make_stream())
+        mpath = h.manifest_path
+        deltas = []
+        t0 = time.time()
+        appended = 400
+        while time.time() - t0 < 30:  # wait for a checkpointed manifest
+            if appended < 1200:  # feed several segments pre-teardown
+                with open(p, "a") as f:
+                    f.writelines(rows[appended:appended + 200])
+                appended += 200
+            deltas.extend(h.poll_deltas())
+            if os.path.exists(mpath) and appended >= 1200:
+                break
+            time.sleep(0.05)
+        assert os.path.exists(mpath), "no manifest before teardown"
+        svc.shutdown()  # streaming failure path: durable state preserved
+        # the handle stays drainable after teardown: panes that landed in
+        # the sink between the last poll and the shutdown (and which the
+        # newest checkpoint already covers) are collected here, not lost
+        deltas.extend(h.poll_deltas())
+        assert isinstance(h.error, ServiceShutdown)
+        assert os.path.exists(mpath)
+        # the rest of the stream arrives while the service is down
+        with open(p, "a") as f:
+            f.writelines(rows[1200:])
+        svc2 = QueryService(pool_size=2, spill_dir=str(tmp_path / "spill"),
+                            exec_config=ecfg)
+        # delivered_floor pins the resume point at-or-before the client's
+        # captured delta count: a pane the checkpoint already covered but
+        # that never crossed the exec->sink edge before teardown re-emits
+        # instead of vanishing (the output-commit gap)
+        h2 = svc2.submit_continuous(make_stream(), resume_from=mpath,
+                                    delivered_floor=len(deltas))
+        skipped = sum(r["skipped_segments"]
+                      for r in h2.resume_info["inputs"].values())
+        assert skipped > 0, "resume recomputed the full stream"
+        t0 = time.time()
+        while time.time() - t0 < 30:
+            wm = h2.watermark()
+            if wm is not None and wm >= float(df.t.max()):
+                break
+            time.sleep(0.05)
+        h2.stop(timeout=60)
+        deltas.extend(h2.poll_deltas())
+        _assert_exact(_merge_deltas(deltas), _truth(df))
+        st = svc2.stats()["sessions"]
+        svc2.shutdown()
+        # clean stop: the manifest (stream complete) is GC'd
+        assert not os.path.exists(mpath)
+
+    def test_resume_rejects_different_plan(self, tmp_path):
+        from quokka_tpu import QuokkaContext
+        from quokka_tpu.service import QueryService
+        from quokka_tpu.streaming.manifest import StreamResumeError
+
+        p = str(tmp_path / "events.csv")
+        with open(p, "w") as f:
+            f.write("1,0,2.0\n900,1,3.0\n")
+        ecfg = {"fault_tolerance": True, "checkpoint_interval": 1}
+        svc = QueryService(pool_size=1, spill_dir=str(tmp_path / "spill"),
+                           exec_config=ecfg)
+        ctx = QuokkaContext()
+        h = svc.submit_continuous(tail_window_agg(
+            ctx, TailingCsvReader(p, EV_SCHEMA, "t"), size=100, by="k",
+            aggs=[("s", "sum", "v")]))
+        mpath = h.manifest_path
+        t0 = time.time()
+        while not os.path.exists(mpath) and time.time() - t0 < 20:
+            time.sleep(0.05)
+        assert os.path.exists(mpath)
+        svc.shutdown()
+        svc2 = QueryService(pool_size=1, spill_dir=str(tmp_path / "spill"),
+                            exec_config=ecfg)
+        ctx2 = QuokkaContext()
+        different = tail_window_agg(  # different window size = new query
+            ctx2, TailingCsvReader(p, EV_SCHEMA, "t"), size=50, by="k",
+            aggs=[("s", "sum", "v")])
+        with pytest.raises(StreamResumeError):
+            svc2.submit_continuous(different, resume_from=mpath)
+        svc2.shutdown()
+
+    def test_resume_of_live_stream_refused(self, tmp_path):
+        from quokka_tpu import QuokkaContext
+        from quokka_tpu.service import QueryService
+
+        p = str(tmp_path / "events.csv")
+        with open(p, "w") as f:
+            f.write("1,0,2.0\n900,1,3.0\n")
+        svc = QueryService(pool_size=1, spill_dir=str(tmp_path / "spill"),
+                           exec_config={"fault_tolerance": True,
+                                        "checkpoint_interval": 1})
+        h = svc.submit_continuous(tail_window_agg(
+            QuokkaContext(), TailingCsvReader(p, EV_SCHEMA, "t"),
+            size=100, by="k", aggs=[("s", "sum", "v")]))
+        t0 = time.time()
+        while not os.path.exists(h.manifest_path) and time.time() - t0 < 20:
+            time.sleep(0.05)
+        # resuming the manifest of a stream STILL RUNNING in this service
+        # would run two engines against one namespace: refused loudly
+        with pytest.raises(ValueError, match="already running"):
+            svc.submit_continuous(tail_window_agg(
+                QuokkaContext(), TailingCsvReader(p, EV_SCHEMA, "t"),
+                size=100, by="k", aggs=[("s", "sum", "v")]),
+                resume_from=h.manifest_path)
+        h.stop(timeout=60)
+        svc.shutdown()
+
+    def test_handle_dedups_replay_overwrites(self):
+        from quokka_tpu.runtime.dataset import ResultDataset
+
+        class _S:  # minimal session stand-in
+            pass
+        from quokka_tpu.streaming.handle import StreamingHandle
+
+        ds = ResultDataset()
+        s = _S()
+        s.graph = type("G", (), {})()
+        s.graph.result = lambda _a: ds
+        s.sink_actor = 0
+        h = StreamingHandle.__new__(StreamingHandle)
+        h._s = s
+        h._cursor = {}
+        t1 = pa.table({"x": [1]})
+        ds.append(0, t1, seq=0)
+        assert [t.to_pylist() for t in h.poll_deltas()] == [[{"x": 1}]]
+        ds.append(0, t1, seq=0)  # replay overwrite: same seq, same bytes
+        assert h.poll_deltas() == []
+        ds.append(0, pa.table({"x": [2]}), seq=1)
+        assert [t.to_pylist() for t in h.poll_deltas()] == [[{"x": 2}]]
