@@ -4,7 +4,7 @@
 PY ?= python
 export JAX_PLATFORMS ?= cpu
 
-.PHONY: lint lint-baseline test test-slow sanitize-demo service-smoke chaos-smoke obs-smoke bench-check shuffle-smoke warmup-smoke multichip-smoke stream-smoke
+.PHONY: lint lint-baseline verify-static test test-slow sanitize-demo service-smoke chaos-smoke obs-smoke bench-check shuffle-smoke warmup-smoke multichip-smoke stream-smoke
 
 # engine-invariant static analysis; exits nonzero on findings beyond the
 # checked-in baseline (quokka_tpu/analysis/baseline.json)
@@ -15,6 +15,18 @@ lint:
 # findings still fail `make lint` until fixed or hand-added with a rationale)
 lint-baseline:
 	$(PY) -m quokka_tpu.analysis.lint quokka_tpu/ --write-baseline
+
+# the full static-analysis plane, exactly as tier-1 runs it: the lint gate
+# (baseline'd, wall-time budgeted), the control-store protocol verifier
+# (QK014-QK017, NO baseline — violations fail outright), and the qkflow
+# engine's known-answer self-check.  The schedex race explorer also proves
+# the shipped rewind rule closes the recovery race over a seeded batch.
+verify-static:
+	$(PY) -m pytest tests/test_lint_clean.py tests/test_lint_rules.py \
+		tests/test_flow.py tests/test_protocol.py tests/test_schedex.py \
+		-q -p no:cacheprovider
+	$(PY) -m quokka_tpu.analysis.protocol quokka_tpu/
+	$(PY) -m quokka_tpu.analysis.schedex --seeds 120
 
 test:
 	$(PY) -m pytest tests/ -q -m 'not slow'

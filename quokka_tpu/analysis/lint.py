@@ -58,17 +58,33 @@ def _relpath(path: str) -> str:
 
 
 def run_lint(paths: Sequence[str]) -> List[Finding]:
+    """Parse every file once, build the interprocedural flow context over
+    the whole set (call graph, static-arg summaries, execution-surface
+    reachability), then run the rules per file against it."""
+    import ast
+
+    from quokka_tpu.analysis.flow import FlowContext
+
     findings: List[Finding] = []
+    parsed: List[tuple] = []
+    ctx = FlowContext()
     for path in iter_py_files(paths):
         with open(path, "r", encoding="utf-8") as f:
             source = f.read()
+        rel = _relpath(path)
         try:
-            findings.extend(run_rules(source, path, _relpath(path)))
+            tree = ast.parse(source, filename=path)
         except SyntaxError as e:
             # a file the engine cannot even parse is its own finding
             findings.append(Finding(
-                "QK000", "syntax-error", path, _relpath(path),
+                "QK000", "syntax-error", path, rel,
                 e.lineno or 0, "<module>", f"syntax error: {e.msg}", ""))
+            continue
+        parsed.append((source, path, rel))
+        ctx.add_module(rel, tree)
+    ctx.finalize()
+    for source, path, rel in parsed:
+        findings.extend(run_rules(source, path, rel, ctx=ctx))
     return findings
 
 

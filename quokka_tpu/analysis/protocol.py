@@ -1,0 +1,675 @@
+"""Control-store protocol verifier (rules QK014-QK017).
+
+    python -m quokka_tpu.analysis.protocol quokka_tpu/
+    python -m quokka_tpu.analysis.protocol quokka_tpu/ --matrix
+
+The ControlStore table taxonomy (runtime/tables.py) is the contract the
+recovery protocol reasons over.  This verifier extracts every store
+operation site (``tset``/``tget``/``tappend``/``tape_append``/``sadd``/
+``tdel``/``srem``/``tape_trim``/``drop_namespace``/``ntt_*``) into a
+per-(table, key-class) writer/reader/GC matrix and statically checks the
+protocol invariants over it:
+
+  QK014  dead write / namespace escape — every written (table, key-class)
+         must have a reader somewhere in the tree (``drop_namespace`` is a
+         sweep, not a reader: state nobody replays is protocol rot), and
+         per-query keys must go through the NamespacedStore ``_k`` wrapping
+         (a raw root-store write escapes ``drop_namespace``'s sweep).
+  QK015  growth needs GC — key-classes that grow with the stream (append-
+         valued rows, per-seq keys, seq-membership sets) must have an
+         in-run GC site (``tdel``/``srem``/``tape_trim``/``ntt_pop``);
+         the end-of-query ``drop_namespace`` sweep does NOT satisfy this
+         (a standing query never ends).
+  QK016  lock-order acyclicity — locks wrapped by ``sanitize.maybe_
+         instrument`` form a static held->acquired graph (nested ``with``
+         blocks plus under-lock calls into the other lock class's
+         acquiring methods); any cycle is the two-lock deadlock precursor
+         the runtime recorder reports dynamically.
+  QK017  checkpoint-frontier atomicity — the checkpoint commit triple
+         (``LCT`` tset, ``("ckpts", ...)`` history tappend, ``IRT``
+         frontier tset) must land in ONE ``store.transaction()`` block;
+         a crash between torn halves leaves the rewind planner a frontier
+         with no covering history entry (monotonicity breaks).
+
+Unlike the lint plane (``analysis/lint.py``) there is NO baseline: the
+verifier must run clean on the tree, and exits nonzero otherwise.  Scope:
+the store's *users* — ``runtime/tables.py`` (the implementation; its
+NamespacedStore delegation is checked separately for ``_k`` discipline),
+``runtime/store_service.py``/``runtime/rpc.py`` (serving/client
+delegation), and ``analysis/`` (this plane models the protocol, it does
+not participate) are excluded from matrix extraction.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import os
+import re
+import sys
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from quokka_tpu.analysis.lint import _relpath, iter_py_files
+from quokka_tpu.analysis.rules import Finding
+
+# -- store-surface taxonomy ---------------------------------------------------
+
+_WRITE_METHODS = {"tset", "tappend", "tape_append", "sadd", "ntt_push"}
+_READ_METHODS = {"tget", "titems", "tlen", "smembers", "scontains",
+                 "tape_slice", "tape_len", "ntt_pop", "ntt_peek_all",
+                 "ntt_len", "ntt_total"}
+_GC_METHODS = {"tdel", "srem", "tape_trim", "ntt_remove_exec",
+               "ntt_remove_channel", "drop_namespace"}
+_TAPE_METHODS = {"tape_append", "tape_slice", "tape_len", "tape_trim"}
+_NTT_METHODS = {"ntt_push", "ntt_pop", "ntt_peek_all", "ntt_len",
+                "ntt_total", "ntt_remove_exec", "ntt_remove_channel"}
+
+# receivers that denote a store handle (self.store, g.store, cs, _root, ...)
+_STORE_RECEIVER = re.compile(r"(store$|^cs$|^_root$)")
+# the ROOT store by name: per-query table keys must not flow through it
+_ROOT_RECEIVER = re.compile(r"^root_store$")
+# namespace-independent root-store surface (engine cleanup path)
+_ROOT_OK_METHODS = {"drop_namespace", "namespace", "dump", "close"}
+
+# key components that denote a per-sequence counter: rows keyed by one are
+# written once per stream seq/state and grow without bound
+_SEQ_NAME = re.compile(r"(^|_)(seq|s|state|pos|nxt)$|seq$")
+
+# modules excluded from matrix extraction (see module docstring)
+_EXCLUDE_REL = re.compile(
+    r"quokka_tpu/(analysis/|runtime/tables\.py|runtime/store_service\.py"
+    r"|runtime/rpc\.py)")
+
+KeyClass = Tuple[str, str, Optional[int]]  # (table, subkey-head, arity)
+
+
+@dataclass
+class StoreOp:
+    kind: str               # "write" | "read" | "gc"
+    method: str
+    keyclass: KeyClass      # ("LT", "ckpts", 3) / ("SWM", "*", 3) / ...
+    path: str
+    rel: str
+    line: int
+    scope: str
+    snippet: str
+    growth: bool = False    # write sites only: grows with the stream
+    wildcard: bool = False  # titems/smembers(all)/drop_namespace: whole table
+
+
+def _receiver_name(expr: ast.AST) -> Optional[str]:
+    """Last name component of the call receiver: ``self.store`` -> 'store',
+    ``cs`` -> 'cs', ``s.graph.store`` -> 'store'."""
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return None
+
+
+def _is_seq_component(node: ast.AST) -> bool:
+    if isinstance(node, ast.Name):
+        return bool(_SEQ_NAME.search(node.id))
+    if isinstance(node, ast.Attribute):
+        return bool(_SEQ_NAME.search(node.attr))
+    return False
+
+
+def _classify_key(table: str, key: Optional[ast.AST]) -> KeyClass:
+    if key is None:
+        return (table, "*", None)
+    if isinstance(key, ast.Tuple):
+        head = key.elts[0] if key.elts else None
+        if isinstance(head, ast.Constant) and isinstance(head.value, str):
+            return (table, head.value, len(key.elts))
+        return (table, "*", len(key.elts))
+    if isinstance(key, ast.Constant):
+        return (table, "*", 1)
+    # a Name/Attribute key may hold a tuple of any shape: unknown arity
+    return (table, "*", None)
+
+
+def _classes_match(write: KeyClass, other: KeyClass) -> bool:
+    """Does a read/GC site of class `other` cover writes of class `write`?
+    Wildcard arity (whole-table ops) covers everything in the table; a
+    wildcard head on either side matches same-arity keys (variable vs
+    constant tuple heads of the same shape address the same rows)."""
+    if write[0] != other[0]:
+        return False
+    if other[2] is None or write[2] is None:
+        return True
+    if write[2] != other[2]:
+        return False
+    return write[1] == other[1] or "*" in (write[1], other[1])
+
+
+class _SiteCollector(ast.NodeVisitor):
+    """One file's store-op sites, with qualified enclosing scopes."""
+
+    def __init__(self, path: str, rel: str, src_lines: List[str]):
+        self.path = path
+        self.rel = rel
+        self.src_lines = src_lines
+        self.stack: List[str] = []
+        self.ops: List[StoreOp] = []
+
+    def _scope(self) -> str:
+        return ".".join(self.stack) or "<module>"
+
+    def _snippet(self, node: ast.AST) -> str:
+        i = getattr(node, "lineno", 0) - 1
+        return self.src_lines[i].strip() if 0 <= i < len(self.src_lines) else ""
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self.stack.append(node.name)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    def visit_FunctionDef(self, node) -> None:
+        self.stack.append(node.name)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node: ast.Call) -> None:
+        self.generic_visit(node)
+        fn = node.func
+        if not isinstance(fn, ast.Attribute):
+            return
+        method = fn.attr
+        recv = _receiver_name(fn.value)
+        if recv is None:
+            return
+        is_store = bool(_STORE_RECEIVER.search(recv)
+                        or _ROOT_RECEIVER.search(recv))
+        if not is_store:
+            return
+        kind = ("write" if method in _WRITE_METHODS else
+                "read" if method in _READ_METHODS else
+                "gc" if method in _GC_METHODS else None)
+        if kind is None:
+            return
+        op = self._classify_call(method, kind, node)
+        if op is not None:
+            self.ops.append(op)
+        # namespace escape: per-query table traffic on the ROOT store
+        if (_ROOT_RECEIVER.search(recv)
+                and method not in _ROOT_OK_METHODS):
+            self.ops.append(self._mk(
+                "escape", method, ("<root>", "*", None), node))
+
+    def _mk(self, kind: str, method: str, kc: KeyClass,
+            node: ast.AST, **kw) -> StoreOp:
+        return StoreOp(kind, method, kc, self.path, self.rel,
+                       getattr(node, "lineno", 0), self._scope(),
+                       self._snippet(node), **kw)
+
+    def _classify_call(self, method: str, kind: str,
+                       node: ast.Call) -> Optional[StoreOp]:
+        args = node.args
+        if method == "drop_namespace":
+            return self._mk(kind, method, ("<all>", "*", None), node,
+                            wildcard=True)
+        if method in _TAPE_METHODS:
+            kc = ("LT", "tape", 3)
+            growth = method == "tape_append"
+            return self._mk(kind, method, kc, node, growth=growth)
+        if method in _NTT_METHODS:
+            return self._mk(kind, method, ("NTT", "*", 1), node,
+                            growth=(method == "ntt_push"))
+        if not args or not (isinstance(args[0], ast.Constant)
+                            and isinstance(args[0].value, str)):
+            return None  # variable table name: delegation plumbing, skip
+        table = args[0].value
+        key = args[1] if len(args) > 1 else None
+        kc = _classify_key(table, key)
+        wildcard = key is None
+        growth = False
+        if kind == "write":
+            if method == "tappend":
+                growth = True
+            elif method == "tset" and isinstance(key, ast.Tuple) \
+                    and key.elts and _is_seq_component(key.elts[-1]):
+                growth = True
+            elif method == "sadd" and len(args) > 2 \
+                    and _is_seq_component(args[2]):
+                growth = True
+        return self._mk(kind, method, kc, node, growth=growth,
+                        wildcard=wildcard)
+
+
+# -- QK016: static lock-order graph -------------------------------------------
+
+# generic container-method names that would alias dict/set/list calls onto a
+# lock class's surface — never edge triggers
+_GENERIC_METHODS = {"get", "set", "put", "pop", "add", "items", "keys",
+                    "values", "append", "update", "clear", "discard",
+                    "remove", "extend", "popleft", "close"}
+
+
+@dataclass
+class _LockClass:
+    lock_name: str
+    class_name: str
+    rel: str
+    line: int
+    # methods of the class whose body acquires the lock
+    acquiring: Set[str] = field(default_factory=set)
+
+
+def _find_lock_classes(trees: Sequence[Tuple[str, str, ast.Module]]
+                       ) -> List[_LockClass]:
+    out: List[_LockClass] = []
+    for path, rel, tree in trees:
+        for cls in ast.walk(tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            lock_name = None
+            line = 0
+            for n in ast.walk(cls):
+                if (isinstance(n, ast.Call)
+                        and isinstance(n.func, ast.Attribute)
+                        and n.func.attr == "maybe_instrument"
+                        and n.args
+                        and isinstance(n.args[0], ast.Constant)):
+                    lock_name = n.args[0].value
+                    line = n.lineno
+                    break
+            if lock_name is None:
+                continue
+            lc = _LockClass(lock_name, cls.name, rel, line)
+            for m in cls.body:
+                if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    if any(_acquires_self_lock(w) for w in ast.walk(m)):
+                        lc.acquiring.add(m.name)
+            out.append(lc)
+    return out
+
+
+def _acquires_self_lock(node: ast.AST) -> bool:
+    """``with self._lock:`` or ``self._lock.acquire()``."""
+    if isinstance(node, ast.With):
+        for item in node.items:
+            e = item.context_expr
+            if isinstance(e, ast.Attribute) and e.attr == "_lock":
+                return True
+    if (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "acquire"
+            and isinstance(node.func.value, ast.Attribute)
+            and node.func.value.attr == "_lock"):
+        return True
+    return False
+
+
+def _lock_edges(trees: Sequence[Tuple[str, str, ast.Module]],
+                locks: Sequence[_LockClass]
+                ) -> Dict[Tuple[str, str], Tuple[str, int, str]]:
+    """(held, acquired) -> (rel, line, scope) witness.  An edge exists when
+    code inside a ``with self._lock`` body of lock class A calls a
+    distinctive acquiring method of lock class B (or nests B's ``with``)."""
+    by_class = {lc.class_name: lc for lc in locks}
+    # distinctive method name -> owning lock, minus generic container names
+    method_owner: Dict[str, _LockClass] = {}
+    for lc in locks:
+        for m in lc.acquiring - _GENERIC_METHODS:
+            method_owner.setdefault(m, lc)
+    edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+    for path, rel, tree in trees:
+        for cls in ast.walk(tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            holder = by_class.get(cls.name)
+            if holder is None:
+                continue
+            for m in cls.body:
+                if not isinstance(m, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    continue
+                for w in ast.walk(m):
+                    if not (isinstance(w, ast.With)
+                            and _acquires_self_lock(w)):
+                        continue
+                    for n in ast.walk(w):
+                        if not (isinstance(n, ast.Call)
+                                and isinstance(n.func, ast.Attribute)):
+                            continue
+                        callee = method_owner.get(n.func.attr)
+                        if callee is None \
+                                or callee.lock_name == holder.lock_name:
+                            continue
+                        edges.setdefault(
+                            (holder.lock_name, callee.lock_name),
+                            (rel, n.lineno, f"{cls.name}.{m.name}"))
+    return edges
+
+
+def _find_cycle(edges: Iterable[Tuple[str, str]]) -> Optional[List[str]]:
+    graph: Dict[str, List[str]] = {}
+    for a, b in edges:
+        graph.setdefault(a, []).append(b)
+    state: Dict[str, int] = {}
+    trail: List[str] = []
+
+    def dfs(v: str) -> Optional[List[str]]:
+        state[v] = 1
+        trail.append(v)
+        for w in graph.get(v, ()):
+            if state.get(w, 0) == 1:
+                return trail[trail.index(w):] + [w]
+            if state.get(w, 0) == 0:
+                c = dfs(w)
+                if c:
+                    return c
+        trail.pop()
+        state[v] = 2
+        return None
+
+    for v in list(graph):
+        if state.get(v, 0) == 0:
+            c = dfs(v)
+            if c:
+                return c
+    return None
+
+
+# -- QK017: checkpoint commit triple ------------------------------------------
+
+def _txn_blocks(tree: ast.Module) -> List[ast.With]:
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.With):
+            continue
+        for item in node.items:
+            e = item.context_expr
+            if (isinstance(e, ast.Call)
+                    and isinstance(e.func, ast.Attribute)
+                    and e.func.attr == "transaction"):
+                out.append(node)
+                break
+    return out
+
+
+def _ckpt_triple_ok(block: ast.With) -> bool:
+    has_lct = has_hist = has_irt = False
+    for n in ast.walk(block):
+        if not (isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute) and n.args):
+            continue
+        a0 = n.args[0]
+        if not (isinstance(a0, ast.Constant) and isinstance(a0.value, str)):
+            continue
+        if n.func.attr == "tset" and a0.value == "LCT":
+            has_lct = True
+        elif n.func.attr == "tset" and a0.value == "IRT":
+            has_irt = True
+        elif n.func.attr == "tappend" and a0.value == "LT" \
+                and len(n.args) > 1 and isinstance(n.args[1], ast.Tuple) \
+                and n.args[1].elts \
+                and isinstance(n.args[1].elts[0], ast.Constant) \
+                and n.args[1].elts[0].value == "ckpts":
+            has_hist = True
+    return has_lct and has_hist and has_irt
+
+
+def _is_hist_rewrite(block: ast.With) -> bool:
+    """A transaction that tdel's the ("ckpts", ...) history before appending
+    is the GC prune pattern (drop-and-reappend of the retained suffix), not
+    a new checkpoint commit — its tappends are exempt from the triple."""
+    for n in ast.walk(block):
+        if (isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)
+                and n.func.attr == "tdel" and n.args
+                and isinstance(n.args[0], ast.Constant)
+                and n.args[0].value == "LT"
+                and len(n.args) > 1 and isinstance(n.args[1], ast.Tuple)
+                and n.args[1].elts
+                and isinstance(n.args[1].elts[0], ast.Constant)
+                and n.args[1].elts[0].value == "ckpts"):
+            return True
+    return False
+
+
+def _is_ckpt_commit_site(node: ast.Call) -> Optional[str]:
+    """'LCT' for a tset("LCT", ...) site, 'ckpts' for the history tappend."""
+    if not (isinstance(node.func, ast.Attribute) and node.args):
+        return None
+    a0 = node.args[0]
+    if not (isinstance(a0, ast.Constant) and isinstance(a0.value, str)):
+        return None
+    if node.func.attr == "tset" and a0.value == "LCT":
+        return "LCT"
+    if node.func.attr == "tappend" and a0.value == "LT" \
+            and len(node.args) > 1 and isinstance(node.args[1], ast.Tuple) \
+            and node.args[1].elts \
+            and isinstance(node.args[1].elts[0], ast.Constant) \
+            and node.args[1].elts[0].value == "ckpts":
+        return "ckpts"
+    return None
+
+
+# -- NamespacedStore _k discipline (QK014 namespace-escape, tables.py side) ---
+
+_KEYED_DELEGATES = {"tset", "tget", "tappend", "tlen", "tdel", "sadd",
+                    "smembers", "scontains", "srem", "ntt_push", "ntt_pop",
+                    "ntt_remove_exec", "ntt_remove_channel", "ntt_peek_all",
+                    "ntt_len"}
+
+
+def _check_namespace_wrapping(path: str, rel: str, tree: ast.Module,
+                              src_lines: List[str]) -> List[Finding]:
+    """Inside NamespacedStore, every keyed delegation to ``self._root`` must
+    wrap the raw ``key`` parameter through ``self._k`` — a raw pass-through
+    writes rows ``drop_namespace`` can never sweep."""
+    findings: List[Finding] = []
+    for cls in ast.walk(tree):
+        if not (isinstance(cls, ast.ClassDef)
+                and cls.name == "NamespacedStore"):
+            continue
+        for n in ast.walk(cls):
+            if not (isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and n.func.attr in _KEYED_DELEGATES
+                    and isinstance(n.func.value, ast.Attribute)
+                    and n.func.value.attr == "_root"):
+                continue
+            raw_key = any(isinstance(a, ast.Name) and a.id == "key"
+                          for a in n.args)
+            wrapped = any(
+                isinstance(a, ast.Call)
+                and isinstance(a.func, ast.Attribute)
+                and a.func.attr == "_k" for a in n.args)
+            if raw_key and not wrapped:
+                i = n.lineno - 1
+                snip = src_lines[i].strip() if i < len(src_lines) else ""
+                findings.append(Finding(
+                    "QK014", "namespace-escape", path, rel, n.lineno,
+                    f"NamespacedStore.{n.func.attr}",
+                    f"NamespacedStore.{n.func.attr} passes the raw key to "
+                    "the root store — wrap it with self._k() so "
+                    "drop_namespace can sweep the row", snip))
+    return findings
+
+
+# -- verifier -----------------------------------------------------------------
+
+def collect_matrix(trees: Sequence[Tuple[str, str, ast.Module, List[str]]]
+                   ) -> List[StoreOp]:
+    ops: List[StoreOp] = []
+    for path, rel, tree, src_lines in trees:
+        if _EXCLUDE_REL.search(rel):
+            continue
+        c = _SiteCollector(path, rel, src_lines)
+        c.visit(tree)
+        ops.extend(c.ops)
+    return ops
+
+
+def verify(paths: Sequence[str]) -> Tuple[List[Finding], List[StoreOp]]:
+    trees: List[Tuple[str, str, ast.Module, List[str]]] = []
+    findings: List[Finding] = []
+    for path in iter_py_files(paths):
+        with open(path, "r", encoding="utf-8") as f:
+            source = f.read()
+        rel = _relpath(path)
+        try:
+            tree = ast.parse(source, filename=path)
+        except SyntaxError:
+            continue  # the lint plane owns QK000
+        trees.append((path, rel, tree, source.splitlines()))
+
+    ops = collect_matrix(trees)
+    writes = [o for o in ops if o.kind == "write"]
+    reads = [o for o in ops if o.kind == "read"]
+    gcs = [o for o in ops if o.kind == "gc" and o.method != "drop_namespace"]
+
+    # QK014a: dead writes (no reader anywhere for the key-class)
+    for w in writes:
+        if any(_classes_match(w.keyclass, r.keyclass) for r in reads):
+            continue
+        findings.append(Finding(
+            "QK014", "dead-write", w.path, w.rel, w.line, w.scope,
+            f"table {w.keyclass[0]!r} key-class {_fmt_kc(w.keyclass)} is "
+            "written here but read nowhere in the tree — state nobody "
+            "replays (drop its write, or wire up the reader)", w.snippet))
+
+    # QK014b: root-store escapes + NamespacedStore _k discipline
+    for o in ops:
+        if o.kind == "escape":
+            findings.append(Finding(
+                "QK014", "namespace-escape", o.path, o.rel, o.line, o.scope,
+                f"per-query store op {o.method!r} on the ROOT store — "
+                "route it through store.namespace(query_id) so "
+                "drop_namespace can sweep it", o.snippet))
+    for path, rel, tree, src_lines in trees:
+        if rel.endswith("runtime/tables.py"):
+            findings.extend(
+                _check_namespace_wrapping(path, rel, tree, src_lines))
+
+    # QK015: growth classes need an in-run GC site
+    flagged: Set[KeyClass] = set()
+    for w in writes:
+        if not w.growth or w.keyclass in flagged:
+            continue
+        if any(_classes_match(w.keyclass, g.keyclass) for g in gcs):
+            continue
+        flagged.add(w.keyclass)
+        findings.append(Finding(
+            "QK015", "growth-needs-gc", w.path, w.rel, w.line, w.scope,
+            f"key-class {_fmt_kc(w.keyclass)} grows per stream "
+            "seq but has no in-run GC site (tdel/srem/tape_trim) — "
+            "unbounded store growth on a standing query "
+            "(drop_namespace only sweeps at end-of-query)", w.snippet))
+
+    # QK016: lock-order acyclicity (tables.py/cache.py included — the lock
+    # classes ARE the implementation)
+    bare = [(p, r, t) for p, r, t, _ in trees]
+    locks = _find_lock_classes(bare)
+    edges = _lock_edges(bare, locks)
+    cycle = _find_cycle(edges.keys())
+    if cycle:
+        a, b = cycle[0], cycle[1]
+        rel, line, scope = edges[(a, b)]
+        path = next(p for p, r, _ in bare if r == rel)
+        findings.append(Finding(
+            "QK016", "lock-order-cycle", path, rel, line, scope,
+            "lock-order cycle " + " -> ".join(cycle) + " in the static "
+            "held->acquired graph — the two-lock deadlock precursor "
+            "sanitize.py's recorder reports dynamically", ""))
+
+    # QK017: checkpoint commit triple atomicity
+    for path, rel, tree, src_lines in trees:
+        if _EXCLUDE_REL.search(rel):
+            continue
+        txns = _txn_blocks(tree)
+        in_ok_txn: Set[int] = set()
+        in_any_txn: Set[int] = set()
+        for blk in txns:
+            ok = _ckpt_triple_ok(blk) or _is_hist_rewrite(blk)
+            for n in ast.walk(blk):
+                if isinstance(n, ast.Call) and _is_ckpt_commit_site(n):
+                    in_any_txn.add(id(n))
+                    if ok:
+                        in_ok_txn.add(id(n))
+        for n in ast.walk(tree):
+            if not isinstance(n, ast.Call):
+                continue
+            part = _is_ckpt_commit_site(n)
+            if part is None or id(n) in in_ok_txn:
+                continue
+            i = n.lineno - 1
+            snip = src_lines[i].strip() if i < len(src_lines) else ""
+            where = ("a transaction missing the rest of the triple"
+                     if id(n) in in_any_txn else "no transaction at all")
+            findings.append(Finding(
+                "QK017", "torn-checkpoint", path, rel, n.lineno, "<module>",
+                f"checkpoint commit part ({part}) lands in {where} — the "
+                "LCT pointer, the (\"ckpts\", ...) history entry and the "
+                "IRT frontier must commit in ONE store.transaction() or a "
+                "crash tears the frontier from its covering history",
+                snip))
+    return findings, ops
+
+
+def _fmt_kc(kc: KeyClass) -> str:
+    table, head, arity = kc
+    if arity is None:
+        return f"{table}[*]"
+    parts = ([repr(head)] if head != "*" else []) \
+        + ["_"] * (arity - (head != "*"))
+    return f"{table}({', '.join(parts)})"
+
+
+def render_matrix(ops: Sequence[StoreOp]) -> str:
+    rows: Dict[KeyClass, Dict[str, int]] = {}
+    growth: Set[KeyClass] = set()
+    for o in ops:
+        if o.kind == "escape":
+            continue
+        rows.setdefault(o.keyclass, {"write": 0, "read": 0, "gc": 0})
+        rows[o.keyclass][o.kind] += 1
+        if o.growth:
+            growth.add(o.keyclass)
+    lines = [f"{'key-class':<28} {'writes':>6} {'reads':>6} {'gc':>4}  notes"]
+    for kc in sorted(rows, key=lambda k: (k[0], k[1], k[2] or 0)):
+        r = rows[kc]
+        note = "growth" if kc in growth else ""
+        lines.append(f"{_fmt_kc(kc):<28} {r['write']:>6} {r['read']:>6} "
+                     f"{r['gc']:>4}  {note}")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m quokka_tpu.analysis.protocol", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("paths", nargs="*", default=None,
+                   help="files or directories (default: the installed "
+                        "quokka_tpu package)")
+    p.add_argument("--matrix", action="store_true",
+                   help="print the writer/reader/GC matrix and exit")
+    args = p.parse_args(argv)
+    paths = args.paths or [os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))]
+
+    findings, ops = verify(paths)
+    if args.matrix:
+        try:
+            print(render_matrix(ops))
+        except BrokenPipeError:  # `--matrix | head` closing the pipe early
+            sys.stderr.close()
+        return 0
+    for f in findings:
+        print(f.render())
+    if findings:
+        print(f"{len(findings)} protocol violation(s) — the control-store "
+              "protocol has NO baseline; fix the code", file=sys.stderr)
+        return 1
+    n = len({o.keyclass for o in ops if o.kind != 'escape'})
+    print(f"protocol clean: {len(ops)} store-op sites across "
+          f"{n} key-classes verified (QK014-QK017)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

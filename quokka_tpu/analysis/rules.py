@@ -73,6 +73,8 @@ import ast
 from dataclasses import dataclass
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
+from quokka_tpu.analysis.flow import FlowContext
+
 _JIT_MAKERS = ("jit", "pjit", "shard_map")
 
 _REGISTRATION_CALLS = (
@@ -436,28 +438,44 @@ def _callees(fn: ast.FunctionDef, known: Dict[str, ast.FunctionDef]
     return out
 
 
-def check_host_sync_in_jit(tree: ast.Module, path: str, rel: str,
-                           src_lines: Sequence[str]) -> List[Finding]:
-    fns = _collect_functions(tree)
-    entry_statics = {n: s for n, s in _jit_entry_names(tree).items()
-                     if n in fns}
-    # reachability over same-module simple-name calls
-    reachable: Set[str] = set()
-    frontier = list(entry_statics)
+def _module_reachable(ctx: FlowContext, mt, seeds: Iterable[str]) -> Set[str]:
+    """Call-graph closure restricted to `mt`'s own functions (a helper in
+    another module cannot re-enter the old same-file scope, so dataflow
+    precision only ever REMOVES findings relative to the name heuristic)."""
+    seen: Set[str] = set()
+    frontier = list(seeds)
     while frontier:
-        name = frontier.pop()
-        if name in reachable:
+        fid = frontier.pop()
+        if fid in seen:
             continue
-        reachable.add(name)
-        frontier.extend(_callees(fns[name], fns) - reachable)
+        seen.add(fid)
+        frontier.extend(
+            c for c in ctx.calls.get(fid, ())
+            if c not in seen and ctx.funcs[c].module == mt.name
+        )
+    return seen
+
+
+def check_host_sync_in_jit(tree: ast.Module, path: str, rel: str,
+                           src_lines: Sequence[str],
+                           ctx: FlowContext) -> List[Finding]:
+    mt = ctx.module_table(rel)
+    if mt is None:
+        return []
+    entry_statics = _jit_entry_names(tree)
+    seeds = [fi.fid for fi in mt.functions.values()
+             if fi.name in entry_statics]
 
     out: List[Finding] = []
-    for name in sorted(reachable):
-        fn = fns[name]
-        params = {a.arg for a in fn.args.args + fn.args.kwonlyargs
-                  if a.arg not in ("self", "cls")}
+    for fid in sorted(_module_reachable(ctx, mt, seeds)):
+        fi = ctx.funcs[fid]
+        name = fi.name
+        params = fi.params()
         params -= entry_statics.get(name, set())
-        for node in ast.walk(fn):
+        # interprocedurally static parameters (literal/metadata at EVERY
+        # call site in the analyzed set) are trace-time config, not tracers
+        params -= ctx.static_params(fid)
+        for node in FlowContext._own_nodes(fi.node):
             if isinstance(node, ast.Call):
                 d = _dotted(node.func)
                 if d is not None:
@@ -505,6 +523,9 @@ def check_host_sync_in_jit(tree: ast.Module, path: str, rel: str,
                         "mark the argument static)",
                         src_lines))
     return out
+
+
+check_host_sync_in_jit._needs_flow = True
 
 
 # ---------------------------------------------------------------------------
@@ -778,18 +799,59 @@ def _is_environ(node: ast.AST) -> bool:
     return d in ("os.environ", "environ")
 
 
+def _exec_surface(ctx: FlowContext) -> Set[str]:
+    """Functions reachable from the query-execution surface: the task
+    dispatch handlers (``handle_*``), the shuffle push path, and every
+    jitted entry.  Code OUTSIDE this closure runs pre-query (import-time
+    setup, process bootstrap, CLI/soak drivers) where a process-global
+    mutation has no concurrently-running neighbor to corrupt."""
+    cached = getattr(ctx, "_qk_exec_surface", None)
+    if cached is not None:
+        return cached
+    seeds: Set[str] = set()
+    for mt in ctx.modules.values():
+        jit_entries = _jit_entry_names(mt.tree)
+        for fi in mt.functions.values():
+            if (fi.name.startswith("handle_")
+                    or fi.name in _PUSH_PATH_ENTRY_FUNCS
+                    or fi.name in jit_entries):
+                seeds.add(fi.fid)
+    surface = ctx.reachable(seeds)
+    ctx._qk_exec_surface = surface
+    return surface
+
+
 def check_global_config_mutation(tree: ast.Module, path: str, rel: str,
-                                 src_lines: Sequence[str]) -> List[Finding]:
+                                 src_lines: Sequence[str],
+                                 ctx: FlowContext) -> List[Finding]:
     """With the query service, many queries share one process: jax.config,
     quokka_tpu.config module globals and os.environ are PROCESS-global, so
     code reachable inside query execution mutating them corrupts every
     concurrently-running neighbor (dtype regime flips mid-pipeline, kernel
-    strategy changes between a build and its probe, ...).  Mutations that
-    are genuinely pre-query (import-time setup in config.py, spawned-worker
-    bootstrap) go into the baseline with a rationale."""
+    strategy changes between a build and its probe, ...).  Only mutations
+    inside functions reachable from the execution surface (task handlers,
+    push path, jit entries — see ``_exec_surface``) are flagged: import-time
+    setup, spawned-worker bootstrap and soak drivers are pre-query by
+    construction, which the old name-heuristic could not see and baselined
+    one rationale at a time."""
+    mt = ctx.module_table(rel)
+    if mt is None:
+        return []
+    surface = _exec_surface(ctx)
+    owner: Dict[int, object] = {}
+    for fi in mt.functions.values():
+        for n in FlowContext._own_nodes(fi.node):
+            owner[id(n)] = fi
+
+    def gated(node: ast.AST) -> bool:
+        fi = owner.get(id(node))
+        return fi is not None and fi.fid in surface
+
     out: List[Finding] = []
 
     def flag(node: ast.AST, what: str):
+        if not gated(node):
+            return
         out.append(_mk(
             "QK008", "global-config-mutation", path, rel, node,
             _scope_of(tree, node),
@@ -831,6 +893,9 @@ def check_global_config_mutation(tree: ast.Module, path: str, rel: str,
                 if isinstance(t, ast.Subscript) and _is_environ(t.value):
                     flag(node, "del on os.environ")
     return out
+
+
+check_global_config_mutation._needs_flow = True
 
 
 # ---------------------------------------------------------------------------
@@ -1032,42 +1097,31 @@ _PUSH_SYNC_TAILS = ("asarray", "item", "tolist", "device_get",
 
 
 def check_push_path_host_sync(tree: ast.Module, path: str, rel: str,
-                              src_lines: Sequence[str]) -> List[Finding]:
+                              src_lines: Sequence[str],
+                              ctx: FlowContext) -> List[Finding]:
     """The shuffle push path (Engine.push -> partition fn -> split kernels)
     is the producer's hot loop: a blocking host readback there drains the
     whole queued device pipeline once per batch per edge — exactly the
     stall the device-resident data plane removed.  Flags np.asarray/.item()/
     .tolist()/device_get/block_until_ready in functions reachable from the
-    push-path entry set; the deliberate sites (e.g. the compacted split's
-    bucket-sizing counts readback, whose async host copy starts at plan
-    dispatch) carry baseline rationales."""
-    fns: Dict[str, ast.FunctionDef] = {}
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            fns.setdefault(node.name, node)
-    entries = [n for n in _PUSH_PATH_ENTRY_FUNCS if n in fns]
+    push-path entry set.  Reachability comes from the flow call graph:
+    nested closures count only when they actually ESCAPE into the caller
+    (called, returned, stored or passed — the old rule pulled in every
+    nested def of an entry unconditionally), and an ``np.asarray(x)`` whose
+    ``x.copy_to_host_async()`` was dispatched earlier in the same function
+    is an overlapped transfer, not a pipeline drain."""
+    mt = ctx.module_table(rel)
+    if mt is None:
+        return []
+    entries = [fi.fid for fi in mt.functions.values()
+               if fi.name in _PUSH_PATH_ENTRY_FUNCS]
     if not entries:
         return []
-    reachable: Set[str] = set()
-    frontier = list(entries)
-    while frontier:
-        name = frontier.pop()
-        if name in reachable:
-            continue
-        reachable.add(name)
-        fn = fns[name]
-        frontier.extend(_callees(fn, fns) - reachable)
-        # closures built by an entry run on the push path too (the lowered
-        # partition fn is a nested def inside _partition_fn)
-        for sub in ast.walk(fn):
-            if (isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef))
-                    and sub is not fn and sub.name in fns
-                    and fns[sub.name] is sub):
-                frontier.append(sub.name)
 
     out: List[Finding] = []
-    for name in sorted(reachable):
-        for node in ast.walk(fns[name]):
+    for fid in sorted(_module_reachable(ctx, mt, entries)):
+        fi = ctx.funcs[fid]
+        for node in FlowContext._own_nodes(fi.node):
             if not isinstance(node, ast.Call):
                 continue
             d = _dotted(node.func)
@@ -1091,6 +1145,14 @@ def check_push_path_host_sync(tree: ast.Module, path: str, rel: str,
                 if tail == "asarray" and base not in ("np", "numpy", "onp",
                                                       ""):
                     continue
+                # def-use: the d2h copy of this local was already dispatched
+                # asynchronously earlier in the function — materializing it
+                # here overlaps the device pipeline instead of draining it
+                if (tail == "asarray" and len(node.args) == 1
+                        and isinstance(node.args[0], ast.Name)
+                        and FlowContext.async_copy_started(
+                            fi.node, node.args[0].id, node.lineno)):
+                    continue
             scope = _scope_of(tree, node)
             out.append(_mk(
                 "QK011", "push-path-host-sync", path, rel, node, scope,
@@ -1101,6 +1163,9 @@ def check_push_path_host_sync(tree: ast.Module, path: str, rel: str,
                 "background spill), or baseline with a rationale",
                 src_lines))
     return out
+
+
+check_push_path_host_sync._needs_flow = True
 
 
 # ---------------------------------------------------------------------------
@@ -1273,12 +1338,27 @@ RULES = (
 )
 
 
-def run_rules(source: str, path: str, rel: str) -> List[Finding]:
-    tree = ast.parse(source, filename=path)
+def run_rules(source: str, path: str, rel: str,
+              ctx: Optional[FlowContext] = None) -> List[Finding]:
+    """ctx: the whole-file-set flow context built by ``lint.run_lint``;
+    when absent (single-file callers, fixtures) a one-module context is
+    built here so the flow-aware rules behave identically — just without
+    cross-module knowledge."""
+    if ctx is not None and ctx.module_table(rel) is not None:
+        # reuse the context's tree: flow tables are keyed by node identity
+        tree = ctx.module_table(rel).tree
+    else:
+        tree = ast.parse(source, filename=path)
+        ctx = FlowContext()
+        ctx.add_module(rel, tree)
+        ctx.finalize()
     src_lines = source.splitlines()
     findings: List[Finding] = []
     for rule in RULES:
-        findings.extend(rule(tree, path, rel, src_lines))
+        if getattr(rule, "_needs_flow", False):
+            findings.extend(rule(tree, path, rel, src_lines, ctx))
+        else:
+            findings.extend(rule(tree, path, rel, src_lines))
     findings.sort(key=lambda f: (f.line, f.rule))
     # occurrence-number duplicate (rule, scope, snippet) triples so baseline
     # keys are unique and stable in file order

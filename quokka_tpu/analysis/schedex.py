@@ -1,0 +1,354 @@
+"""Deterministic-schedule explorer for the recovery protocol (schedex).
+
+    python -m quokka_tpu.analysis.schedex                  # explore + report
+    python -m quokka_tpu.analysis.schedex --seed 7 --rule covering
+    python -m quokka_tpu.analysis.schedex --minimize
+
+TestKill9Recovery wedged about once in ten runs: after a SIGKILL took a
+worker that owned both a producer and its consumer, the consumer's exec
+task spun on ``plan_get=None`` forever while the stall report blamed the
+dead worker's stale heartbeat.  The root cause was an *interleaving* —
+checkpoint placement vs kill timing — which wall-clock soak runs reproduce
+only probabilistically.  This module replays the protocol under a seeded
+virtual clock instead: every interleaving is a pure function of its seed,
+so a failing schedule is a permalink, and delta-debugging can shrink it to
+the minimal action sequence that still wedges.
+
+The model is the recovery protocol stripped to its load-bearing state
+(runtime/engine.py): per-channel out_seq / input frontiers / lineage tape /
+checkpoint history (LCT + ("ckpts", ...) + IRT), worker-owned seq caches
+that die with their worker, and a coordinator whose ``recover`` step runs
+the rewind planner.  Two planner rules are implemented:
+
+- ``covering`` — the OLD rule: co-dead producers are rewound only far
+  enough to cover seqs recorded on consumers' tape slices.  A co-dead
+  consumer whose LIVE phase (after replaying its tape) needs a seq its
+  tape never recorded leaves the producer at a checkpoint PAST that seq:
+  the seq exists nowhere (producer-side spill and consumer-side cache both
+  died), and the consumer blocks forever — the wedge.
+- ``frontier`` — the SHIPPED rule (engine.plan_rewinds): each dead
+  channel's post-tape input frontier (IRT at the chosen state advanced
+  through the tape slice) is computed, and co-dead producers must also
+  cover THAT.  Exploration across every seed finds no wedge under it.
+
+Wedge detection is exact, not timeout-based: the world is quiescent when
+no action can make progress; quiescent with an unmet need is a wedge.
+"""
+
+from __future__ import annotations
+
+import argparse
+import random
+import sys
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+# topology of the repro: source -> producer -> consumer, with the producer
+# and consumer co-located on one worker (the SIGKILL takes both — the
+# TestKill9Recovery shape: worker 1 owned (2,1) and (3,1))
+SOURCE, PROD, CONS = "S", "P", "X"
+WORKER_OF = {SOURCE: 0, PROD: 1, CONS: 1}
+UPSTREAM = {PROD: SOURCE, CONS: PROD}
+MAX_SEQS = 4  # source run length: enough for every checkpoint/kill phasing
+
+
+@dataclass
+class Chan:
+    """One channel's control-plane state (LCT/IRT/tape/ckpts essentials)."""
+    name: str
+    out_seq: int = 0
+    frontier: int = 0            # next upstream seq this channel consumes
+    tape: List[int] = field(default_factory=list)   # recorded input seqs
+    # checkpoint history: (state_seq, out_seq, tape_pos, frontier=IRT)
+    ckpts: List[Tuple[int, int, int, int]] = field(
+        default_factory=lambda: [(0, 0, 0, 0)])
+    alive: bool = True
+
+
+@dataclass
+class World:
+    chans: Dict[str, Chan]
+    # (producer, seq) -> owning worker while the copy is alive
+    cache: Dict[Tuple[str, int], int] = field(default_factory=dict)
+    killed: bool = False
+    recovered: bool = False
+
+    @classmethod
+    def fresh(cls) -> "World":
+        return cls({n: Chan(n) for n in (SOURCE, PROD, CONS)})
+
+
+Action = Tuple[str, str]  # (verb, channel-or-'') — one schedule step
+
+
+def enabled(w: World) -> List[Action]:
+    out: List[Action] = []
+    for name, c in w.chans.items():
+        if not c.alive:
+            continue
+        if name == SOURCE:
+            if c.out_seq < MAX_SEQS:
+                out.append(("produce", name))
+        else:
+            # the needed upstream seq must still be cached somewhere
+            # (produced seqs enter the cache at produce time)
+            if (UPSTREAM[name], c.frontier) in w.cache:
+                out.append(("produce", name))
+            if (c.ckpts[-1][2] < len(c.tape)
+                    or c.out_seq > c.ckpts[-1][1]):
+                out.append(("checkpoint", name))
+    if not w.killed:
+        out.append(("kill", ""))
+    if w.killed and not w.recovered:
+        out.append(("recover", ""))
+    return out
+
+
+def _produce(w: World, name: str) -> None:
+    c = w.chans[name]
+    if name != SOURCE:
+        # consume the input seq at the frontier, record it on the tape
+        del_key = (UPSTREAM[name], c.frontier)
+        # the copy stays cached for other (hypothetical) consumers; the
+        # engine's seq-keyed cache keeps it until GC — keep it here too
+        assert del_key in w.cache
+        c.tape.append(c.frontier)
+        c.frontier += 1
+    w.cache[(name, c.out_seq)] = WORKER_OF[name]
+    c.out_seq += 1
+
+
+def _checkpoint(w: World, name: str) -> None:
+    c = w.chans[name]
+    state = c.ckpts[-1][0] + 1
+    c.ckpts.append((state, c.out_seq, len(c.tape), c.frontier))
+
+
+def _kill(w: World) -> None:
+    """SIGKILL worker 1: its channels die, every cached copy it owned dies
+    with it (consumer-side cache and producer-side async spill both lived
+    in the killed process)."""
+    w.killed = True
+    for name, owner in WORKER_OF.items():
+        if owner == 1:
+            w.chans[name].alive = False
+    w.cache = {k: v for k, v in w.cache.items() if v != 1}
+
+
+def plan_rewinds_model(w: World, rule: str) -> Dict[str, int]:
+    """The rewind planner over the dead set: returns channel -> chosen
+    checkpoint index.  ``covering`` reproduces the old engine rule (tape-
+    recorded needs only); ``frontier`` adds the live-phase frontier pass
+    that engine.plan_rewinds ships."""
+    dead = [n for n, c in w.chans.items() if not c.alive]
+    choice = {n: len(w.chans[n].ckpts) - 1 for n in dead}  # latest first
+
+    def rewind_to_cover(name: str, seq: int) -> bool:
+        c = w.chans[name]
+        if c.ckpts[choice[name]][1] <= seq:
+            return False
+        best = max((i for i, h in enumerate(c.ckpts) if h[1] <= seq),
+                   default=0)
+        if best == choice[name]:
+            return False
+        choice[name] = best
+        return True
+
+    changed = True
+    while changed:
+        changed = False
+        for name in dead:
+            c = w.chans[name]
+            if name == SOURCE:
+                continue
+            _st, _out, tape_pos, frontier = c.ckpts[choice[name]]
+            # walk the tape slice: recorded needs (the old rule's whole
+            # coverage set), advancing the frontier as replay would
+            for seq in c.tape[tape_pos:]:
+                if UPSTREAM[name] in dead:
+                    if rewind_to_cover(UPSTREAM[name], seq):
+                        changed = True
+                frontier = max(frontier, seq + 1)
+            if rule == "frontier" and UPSTREAM[name] in dead:
+                # the shipped fix: the LIVE phase after replay needs the
+                # post-tape frontier seq too
+                if rewind_to_cover(UPSTREAM[name], frontier):
+                    changed = True
+    return choice
+
+
+def _recover(w: World, rule: str) -> None:
+    choice = plan_rewinds_model(w, rule)
+    for name, idx in choice.items():
+        c = w.chans[name]
+        state, out, tape_pos, frontier = c.ckpts[idx]
+        c.out_seq = out
+        c.frontier = frontier
+        c.tape = c.tape[:tape_pos]
+        c.ckpts = c.ckpts[:idx + 1]
+        c.alive = True  # tape truncated to the checkpoint: no replay gap
+    w.recovered = True
+
+
+def apply(w: World, action: Action, rule: str) -> None:
+    verb, name = action
+    if verb == "produce":
+        _produce(w, name)
+    elif verb == "checkpoint":
+        _checkpoint(w, name)
+    elif verb == "kill":
+        _kill(w)
+    elif verb == "recover":
+        _recover(w, rule)
+
+
+@dataclass
+class Result:
+    wedged: bool
+    trace: List[Action]
+    detail: str
+
+
+def _wedge_report(w: World) -> Optional[str]:
+    """Quiescent-state analysis: an alive consumer whose needed seq exists
+    nowhere and will never be produced again is the wedge."""
+    for name, c in w.chans.items():
+        if name == SOURCE or not c.alive:
+            continue
+        up_name = UPSTREAM[name]
+        up = w.chans[up_name]
+        need = c.frontier
+        if c.out_seq >= MAX_SEQS and name == CONS:
+            continue  # drained
+        if (up_name, need) in w.cache:
+            continue
+        if up.alive and up.out_seq <= need:
+            continue  # upstream will regenerate it
+        if up_name == SOURCE and up.out_seq >= MAX_SEQS and \
+                c.frontier >= MAX_SEQS:
+            continue  # stream finished
+        return (f"channel {name} blocked on seq {need} from {up_name}: "
+                f"no cached copy survives and {up_name} restarts at "
+                f"out_seq {up.out_seq} > {need} — the seq exists nowhere "
+                "(the 'object nobody regenerates' wedge)")
+    return None
+
+
+def run_schedule(seed: Optional[int], rule: str,
+                 trace: Optional[Sequence[Action]] = None,
+                 max_steps: int = 200) -> Result:
+    """Run one deterministic schedule: either RNG-driven by ``seed`` or
+    replayed from an explicit ``trace`` (disabled actions are skipped, so
+    ddmin subsets stay executable)."""
+    w = World.fresh()
+    rng = random.Random(seed)
+    taken: List[Action] = []
+    if trace is not None:
+        for a in trace:
+            if a in enabled(w):
+                apply(w, a, rule)
+                taken.append(a)
+    else:
+        for _ in range(max_steps):
+            acts = enabled(w)
+            if not acts:
+                break
+            a = acts[rng.randrange(len(acts))]
+            apply(w, a, rule)
+            taken.append(a)
+            if w.recovered and _drained(w):
+                break
+    # drain deterministically so "kill early, recover, finish" completes:
+    # after the scheduled prefix, give every channel a fair chance
+    for _ in range(max_steps):
+        if not w.killed or not w.recovered:
+            break
+        acts = [a for a in enabled(w) if a[0] == "produce"]
+        if not acts or _drained(w):
+            break
+        apply(w, acts[0], rule)
+    report = _wedge_report(w) if (w.killed and w.recovered) else None
+    return Result(report is not None, taken, report or "completed")
+
+
+def _drained(w: World) -> bool:
+    return all(c.out_seq >= MAX_SEQS for c in w.chans.values())
+
+
+def explore(rule: str, seeds: int = 300) -> List[Tuple[int, Result]]:
+    """Every seed is an interleaving; return the wedged ones."""
+    wedges = []
+    for seed in range(seeds):
+        r = run_schedule(seed, rule)
+        if r.wedged:
+            wedges.append((seed, r))
+    return wedges
+
+
+def minimize(trace: Sequence[Action], rule: str) -> List[Action]:
+    """ddmin to a 1-minimal wedging schedule: removing any single action
+    no longer wedges."""
+    cur = list(trace)
+    n = 2
+    while len(cur) >= 2:
+        chunk = max(1, len(cur) // n)
+        shrunk = False
+        for i in range(0, len(cur), chunk):
+            cand = cur[:i] + cur[i + chunk:]
+            if run_schedule(None, rule, trace=cand).wedged:
+                cur = cand
+                n = max(2, n - 1)
+                shrunk = True
+                break
+        if not shrunk:
+            if chunk == 1:
+                break
+            n = min(len(cur), n * 2)
+    return cur
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m quokka_tpu.analysis.schedex", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("--rule", choices=("covering", "frontier"),
+                   default=None,
+                   help="planner rule (default: compare both)")
+    p.add_argument("--seed", type=int, default=None,
+                   help="replay one seed and print its trace")
+    p.add_argument("--seeds", type=int, default=300,
+                   help="seeds to explore (default 300)")
+    p.add_argument("--minimize", action="store_true",
+                   help="ddmin the first wedging schedule to 1-minimal")
+    args = p.parse_args(argv)
+
+    if args.seed is not None:
+        rule = args.rule or "covering"
+        r = run_schedule(args.seed, rule)
+        print(f"seed {args.seed} rule={rule}: "
+              f"{'WEDGED' if r.wedged else 'ok'}")
+        for a in r.trace:
+            print(f"  {a[0]} {a[1]}".rstrip())
+        print(r.detail)
+        return 1 if r.wedged else 0
+
+    rules = [args.rule] if args.rule else ["covering", "frontier"]
+    status = 0
+    for rule in rules:
+        wedges = explore(rule, args.seeds)
+        print(f"rule={rule}: {len(wedges)}/{args.seeds} seeds wedge")
+        if wedges and rule == "frontier":
+            status = 1  # the shipped rule must never wedge
+        if wedges and args.minimize:
+            seed, r = wedges[0]
+            mini = minimize(r.trace, rule)
+            print(f"  minimal repro (from seed {seed}, "
+                  f"{len(r.trace)} -> {len(mini)} actions):")
+            for a in mini:
+                print(f"    {a[0]} {a[1]}".rstrip())
+            print(f"  {run_schedule(None, rule, trace=mini).detail}")
+    return status
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -239,7 +239,6 @@ class TaskGraph:
 
             info.predicate = FusedPredicate(predicate)
         info.projection = projection
-        self.store.tset("FOT", info.id, reader)
         tapes = reader.get_own_state(channels)
         for ch in range(channels):
             lineages = tapes.get(ch, [])
@@ -277,7 +276,6 @@ class TaskGraph:
         info = self._new_actor("exec", channels, stage, sorted_actor)
         info.channel_major = channel_major
         info.executor_factory = executor_factory
-        self.store.tset("FOT", info.id, executor_factory)
         self.store.tset("AST", info.id, stage)
         if sorted_actor:
             self.store.sadd("SAT", info.id)
@@ -397,33 +395,68 @@ def plan_rewinds(store, dead_exec: List[Tuple[int, int]]) -> Dict[Tuple[int, int
     tape consumes an object produced by co-dead channel Y at an output seq
     BELOW Y's chosen checkpoint out_seq, no surviving copy of that object may
     exist (HBQ spill is producer-local and died with Y's worker) — Y must
-    rewind to a checkpoint old enough to regenerate it.  Iterate to fixpoint;
-    choices only move backward, bounded by (0, 0, 0), so this terminates."""
+    rewind to a checkpoint old enough to regenerate it.
+
+    The same covering rule applies PAST the tape: once X's tape is exhausted
+    its live execution resumes consuming at its post-replay input frontier
+    (IRT at the chosen state, advanced through the tape slice).  A co-dead
+    producer restored past that frontier leaves a seq gap no surviving copy
+    fills — the consumer-side cache copies died with X's worker and the
+    producer-side async spill died with Y's — so X's exec task spins on
+    plan_get forever while the stall report blames the dead worker's stale
+    heartbeat (the TestKill9Recovery wedge; reproduce with
+    `python -m quokka_tpu.analysis.schedex`).  Covering the frontier too
+    closes it: over-rewinding is idempotent (re-emissions are seq-keyed,
+    consumers ignore seqs below their frontier) and a finished producer is
+    never rewound past its end (its checkpoint out_seqs never exceed the
+    frontier a consumer could still need).  Iterate to fixpoint; choices
+    only move backward, bounded by (0, 0, 0), so this terminates."""
     dead = set(dead_exec)
     choice: Dict[Tuple[int, int], Tuple[int, int, int]] = {}
     for (a, ch) in dead:
         lct = store.tget("LCT", (a, ch))
         choice[(a, ch)] = tuple(lct) if lct is not None else (0, 0, 0)
+
+    def _rewind_to_cover(key: Tuple[int, int], seq: int) -> bool:
+        if choice[key][1] <= seq:
+            return False  # producer's replay regenerates it
+        hist = ckpt_candidates(store, *key)
+        best = tuple(
+            max((h for h in hist if h[1] <= seq), key=lambda h: h[0])
+        )
+        if best == choice[key]:
+            return False
+        choice[key] = best
+        return True
+
     changed = True
     while changed:
         changed = False
         for (a, ch) in dead:
-            for ev in store.tape_slice(a, ch, choice[(a, ch)][2]):
+            state_seq, _out_seq, tape_pos = choice[(a, ch)]
+            irt = store.tget("IRT", (a, ch, state_seq)) or {}
+            frontier = {s: dict(c) for s, c in irt.items()}
+            for ev in store.tape_slice(a, ch, tape_pos):
                 if ev[0] != "exec":
                     continue
                 for name in ev[2]:
                     key = (name[0], name[1])
+                    seq = name[2]
+                    chans = frontier.setdefault(name[0], {})
+                    if chans.get(name[1], 0) <= seq:
+                        chans[name[1]] = seq + 1
                     if key not in dead:
                         continue  # producer alive: its HBQ still serves it
-                    seq = name[2]
-                    if choice[key][1] <= seq:
-                        continue  # producer's replay regenerates it
-                    hist = ckpt_candidates(store, *key)
-                    best = max(
-                        (h for h in hist if h[1] <= seq), key=lambda h: h[0]
-                    )
-                    if tuple(best) != choice[key]:
-                        choice[key] = tuple(best)
+                    if _rewind_to_cover(key, seq):
+                        changed = True
+            # live-phase needs: the first seq consumed after the tape ends
+            # must also be regenerated by any co-dead producer
+            for sa, chans in frontier.items():
+                for sch, nxt in chans.items():
+                    key = (sa, sch)
+                    if key not in dead:
+                        continue
+                    if _rewind_to_cover(key, nxt):
                         changed = True
     return choice
 
@@ -605,9 +638,6 @@ class Engine:
                         # boundaries flush it (_flush_spills).
                         self._spill_submit(name, part)
                     self._cache_put(name, part)
-                    with self.store.transaction():
-                        self.store.sadd("NOT", (actor, channel), name)
-                        self.store.tset("PT", name, (actor, channel))
 
     # -- async HBQ spill ------------------------------------------------------
     # The HBQ write used to sit synchronously inside push: a full d2h sync +
@@ -992,7 +1022,6 @@ class Engine:
             self._flush_emits()
             with self.store.transaction():
                 self.store.tset("LIT", (task.actor, task.channel), out_seq - 1)
-                self.store.tset("EST", (task.actor, task.channel), task.state_seq)
                 self.store.sadd("DST", (task.actor, task.channel), "done")
             return True
         plan = self.cache.plan_get(
@@ -1237,10 +1266,14 @@ class Engine:
         self.store.tdel("DST", (a, ch))
         self.store.ntt_remove_channel(a, ch)
         if info.kind == "input":
-            # inputs carry no state: re-derive the remaining tape from GIT
+            # inputs carry no state: re-derive the remaining tape from GIT.
+            # Seqs below the streaming GC floor were committed AND consumed
+            # past every recorded checkpoint frontier before manifest.gc
+            # dropped their GIT/LT rows, so the rebuild starts at the floor.
             last = self.store.tget("LIT", (a, ch), -1)
+            floor = self.store.tget("LT", ("gc_floor", a, ch), 0)
             done = self.store.smembers("GIT", (a, ch))
-            remaining = [s for s in range(last + 1) if s not in done]
+            remaining = [s for s in range(floor, last + 1) if s not in done]
             if remaining:
                 self.store.ntt_push(a, TapedInputTask(a, ch, remaining))
             elif (getattr(info.reader, "UNBOUNDED", False)
@@ -1254,6 +1287,18 @@ class Engine:
         if choice is None:
             choice = self.store.tget("LCT", (a, ch)) or (0, 0, 0)
         state_seq, out_seq, tape_pos = choice
+        tape_base = self.store.tget("LT", ("tape_base", a, ch), 0)
+        if tape_pos < tape_base:
+            # streaming GC trimmed the tape below this recovery point
+            # (manifest.gc trims only below the covering checkpoint of the
+            # retained floor, so a planner choice landing here means the
+            # floor discipline was violated) — fail loudly rather than
+            # replay a silently truncated tape as if it were complete
+            raise RuntimeError(
+                f"recovery of channel ({a}, {ch}) needs tape history from "
+                f"position {tape_pos}, but the tape was trimmed to "
+                f"{tape_base} (streaming GC floor violation)"
+            )
         reqs = {
             s: dict(c)
             for s, c in self.store.tget("IRT", (a, ch, state_seq)).items()
@@ -1433,8 +1478,6 @@ class Engine:
             f"tape replay of ({a},{ch}) reached state {state_seq}, "
             f"expected {task.last_state_seq} — lineage tape diverged"
         )
-        with self.store.transaction():
-            self.store.tset("EST", (a, ch), state_seq)
         if self.g.hbq is not None:
             hbq_names = self._hbq_names_for_target(a, ch)
             specs = {

@@ -16,24 +16,51 @@ NTT/CT/DST/GIT rows colliding; ``drop_namespace(query_id)`` GCs everything a
 finished query wrote.  The view only calls the public store surface, so it
 wraps the embedded store and the RPC client alike.
 
-Table map (name -> role, reference location in pyquokka/tables.py):
-  CT   cemetery: objects safe to GC                      (103)
-  NOT  node -> object names it must keep                  (121)
-  PT   object name -> producing node                      (138)
+Table map (name -> role, reference location in pyquokka/tables.py), annotated
+with the writer/reader/GC matrix the protocol verifier
+(``python -m quokka_tpu.analysis.protocol``) checks.  [W]=who writes,
+[R]=who reads, [GC]=who reclaims; rows with no [GC] are bounded (overwrite
+semantics per (actor, channel) key, or membership bounded by graph size).
+Tables marked *parity* exist for taxonomy parity with the reference but have
+no writers in this implementation (their reference roles are served by the
+device cache / actor objects directly); writing one without adding a reader
+trips protocol rule QK014 (dead write).
+
+  CT   cemetery: objects safe to GC                      (103) *parity*
+  NOT  node -> object names it must keep                  (121) *parity*
+  PT   object name -> producing node                      (138) *parity*
   NTT  (node) -> pending task list                        (152)
+       [W] ntt_push  [R/GC] ntt_pop / ntt_remove_*
   GIT  generated input seqs per (actor, channel)          (170)
+       [W] engine commit  [R] recovery remaining-tape  [GC] manifest.gc
+       (srem below the gc floor; recovery clamps its rebuild range there)
   LT   lineage: (actor, channel, seq) -> lineage payload  (187)
+       plus sub-keyed rows: ("tape", a, ch) event list, ("tape_base", a, ch),
+       ("ckpts", a, ch) checkpoint history, ("gc_floor*", a, ch) markers
+       [W] engine commit/checkpoint  [R] replay + rewind planner
+       [GC] manifest.gc (tdel below floor, tape_trim, history pruning)
   DST  done seqs per (actor, channel)                     (200)
+       [W] engine finish  [R] scontains  [GC] tdel on recovery
   LCT  last checkpoint per (actor, channel)               (214)
-  EST  executor state seq per (actor, channel)            (230)
+       [W] checkpoint txn (QK017: atomic with ckpts+IRT)  [R] planner
+  EST  executor state seq per (actor, channel)            (230) *parity*
   CLT  (actor, channel) -> worker/node location           (243)
-  FOT  actor -> pickled reader/executor object            (257)
+       [W] coordinator placement  [R] worker adoption
+  FOT  actor -> pickled reader/executor object            (257) *parity*
   IRT  input requirements at checkpoints                  (266)
+       [W] checkpoint txn  [R] planner frontier walk  [GC] manifest.gc
   SAT  set of sorted (order-preserving) actors            (278)
+       [W] graph build  [R] smembers (bounded by graph size)
   PFT  (source actor, target actor) -> partition spec     (292)
+       [W] graph build  [R] push path (bounded by graph size)
   AST  actor -> execution stage                           (305)
+       [W] graph build  [R] titems (bounded by graph size)
   LIT  last input seq per (actor, channel)                (318)
+       [W] engine commit  [R] recovery/planner (overwrite, bounded)
   EWT  consumption watermark per (actor, channel)         (332)
+       [W] exec consume  [R] producer throttle (overwrite, bounded)
+  SWM/SWMC/SST stream watermarks + stop flags: SWM is per-seq
+       [W] push  [R] replay  [GC] manifest.gc; SWMC/SST overwrite, bounded
 """
 
 from __future__ import annotations
@@ -241,6 +268,16 @@ class ControlStore:
                 return key in t
             return value in t.get(key, ())
 
+    def srem(self, table: str, key, value=None) -> None:
+        """Discard one member (tolerant, like tdel) — the GC half of sadd
+        for growing sets (GIT seq membership below the streaming gc floor)."""
+        with self._lock:
+            t = self.tables[table]
+            if isinstance(t, set):
+                t.discard(key)
+            elif key in t:
+                t[key].discard(value)
+
     # -- namespaces (multi-query) --------------------------------------------
     def namespace(self, query_id: str) -> "NamespacedStore":
         """A view of this store whose table keys are wrapped
@@ -402,6 +439,9 @@ class NamespacedStore:
 
     def scontains(self, table, key, value=None) -> bool:
         return self._root.scontains(table, self._k(key), value)
+
+    def srem(self, table, key, value=None):
+        return self._root.srem(table, self._k(key), value)
 
     def drop(self) -> int:
         """GC this namespace from the shared store."""

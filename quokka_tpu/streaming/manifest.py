@@ -182,6 +182,125 @@ def update(graph) -> None:
     except OSError as e:
         obs.REGISTRY.counter("stream.manifest_skipped").inc()
         obs.diag(f"[stream] manifest write to {path} skipped: {e!r}")
+        return
+    # a successful manifest write is the durability point: anything below
+    # the recorded-checkpoint floor is now unreachable by every recovery
+    # path, so the control store can finally drop it
+    gc(graph)
+
+
+def gc(graph) -> Dict[str, int]:
+    """Drop control-store rows no RECORDED checkpoint can ask for — the
+    ROADMAP's "SWM/segment-log/tape rows grow unboundedly for very long
+    streams" leftover.  Standing queries only (``update`` calls this after
+    each successful manifest write): the batch engine keeps full lineage
+    because its recovery contract includes the ``(0, 0, 0)`` full-replay
+    fallback, while a standing query's incremental contract already
+    excludes full-stream recompute — resume replays from the recorded
+    checkpoint frontier or fails loudly.
+
+    Floor discipline (protocol rule QK015 checks the write/GC pairing):
+
+    - per SOURCE channel: the min input-requirement frontier over every
+      recorded checkpoint of every consumer, AND the state-0 frontier of
+      any exec channel with no recorded checkpoint yet (its recovery still
+      rewinds to ``(0, 0, 0)``, so nothing is dropped until every channel
+      has checkpointed past warmup);
+    - per EXEC channel: additionally its own oldest recorded checkpoint;
+      the tape is trimmed to the COVERING checkpoint for that floor (the
+      oldest one whose out_seq is at or below it), so every choice the
+      rewind planner can still make has its full tape suffix retained
+      (``_recover_channel`` fails loudly if recovery ever points below the
+      trimmed base).
+
+    Every per-seq growing row class is reclaimed here — segment log rows
+    (LT), watermark rows (SWM), committed-seq membership (GIT, with
+    ``_recover_channel`` clamping its rebuild range at the floor), the
+    lineage tape (trim), checkpoint HISTORY entries older than the covering
+    checkpoint, and their IRT frontier rows — so protocol rule QK015 can
+    demand a GC site for every growth-class write.
+
+    Returns {"segments", "swm", "tape", "git", "ckpts"} dropped counts."""
+    store = graph.store
+    retain: Dict = {}
+    exec_hist: Dict = {}
+    for info in graph.actors.values():
+        if info.kind != "exec":
+            continue
+        for ch in range(info.channels):
+            hist = [tuple(h) for h in
+                    (store.tget("LT", ("ckpts", info.id, ch)) or [])]
+            exec_hist[(info.id, ch)] = hist
+            # a channel with no recorded checkpoint recovers via (0,0,0)
+            states = [h[0] for h in hist] or [0]
+            for state in states:
+                reqs = store.tget("IRT", (info.id, ch, state)) or {}
+                for src, chans_ in reqs.items():
+                    for sch, nxt in chans_.items():
+                        key = (src, sch)
+                        retain[key] = min(retain.get(key, nxt), nxt)
+    dropped = {"segments": 0, "swm": 0, "tape": 0, "git": 0, "ckpts": 0}
+    with store.transaction():
+        # 1) input segment log + watermark trail + committed-seq membership
+        # below the floor
+        for info in _stream_inputs(graph):
+            for ch in range(info.channels):
+                floor = retain.get((info.id, ch))
+                if floor is None:
+                    continue
+                # never drop the NEWEST segment: readers re-derive their
+                # discovery position from the retained tail (same rule as
+                # the manifest's serialization floor above)
+                last = store.tget("LIT", (info.id, ch), -1)
+                floor = min(floor, max(last, 0))
+                base = store.tget("LT", ("gc_floor", info.id, ch), 0)
+                for s in range(base, floor):
+                    store.tdel("LT", (info.id, ch, s))
+                    store.tdel("SWM", (info.id, ch, s))
+                    store.srem("GIT", (info.id, ch), s)
+                    dropped["segments"] += 1
+                    dropped["git"] += 1
+                if floor > base:
+                    store.tset("LT", ("gc_floor", info.id, ch), floor)
+        # 2) exec tapes, replayed-emission watermark rows, and checkpoint
+        # history older than the covering checkpoint (a history entry whose
+        # state precedes the cover can never be chosen by the rewind
+        # planner again: every seq the planner may still need is >= the
+        # floor, and the cover or a newer checkpoint covers it)
+        for (aid, ch), hist in exec_hist.items():
+            if not hist:
+                continue
+            floor = min(h[1] for h in hist)
+            if (aid, ch) in retain:
+                floor = min(floor, retain[(aid, ch)])
+            cover = max((h for h in hist if h[1] <= floor),
+                        key=lambda h: h[0], default=None)
+            if cover is None:
+                continue  # only (0,0,0) covers: nothing is trimmable yet
+            tape_base = store.tget("LT", ("tape_base", aid, ch), 0)
+            if cover[2] > tape_base:
+                dropped["tape"] += cover[2] - tape_base
+                store.tape_trim(aid, ch, cover[2])
+            base = store.tget("LT", ("gc_floor_swm", aid, ch), 0)
+            for s in range(base, cover[1]):
+                store.tdel("SWM", (aid, ch, s))
+                dropped["swm"] += 1
+            if cover[1] > base:
+                store.tset("LT", ("gc_floor_swm", aid, ch), cover[1])
+            keep = [h for h in hist if h[0] >= cover[0]]
+            if len(keep) < len(hist):
+                dropped["ckpts"] += len(hist) - len(keep)
+                # drop-and-reappend (atomic inside this transaction): the
+                # retained suffix survives, the pruned prefix's IRT rows go
+                store.tdel("LT", ("ckpts", aid, ch))
+                for h in keep:
+                    store.tappend("LT", ("ckpts", aid, ch), h)
+                for h in hist:
+                    if h[0] < cover[0]:
+                        store.tdel("IRT", (aid, ch, h[0]))
+    if any(dropped.values()):
+        obs.REGISTRY.counter("stream.gc_rows").inc(sum(dropped.values()))
+    return dropped
 
 
 def load(path: str) -> Dict:

@@ -1,0 +1,8 @@
+"""Known-answer fixture package for the qkflow engine (tests/test_flow.py
+labels these files as the synthetic package ``quokka_tpu.flowfix`` so the
+relative and absolute import forms below resolve; the files are parse-only
+and never imported)."""
+
+from .alpha import helper
+
+helper(0)  # module-scope call site: static-argument propagation sees it

@@ -1,0 +1,41 @@
+"""Callee side: helpers, nested closures (called and escaping), a class
+with self-dispatch, a never-called function, and a static-args target."""
+
+
+def helper(x):
+    return x + 1
+
+
+def outer(xs):
+    def inner(v):
+        return helper(v)
+
+    return [inner(x) for x in xs]
+
+
+def make_adder(n):
+    def add(v):
+        return v + n
+
+    return add  # escapes by reference: runs in the caller's extent
+
+
+class Engine:
+    def __init__(self, k):
+        self.k = k
+
+    def step(self, v):
+        return self._bump(v)
+
+    def _bump(self, v):
+        return helper(v) + self.k
+
+
+def sized(n, flag):
+    if flag:
+        return [0] * n
+    return []
+
+
+def unreached(x):
+    return helper(x)

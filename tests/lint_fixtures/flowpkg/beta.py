@@ -1,0 +1,40 @@
+"""Caller side: every import form the resolver handles — relative module
+binding, from-import alias, absolute import alias — plus a class call,
+a callback reference, and static/tainted call sites for ``sized``."""
+
+import quokka_tpu.flowfix.alpha as qalpha
+
+from . import alpha
+from .alpha import helper as hlp
+
+
+def call_via_module(v):
+    return alpha.helper(v)
+
+
+def call_via_from_alias(v):
+    return hlp(v)
+
+
+def call_via_import_alias(v):
+    return qalpha.outer([v])
+
+
+def build_engine(v):
+    return alpha.Engine(v)
+
+
+def passes_callback(xs):
+    return list(map(local_cb, xs))
+
+
+def local_cb(x):
+    return x
+
+
+def static_caller():
+    return alpha.sized(4, True)
+
+
+def tainted_caller(k):
+    return alpha.sized(k, True)

@@ -1,0 +1,11 @@
+"""Fully-dotted alias chain: ``import pkg.mod`` followed by
+``pkg.mod.f()`` resolves through the root alias."""
+
+import quokka_tpu.flowfix.alpha
+
+
+def dotted_call(v):
+    return quokka_tpu.flowfix.alpha.helper(v)
+
+
+quokka_tpu.flowfix.alpha.sized(8, False)  # module-scope static call site

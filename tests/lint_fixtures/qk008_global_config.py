@@ -1,9 +1,16 @@
 """QK008 fixture: process-global config mutation reachable from query
-execution — each of the three mutation families fires once."""
+execution — each of the three mutation families fires once, through one
+interprocedural hop from the execution surface (a task handler, the push
+path, a jit entry).  Mutations OUTSIDE that surface (module-scope import
+setup, process bootstrap with no inbound call edge) are pre-query and must
+NOT fire."""
 
 import os
 
 import jax
+
+# NOT flagged: import-time setup runs once, before any query exists
+os.environ.setdefault("QUOKKA_FIXTURE_SETUP", "1")
 
 
 def mutate_backend_config(flag):
@@ -20,3 +27,16 @@ def mutate_environment(value):
 def mutate_config_module_global(config, rows):
     # QK008: quokka_tpu.config module globals (spill thresholds, buckets)
     config.SPILL_SORT_ROWS = rows
+
+
+def handle_exec_task(task, config):
+    # the task-dispatch surface: everything it reaches runs mid-query
+    mutate_backend_config(True)
+    mutate_environment("0")
+    mutate_config_module_global(config, 1 << 20)
+
+
+def fixture_main():
+    # NOT flagged: process bootstrap — nothing on the execution surface
+    # calls it, so its mutation has no concurrent neighbor to corrupt
+    jax.config.update("jax_platforms", "cpu")

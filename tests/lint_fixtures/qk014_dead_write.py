@@ -1,0 +1,22 @@
+"""QK014 fixture: control-store writes with no reader, and a per-query key
+escaping the namespace wrapper.  ``well_paired``/``read_back`` are the
+negative case — a written class with a reader must NOT fire."""
+
+
+def record_unread(store, a, ch, digest):
+    # QK014 dead-write: XRT is read nowhere — state nobody replays
+    store.tset("XRT", (a, ch), digest)
+
+
+def leak_raw(root_store, a, ch, payload):
+    # QK014 namespace-escape: per-query lineage on the ROOT store — the
+    # row outlives drop_namespace's sweep (also a dead write here)
+    root_store.tset("LT", (a, ch, 0), payload)
+
+
+def well_paired(store, a, ch, stamp):
+    store.tset("XOK", (a, ch), stamp)
+
+
+def read_back(store, a, ch):
+    return store.tget("XOK", (a, ch))

@@ -1,0 +1,25 @@
+"""QK015 fixture: a per-stream growth class with no in-run GC site.  The
+``WRT`` rows are the negative case — per-seq growth WITH a tdel sweep must
+NOT fire (the pairing manifest.gc provides for the real SWM/LT rows)."""
+
+
+def append_history(store, a, ch, ev):
+    # QK015: append-valued row grows with the stream, nothing reclaims it
+    store.tappend("HGT", (a, ch), ev)
+
+
+def read_history(store, a, ch):
+    return store.tget("HGT", (a, ch))
+
+
+def stamp_row(store, a, ch, seq, wm):
+    store.tset("WRT", (a, ch, seq), wm)
+
+
+def read_row(store, a, ch, seq):
+    return store.tget("WRT", (a, ch, seq))
+
+
+def gc_rows(store, a, ch, floor, base):
+    for s in range(base, floor):
+        store.tdel("WRT", (a, ch, s))

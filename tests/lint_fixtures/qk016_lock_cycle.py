@@ -1,0 +1,33 @@
+"""QK016 fixture: two sanitize-instrumented lock classes whose under-lock
+calls form a held->acquired cycle — the two-lock deadlock precursor the
+runtime recorder reports dynamically."""
+
+import threading
+
+from quokka_tpu.analysis import sanitize
+
+
+class AlphaPlane:
+    def __init__(self, beta):
+        self._lock = sanitize.maybe_instrument("alpha", threading.Lock())
+        self.beta = beta
+
+    def alpha_op(self):
+        # holds alpha while acquiring beta
+        with self._lock:
+            return self.beta.beta_op()
+
+
+class BetaPlane:
+    def __init__(self, alpha):
+        self._lock = sanitize.maybe_instrument("beta", threading.Lock())
+        self.alpha = alpha
+
+    def beta_op(self):
+        with self._lock:
+            return 1
+
+    def beta_cross(self):
+        # holds beta while acquiring alpha: closes the cycle
+        with self._lock:
+            return self.alpha.alpha_op()

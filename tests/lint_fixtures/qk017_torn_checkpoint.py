@@ -1,0 +1,37 @@
+"""QK017 fixture: the checkpoint commit triple (LCT pointer, ckpts history
+entry, IRT frontier) written WITHOUT a wrapping transaction — a crash
+between the halves tears the frontier from its covering history.
+``atomic_commit`` is the negative case and must NOT fire."""
+
+
+def torn_commit(store, a, ch, state_seq, out_seq, tape_len):
+    # QK017: both halves land outside any store.transaction() block
+    store.tset("LCT", (a, ch), (state_seq, out_seq, tape_len))
+    store.tappend("LT", ("ckpts", a, ch), (state_seq, out_seq, tape_len))
+
+
+def atomic_commit(store, a, ch, state_seq, out_seq, tape_len, reqs):
+    with store.transaction():
+        store.tset("LCT", (a, ch), (state_seq, out_seq, tape_len))
+        store.tappend("LT", ("ckpts", a, ch),
+                      (state_seq, out_seq, tape_len))
+        store.tset("IRT", (a, ch, state_seq), reqs)
+
+
+def read_back(store, a, ch, state_seq):
+    return (store.tget("LCT", (a, ch)),
+            store.tget("LT", ("ckpts", a, ch)),
+            store.tget("IRT", (a, ch, state_seq)))
+
+
+def prune_history(store, a, ch, floor_state):
+    # in-run GC for the growth classes this fixture writes (keeps the
+    # fixture pure-QK017: no QK015 noise)
+    hist = [h for h in (store.tget("LT", ("ckpts", a, ch)) or [])
+            if h[0] >= floor_state]
+    with store.transaction():
+        # drop-and-reappend rewrite: exempt from the commit-triple check
+        store.tdel("LT", ("ckpts", a, ch))
+        for h in hist:
+            store.tappend("LT", ("ckpts", a, ch), h)
+        store.tdel("IRT", (a, ch, floor_state))

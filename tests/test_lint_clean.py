@@ -1,27 +1,51 @@
-"""Tier-1 lint gate: the shipped tree is clean against the checked-in
-baseline, and the baseline itself is healthy (no stale entries, every entry
-carries a real rationale).  This is the gate every later PR runs under —
-new invariant violations fail here; the baseline may only shrink."""
+"""Tier-1 static-analysis gate: the shipped tree is clean against the
+checked-in lint baseline, the baseline itself is healthy (no stale entries,
+every entry carries a real rationale), the control-store protocol verifier
+(QK014-QK017) is clean with NO baseline, and the whole lint pass fits the
+wall-time budget.  This is the gate every later PR runs under — new
+invariant violations fail here; the lint baseline may only shrink."""
 
 import json
 import os
+import time
 
 from quokka_tpu.analysis.lint import (
     DEFAULT_BASELINE,
     load_baseline,
     run_lint,
 )
+from quokka_tpu.analysis.protocol import verify as protocol_verify
 
 PKG = os.path.dirname(os.path.dirname(os.path.abspath(DEFAULT_BASELINE)))
 assert os.path.basename(PKG) == "quokka_tpu", PKG
 
+# Full-package lint wall-time budget.  The lint pass runs in every tier-1
+# invocation and in `make verify-static`; an interprocedural rule that
+# regresses to quadratic blows this long before it blows CI.
+LINT_BUDGET_S = 20.0
 
-def test_package_is_clean_against_baseline():
+
+def test_package_is_clean_against_baseline_within_budget():
+    t0 = time.monotonic()
     findings = run_lint([PKG])
+    elapsed = time.monotonic() - t0
     baseline = load_baseline(DEFAULT_BASELINE)
     new = [f for f in findings if f.key() not in baseline]
     assert not new, "new lint findings (fix or baseline with rationale):\n" \
         + "\n".join(f.render() for f in new)
+    assert elapsed < LINT_BUDGET_S, (
+        f"lint pass took {elapsed:.1f}s (budget {LINT_BUDGET_S}s) — an "
+        "interprocedural rule has regressed")
+
+
+def test_protocol_verifier_is_clean():
+    """QK014-QK017 run with NO baseline: a dead store write, an un-GC'd
+    growth class, a lock-order cycle, or a torn checkpoint commit fails
+    tier-1 outright — fix the code, don't suppress."""
+    findings, ops = protocol_verify([PKG])
+    assert not findings, "protocol violations (no baseline for these):\n" \
+        + "\n".join(f.render() for f in findings)
+    assert len(ops) > 100, "protocol verifier lost its site inventory"
 
 
 def test_baseline_has_no_stale_entries():

@@ -1,0 +1,79 @@
+"""Deterministic-schedule explorer (analysis/schedex.py).
+
+The explorer is the static-analysis-plane record of the TestKill9Recovery
+root cause: under the OLD covering rule some seeded interleavings wedge
+(a co-dead consumer's live-phase frontier need past every surviving copy),
+and under the SHIPPED frontier rule (engine.plan_rewinds) none do."""
+
+import pytest
+
+from quokka_tpu.analysis.schedex import (
+    explore, main, minimize, run_schedule)
+
+SEEDS = 300
+
+
+@pytest.fixture(scope="module")
+def covering_wedges():
+    return explore("covering", SEEDS)
+
+
+def test_old_rule_wedges(covering_wedges):
+    """The bug is reachable: the covering rule leaves wedging schedules."""
+    assert covering_wedges, "explorer lost the repro"
+
+
+def test_shipped_rule_never_wedges():
+    assert explore("frontier", SEEDS) == []
+
+
+def test_same_seed_same_schedule(covering_wedges):
+    seed, r = covering_wedges[0]
+    again = run_schedule(seed, "covering")
+    assert again.trace == r.trace
+    assert again.detail == r.detail
+    assert again.wedged
+
+
+def test_wedging_trace_passes_under_shipped_rule(covering_wedges):
+    """The SAME interleaving that wedges under the old rule completes under
+    the shipped one — the fix, not schedule luck, closes the race."""
+    _seed, r = covering_wedges[0]
+    replay = run_schedule(None, "frontier", trace=r.trace)
+    assert not replay.wedged, replay.detail
+
+
+def test_minimize_is_one_minimal(covering_wedges):
+    _seed, r = covering_wedges[0]
+    mini = minimize(r.trace, "covering")
+    assert run_schedule(None, "covering", trace=mini).wedged
+    assert len(mini) <= len(r.trace)
+    # 1-minimal: removing ANY single action un-wedges
+    for i in range(len(mini)):
+        cand = mini[:i] + mini[i + 1:]
+        assert not run_schedule(None, "covering", trace=cand).wedged, (
+            i, mini)
+    # the minimal schedule names the protocol steps, and the kill/recover
+    # pair is always part of the story
+    verbs = [a[0] for a in mini]
+    assert "kill" in verbs and "recover" in verbs
+
+
+def test_minimal_repro_passes_under_shipped_rule(covering_wedges):
+    _seed, r = covering_wedges[0]
+    mini = minimize(r.trace, "covering")
+    assert not run_schedule(None, "frontier", trace=mini).wedged
+
+
+def test_cli(capsys):
+    # compare-both mode: informative about the old rule, clean shipped rule
+    assert main(["--seeds", "80"]) == 0
+    out = capsys.readouterr().out
+    assert "rule=covering" in out and "rule=frontier: 0/80" in out
+    # replaying a wedging seed exits nonzero and prints the trace
+    wedges = explore("covering", SEEDS)
+    seed = wedges[0][0]
+    assert main(["--seed", str(seed), "--rule", "covering"]) == 1
+    out = capsys.readouterr().out
+    assert "WEDGED" in out and "kill" in out
+    assert main(["--seed", str(seed), "--rule", "frontier"]) == 0

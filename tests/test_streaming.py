@@ -347,6 +347,89 @@ class TestStandingQueryService:
         self._run(tmp_path, chaos="seed=5,kill=2,kill_after=5")
         assert obs.REGISTRY.snapshot().get("chaos.kill", 0) > before
 
+    def test_long_stream_gc_reclaims_control_rows(self, tmp_path):
+        """Dynamic half of protocol rule QK015: on a long standing query the
+        per-seq control rows (segment log, watermarks, committed-seq
+        membership, exec tape, checkpoint history) are reclaimed below the
+        recorded-checkpoint floor while the retained tail stays intact —
+        and the result is still bit-exact."""
+        from quokka_tpu import QuokkaContext, obs
+        from quokka_tpu.service import QueryService
+        from quokka_tpu.streaming import manifest as smanifest
+
+        rng = np.random.default_rng(17)
+        n = 4000
+        df = pd.DataFrame({
+            "t": np.sort(rng.integers(0, 1000, n)),
+            "k": rng.integers(0, 4, n),
+            "v": rng.integers(0, 50, n).astype(np.float64),
+        })
+        rows = [f"{r.t},{r.k},{r.v}\n" for r in df.itertuples(index=False)]
+        p = str(tmp_path / "events.csv")
+        with open(p, "w") as f:
+            f.writelines(rows[:200])
+        before = obs.REGISTRY.snapshot().get("stream.gc_rows", 0)
+        svc = QueryService(pool_size=2, spill_dir=str(tmp_path / "spill"),
+                           exec_config={"fault_tolerance": True,
+                                        "checkpoint_interval": 1})
+        ctx = QuokkaContext()
+        ds = tail_window_agg(
+            ctx, TailingCsvReader(p, EV_SCHEMA, "t"), size=100, by="k",
+            aggs=[("s", "sum", "v"), ("n", "count", None)])
+        h = svc.submit_continuous(ds)
+        deltas, appended, t0 = [], 200, time.time()
+        while time.time() - t0 < 40:
+            if appended < n:  # many small segments -> a long segment log
+                with open(p, "a") as f:
+                    f.writelines(rows[appended:appended + 100])
+                appended += 100
+            deltas.extend(h.poll_deltas())
+            wm = h.watermark()
+            if appended >= n and wm is not None and wm >= float(df.t.max()):
+                break
+            time.sleep(0.04)
+        # session still live: run one final sweep and audit the store
+        graph = h._s.graph
+        store = graph.store
+        smanifest.gc(graph)
+        floors = {}
+        for info in smanifest._stream_inputs(graph):
+            for ch in range(info.channels):
+                floor = store.tget("LT", ("gc_floor", info.id, ch), 0)
+                floors[(info.id, ch)] = floor
+                done = store.smembers("GIT", (info.id, ch))
+                for s in range(floor):  # everything below the floor is gone
+                    assert store.tget("LT", (info.id, ch, s)) is None
+                    assert store.tget("SWM", (info.id, ch, s)) is None
+                    assert s not in done
+                last = store.tget("LIT", (info.id, ch), -1)
+                if last >= 0:  # the newest segment is never dropped
+                    assert store.tget("LT", (info.id, ch, last)) is not None
+        assert any(f > 0 for f in floors.values()), \
+            "gc floor never advanced on a long checkpointed stream"
+        pruned_hist = trimmed_tape = False
+        for info in graph.actors.values():
+            if info.kind != "exec":
+                continue
+            for ch in range(info.channels):
+                hist = [tuple(x) for x in
+                        (store.tget("LT", ("ckpts", info.id, ch)) or [])]
+                if not hist:
+                    continue
+                base = store.tget("LT", ("tape_base", info.id, ch), 0)
+                trimmed_tape = trimmed_tape or base > 0
+                # history is a suffix: everything older than the covering
+                # checkpoint was dropped, and the IRT rows went with it
+                pruned_hist = pruned_hist or hist[0][0] > 1
+                assert [x[0] for x in hist] == sorted(x[0] for x in hist)
+        assert trimmed_tape, "no exec tape was ever trimmed"
+        assert pruned_hist, "checkpoint history never pruned"
+        assert obs.REGISTRY.snapshot().get("stream.gc_rows", 0) > before
+        h.stop(timeout=60)
+        deltas.extend(h.poll_deltas())
+        _assert_exact(_merge_deltas(deltas), _truth(df))
+        svc.shutdown()
+
     def test_manifest_resume_after_service_teardown(self, tmp_path):
         from quokka_tpu import QuokkaContext
         from quokka_tpu.service import QueryService
