@@ -4,7 +4,7 @@
 PY ?= python
 export JAX_PLATFORMS ?= cpu
 
-.PHONY: lint lint-baseline verify-static test test-slow sanitize-demo service-smoke chaos-smoke obs-smoke bench-check shuffle-smoke warmup-smoke multichip-smoke stream-smoke
+.PHONY: lint lint-baseline verify-static test test-slow sanitize-demo service-smoke chaos-smoke obs-smoke bench-check shuffle-smoke warmup-smoke multichip-smoke stream-smoke mem-smoke
 
 # engine-invariant static analysis; exits nonzero on findings beyond the
 # checked-in baseline (quokka_tpu/analysis/baseline.json)
@@ -100,6 +100,14 @@ multichip-smoke:
 # replaying only the post-frontier segment tail (never the whole stream)
 stream-smoke:
 	$(PY) -m quokka_tpu.streaming.smoke
+
+# memory-plane smoke: a Q3-shaped service query must GC with ZERO leaked
+# ledger entries, the device-buffer ledger must reconcile with
+# jax.live_arrays() within QK_MEM_RECONCILE (10%), and a second submission
+# of the same plan must be admitted on the MEASURED footprint persisted
+# under the plan fingerprint, not the size_hint() guess
+mem-smoke:
+	$(PY) -m quokka_tpu.obs.mem_smoke
 
 # chaos plane soak: >= 20 seeded mixed-fault runs (RPC drops/delays, flaky
 # store calls, worker kills, spill + checkpoint corruption) each asserting

@@ -487,12 +487,18 @@ def measure(paths):
         return {k: snap.get(k, 0) for k in
                 ("shuffle.bytes", "shuffle.host_syncs", "shuffle.spill_bytes")}
 
+    from quokka_tpu.obs import memplane
+
     for qname, fn in QUERIES.items():
         ref = REF_SECONDS_SF100_4W[qname] * 4.0 / 100.0 * SF
         obs_spans.reset()
         kstrategy.reset_used()
         c0 = compilestats.snapshot()
         sh0 = _shuffle_snap()
+        # memory plane: peak resets to current live before the query, so
+        # detail.memory reports THIS query's high-water mark, not the
+        # session's
+        memplane.LEDGER.reset_peak()
         warm = fn(paths)  # compiles the kernel set for this query shape
         extra = {}
         if qname == "q1":
@@ -590,6 +596,14 @@ def measure(paths):
             "cache_hits_warmup": c1["cache_hits"] - c0["cache_hits"],
             "breakdown": breakdown,
             "shuffle": shuffle_detail,
+            # memory-ledger footprint across warmup + timed runs: device
+            # high-water mark and spill-bytes delta (obs/memplane.py);
+            # `--check` gates peak_bytes growth like warmup_seconds
+            "memory": {
+                "peak_bytes": int(memplane.LEDGER.peak_bytes()),
+                "spill_bytes": int(sh2["shuffle.spill_bytes"]
+                                   - sh0["shuffle.spill_bytes"]),
+            },
             # the kernel family each strategy-dispatched operator actually
             # executed during this query (ops/strategy.note_used)
             "strategy": kstrategy.used_snapshot(),
@@ -1007,6 +1021,73 @@ def check_warmup_gates(base, cur, current_not_comparable=False):
     return rows, regressed
 
 
+# Memory gates (lower-is-better, from per-query detail.memory): relative
+# growth allowed plus absolute slack.  64 MiB of slack absorbs allocator /
+# padding-bucket wobble on small scale factors while a genuine doubling of
+# a query's device high-water mark still trips.
+MEMORY_GATES = {
+    "peak_bytes": (0.5, 64 << 20),
+}
+
+
+def _memory_details(metrics):
+    """{qname: {peak_bytes}} from a bench metric map — same sourcing rules
+    as _warmup_details (geomean nested details first, per-query lines as
+    fallback)."""
+    out = {}
+    for d in metrics.values():
+        detail = d.get("detail") or {}
+        queries = detail.get("queries")
+        if isinstance(queries, dict):
+            for q, qd in queries.items():
+                mem = (qd or {}).get("memory") or {}
+                for k in MEMORY_GATES:
+                    if mem.get(k) is not None:
+                        out.setdefault(q, {})[k] = float(mem[k])
+    if out:
+        return out
+    for metric, d in metrics.items():
+        if not metric.startswith("tpch_q"):
+            continue
+        q = metric.split("_")[1]
+        mem = (d.get("detail") or {}).get("memory") or {}
+        for k in MEMORY_GATES:
+            if mem.get(k) is not None:
+                out.setdefault(q, {})[k] = float(mem[k])
+    return out
+
+
+def check_memory_gates(base, cur, current_not_comparable=False):
+    """Per-query peak-memory regression rows — the warmup-gate machinery
+    applied to detail.memory (lower-is-better; MISSING = regression, since
+    a vanished memory detail is how a footprint regression would hide).
+    Baselines recorded before the memory plane existed carry no
+    detail.memory and gate nothing."""
+    b_m, c_m = _memory_details(base), _memory_details(cur)
+    rows, regressed = [], []
+    for q in sorted(b_m):
+        for k, (thr, slack) in MEMORY_GATES.items():
+            if k not in b_m[q]:
+                continue
+            name = f"memory[{q}].{k}"
+            b = b_m[q][k]
+            c = (c_m.get(q) or {}).get(k)
+            if c is None:
+                if current_not_comparable:
+                    rows.append((name, b, None, None, None, "not-run"))
+                else:
+                    rows.append((name, b, None, None, thr, "MISSING"))
+                    regressed.append(name)
+                continue
+            bad = c > b * (1.0 + thr) + slack
+            delta = (c - b) / b if b else None
+            rows.append((name, b, c, delta, thr,
+                         "REGRESSED" if bad else "ok"))
+            if bad:
+                regressed.append(name)
+    return rows, regressed
+
+
 def check_regressions(base, cur, threshold=None, not_run_prefixes=()):
     """Compare {metric: line} maps; returns (report_rows, regressed_list).
     A metric present in the baseline but missing from the current run
@@ -1125,6 +1206,10 @@ def check_main(argv):
     w_rows, w_regressed = check_warmup_gates(
         base, cur, current_not_comparable=bool(not_run_prefixes == ("",)))
     regressed += w_regressed
+    # peak-memory gates (lower-is-better, same truncation rules)
+    m_rows, m_regressed = check_memory_gates(
+        base, cur, current_not_comparable=bool(not_run_prefixes == ("",)))
+    regressed += m_regressed
     # bench honesty: recorded strategies must be runnable on the bench
     # platform; fresh runs must record them on the join/asof lines (a
     # truncated --current tail cannot carry details, so presence is only
@@ -1146,7 +1231,7 @@ def check_main(argv):
                   f"{d_s:>8} {t_s}\n")
         if status == "REGRESSED":
             _print_critpath_diff(metric, base[metric], cur[metric], out)
-    for metric, b, c, delta, thr, status in w_rows:
+    for metric, b, c, delta, thr, status in w_rows + m_rows:
         b_s = f"{b:.4f}" if b is not None else "-"
         c_s = f"{c:.4f}" if c is not None else "-"
         d_s = f"{delta:+.1%}" if delta is not None else "-"

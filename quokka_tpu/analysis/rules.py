@@ -60,6 +60,15 @@ Rule ids:
                                 or record, which is exactly how the bench
                                 came to measure a path the target backend
                                 never runs (VERDICT r5 #2)
+  QK018 unledgered-device-alloc eager device allocations (jax.device_put,
+                                jnp.* array constructors on non-traced
+                                paths) in runtime/executors/streaming/
+                                service code — residency created outside
+                                the ledgered choke points (bridge + caches
+                                + HBQ) is invisible to the memory ledger
+                                (obs/memplane.py), so per-query footprints
+                                and OOM forensics under-report exactly the
+                                allocation that mattered
 
 Finding keys (``Finding.key``) are line-number-free — ``rule::relpath::
 scope::snippet[::n]`` — so a baseline survives unrelated edits above the
@@ -1321,6 +1330,99 @@ def check_platform_gate(tree: ast.Module, path: str, rel: str,
     return out
 
 
+# ---------------------------------------------------------------------------
+# QK018 — eager device allocations outside the ledgered choke points
+# ---------------------------------------------------------------------------
+
+# where the rule applies: the code that creates device/host residency the
+# memory ledger must see (obs/memplane.py).  ops/ is exempt — the bridge
+# and kernels are themselves the ledgered helpers — as are tests.
+_QK018_SCOPED_DIRS = ("quokka_tpu/runtime/", "quokka_tpu/executors/",
+                      "quokka_tpu/streaming/", "quokka_tpu/service/")
+_QK018_CONSTRUCTORS = {
+    "array", "asarray", "zeros", "ones", "full", "arange", "empty",
+    "linspace", "zeros_like", "ones_like", "full_like", "empty_like",
+}
+_QK018_JNP_BASES = ("jnp", "jax.numpy")
+
+
+def _qk018_traced_functions(tree: ast.Module) -> List[ast.AST]:
+    """Function nodes whose bodies trace under jit — decorated with a jit
+    maker (directly or via functools.partial), or wrapped by a ``jit(fn)``
+    call anywhere in the module.  ``jnp`` constructors there are lazy
+    tracer ops the compiler fuses, not eager device allocations."""
+    jit_wrapped: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _is_jit_maker(_dotted(node.func)):
+            for a in node.args[:1]:
+                if isinstance(a, ast.Name):
+                    jit_wrapped.add(a.id)
+    out: List[ast.AST] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if node.name in jit_wrapped:
+            out.append(node)
+            continue
+        for dec in node.decorator_list:
+            target = dec.func if isinstance(dec, ast.Call) else dec
+            d = _dotted(target) or ""
+            if _is_jit_maker(d):
+                out.append(node)
+                break
+            if (d.rsplit(".", 1)[-1] == "partial"
+                    and isinstance(dec, ast.Call) and dec.args
+                    and _is_jit_maker(_dotted(dec.args[0]))):
+                out.append(node)
+                break
+    return out
+
+
+def check_unledgered_device_alloc(tree: ast.Module, path: str, rel: str,
+                                  src_lines: Sequence[str]) -> List[Finding]:
+    """Flags eager device allocations — ``jax.device_put`` and ``jnp.*``
+    array constructors on non-traced paths — in runtime/executors/
+    streaming/service code.  Device residency must be created through the
+    ledgered choke points (ops/bridge, BatchCache, ScanCache, HBQ) so the
+    memory ledger (obs/memplane.py) accounts for it; a raw allocation here
+    is bytes the per-query footprints, the OOM forensics bundle and
+    measured admission never see.  Deliberate small allocations baseline
+    with a rationale (shrink-only contract)."""
+    r = rel.replace("\\", "/")
+    base = r.rsplit("/", 1)[-1]
+    if not (any(d in r for d in _QK018_SCOPED_DIRS)
+            or base.startswith("qk018")):
+        return []
+    exempt: Set[int] = set()
+    for fn in _qk018_traced_functions(tree):
+        for sub in ast.walk(fn):
+            exempt.add(id(sub))
+    out: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or id(node) in exempt:
+            continue
+        name = _dotted(node.func)
+        if name is None:
+            continue
+        head, _, attr = name.rpartition(".")
+        hit = None
+        if attr == "device_put" and head in ("jax", ""):
+            hit = f"'{name}(...)'"
+        elif attr in _QK018_CONSTRUCTORS and head in _QK018_JNP_BASES:
+            hit = f"array constructor '{name}(...)'"
+        if hit is None:
+            continue
+        out.append(_mk(
+            "QK018", "unledgered-device-alloc", path, rel, node,
+            _scope_of(tree, node),
+            f"eager device allocation {hit} outside the ledgered choke "
+            "points — this residency is invisible to the memory ledger "
+            "(obs/memplane.py): route it through the bridge/cache/HBQ "
+            "helpers that LEDGER.track() it, or baseline with a rationale",
+            src_lines))
+    return out
+
+
 RULES = (
     check_module_level_jit,
     check_import_time_side_effects,
@@ -1335,6 +1437,7 @@ RULES = (
     check_push_path_host_sync,
     check_raw_len_cache_key,
     check_platform_gate,
+    check_unledgered_device_alloc,
 )
 
 

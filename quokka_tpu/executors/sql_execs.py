@@ -435,6 +435,14 @@ class BuildProbeJoinExecutor(Executor):
             payload = [self.rename.get(c, c) for c in payload]
         self.payload = payload
         self.build = b
+        # build-side hash state is the largest single device residency a
+        # join pins; ledger it (query attribution happens at graph level —
+        # executors do not know their query id) and retire in done()
+        from quokka_tpu.obs import memplane
+        from quokka_tpu.runtime.cache import _batch_nbytes
+
+        memplane.LEDGER.track(("join_build", id(self)), memplane.SITE_BUILD,
+                              _batch_nbytes(b))
         self.build_unique = join_ops.build_keys_unique(b, self.right_on)
         # the strategy that will serve every probe batch of this build is
         # decided here — stamp it into the flight timeline so critpath /
@@ -569,6 +577,12 @@ class BuildProbeJoinExecutor(Executor):
                         o = inner._probe([chunk])
                         if o is not None and o.count_valid() > 0:
                             yield o
+                # each partition's build state dies with its inner executor
+                # — retire its ledger entry so a high-fanout grace join does
+                # not read as fanout simultaneous build residencies
+                from quokka_tpu.obs import memplane
+
+                memplane.LEDGER.retire(("join_build", id(inner)))
         finally:
             if self._spill_dir is not None:
                 _drop_spill_dir(self._spill_dir)
@@ -587,6 +601,9 @@ class BuildProbeJoinExecutor(Executor):
         return None
 
     def done(self, channel):
+        from quokka_tpu.obs import memplane
+
+        memplane.LEDGER.retire(("join_build", id(self)))
         if self._disk:
             return self._disk_join()
         return None

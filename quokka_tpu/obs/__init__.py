@@ -39,7 +39,15 @@ from __future__ import annotations
 import contextlib
 import sys
 
-from quokka_tpu.obs import critpath, export, merge, metrics, recorder, spans
+from quokka_tpu.obs import (
+    critpath,
+    export,
+    memplane,
+    merge,
+    metrics,
+    recorder,
+    spans,
+)
 from quokka_tpu.obs.merge import (
     dump_flight,
     merge_streams,

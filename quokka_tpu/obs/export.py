@@ -64,6 +64,13 @@ _LABEL_FAMILIES: Tuple[Tuple[str, str, str, str], ...] = (
      "query"),
     ("gauge", "stream.watermark_lag_s.", "quokka_stream_watermark_lag_seconds",
      "query"),
+    # memory plane (obs/memplane.py): per-query footprint gauges GC'd with
+    # the namespace, plus per-site-class residency
+    ("gauge", "mem.live_bytes.", "quokka_mem_live_bytes", "query"),
+    ("gauge", "mem.peak_bytes.", "quokka_mem_peak_bytes", "query"),
+    ("gauge", "mem.spill_resident_bytes.", "quokka_mem_spill_resident_bytes",
+     "query"),
+    ("gauge", "mem.site_bytes.", "quokka_mem_site_bytes", "site"),
 )
 
 # Aggregate instruments that ALSO exist as a labeled per-query family: the
@@ -82,6 +89,10 @@ _EXACT_FAMILIES: Dict[Tuple[str, str], str] = {
     ("counter", "stream.late_dropped"): "quokka_stream_late_dropped_all",
     ("gauge", "stream.watermark_lag_s"):
         "quokka_stream_watermark_lag_all_seconds",
+    ("gauge", "mem.live_bytes"): "quokka_mem_live_bytes_all",
+    ("gauge", "mem.peak_bytes"): "quokka_mem_peak_bytes_all",
+    ("gauge", "mem.spill_resident_bytes"):
+        "quokka_mem_spill_resident_bytes_all",
 }
 
 

@@ -1,0 +1,193 @@
+"""Memory-plane smoke: the ledger accounts, reconciles, and feeds admission.
+
+    python -m quokka_tpu.obs.mem_smoke          (or: make mem-smoke)
+
+One process, three proofs over a seeded Q3-shaped join+aggregate submitted
+through the QueryService:
+
+1. **clean GC** — after the query finishes, the ledger holds ZERO entries
+   charged to its query id (no MemLeakError, ``mem.leaked`` counter flat),
+   the finish-time footprint snapshot shows a nonzero measured peak, and
+   the per-query gauges are gone from the registry (no resurrection);
+2. **reconciliation** — a controlled post-GC device transfer (bridge +
+   BatchCache, the ledgered choke points) must agree with what
+   ``jax.live_arrays()`` actually reports, within ``QK_MEM_RECONCILE``
+   (default 10%), both measured as deltas from ``set_baseline()``;
+3. **measured admission** — a second submission of the SAME plan must be
+   charged the measured ``peak_bytes`` persisted under the plan
+   fingerprint, not the reader ``size_hint()`` guess the first run used.
+
+Exit nonzero on any violation, with the observed figures printed.
+"""
+
+from __future__ import annotations
+
+import gc
+import os
+import sys
+import tempfile
+
+
+def _make_tables(tmp: str, seed: int = 20260805):
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    r = np.random.default_rng(seed)
+    n_fact, n_dim = 200_000, 20_000
+    fact = pa.table({
+        "fk": r.integers(0, n_dim, n_fact).astype(np.int64),
+        "v": r.integers(0, 1000, n_fact).astype(np.int64),
+        "flag": r.integers(0, 4, n_fact).astype(np.int64),
+    })
+    dim = pa.table({
+        "pk": np.arange(n_dim, dtype=np.int64),
+        "grp": r.integers(0, 64, n_dim).astype(np.int64),
+    })
+    fp = os.path.join(tmp, "fact.parquet")
+    dp = os.path.join(tmp, "dim.parquet")
+    pq.write_table(fact, fp, row_group_size=1 << 16)
+    pq.write_table(dim, dp)
+    return fp, dp
+
+
+def _query(ctx, fp, dp):
+    from quokka_tpu.expression import col
+
+    fact = ctx.read_parquet(fp)
+    dim = ctx.read_parquet(dp)
+    return (
+        fact.filter(col("flag") < 3)
+        .join(dim, left_on="fk", right_on="pk")
+        .groupby("grp")
+        .agg_sql("sum(v) as sv, count(*) as n")
+    )
+
+
+def _reconcile_proof(tolerance: float):
+    """Controlled residency through the ledgered choke points vs jax's own
+    live-array accounting.  The transfer shape is warmed FIRST so the
+    baseline window contains data buffers only, not freshly-baked jit
+    constants."""
+    import numpy as np
+    import pyarrow as pa
+
+    from quokka_tpu.obs import memplane
+    from quokka_tpu.ops import bridge
+    from quokka_tpu.runtime.cache import BatchCache, _batch_nbytes
+
+    r = np.random.default_rng(7)
+    table = pa.table({
+        "a": r.integers(0, 1 << 40, 300_000).astype(np.int64),
+        "b": r.standard_normal(300_000),
+    })
+    warm = bridge.arrow_to_device(table)  # compiles the pack kernels
+    del warm
+    gc.collect()
+
+    memplane.LEDGER.set_baseline()
+    batch = bridge.arrow_to_device(table)
+    cache = BatchCache(owner="memsmoke")
+    name = (0, 0, 0, 1, 0, 0)
+    cache.put(name, batch)
+    rec = memplane.LEDGER.reconcile(tolerance=tolerance)
+    tracked = _batch_nbytes(batch)
+    cache.gc([name])
+    memplane.LEDGER.drop_query("memsmoke")
+    del batch
+    gc.collect()
+    return rec, tracked
+
+
+def main() -> int:
+    from quokka_tpu import QuokkaContext, obs
+    from quokka_tpu.obs import memplane
+    from quokka_tpu.service import QueryService
+
+    profile_dir = tempfile.mkdtemp(prefix="qk-memprofile-")
+    saved = os.environ.get("QK_MEMPROFILE_DIR")
+    os.environ["QK_MEMPROFILE_DIR"] = profile_dir
+    try:
+        with tempfile.TemporaryDirectory(prefix="qk-mem-smoke-") as tmp:
+            fp, dp = _make_tables(tmp)
+            leaked0 = obs.REGISTRY.snapshot().get("mem.leaked", 0)
+            with QueryService(pool_size=2) as svc:
+                h1 = svc.submit(_query(QuokkaContext(), fp, dp))
+                rows = h1.to_arrow(timeout=600)
+                assert rows.num_rows > 0, "smoke query returned no rows"
+                qid = h1.query_id
+                est1 = h1._s.est_bytes
+                plan_fp = h1._s.graph.plan_fp
+
+                # -- proof 1: clean GC ------------------------------------
+                mem = h1.memory_stats()
+                snap = obs.REGISTRY.snapshot()
+                leaked = snap.get("mem.leaked", 0) - leaked0
+                entries = memplane.LEDGER.entry_count(qid)
+                print(f"mem-smoke: query {qid} peak_bytes="
+                      f"{mem['peak_bytes']} live_after_gc="
+                      f"{memplane.LEDGER.live_bytes(qid)} "
+                      f"leaked_entries={leaked} ledger_entries={entries}")
+                if mem["peak_bytes"] <= 0:
+                    print("mem-smoke: FAIL — finish-time footprint shows "
+                          "zero peak; the runtime tracked nothing",
+                          file=sys.stderr)
+                    return 1
+                if leaked or entries:
+                    print(f"mem-smoke: FAIL — {leaked} leaked / {entries} "
+                          f"surviving ledger entries after namespace GC",
+                          file=sys.stderr)
+                    return 1
+                if f"mem.live_bytes.{qid}" in snap:
+                    print("mem-smoke: FAIL — per-query memory gauges "
+                          "survived the namespace GC", file=sys.stderr)
+                    return 1
+
+                # -- proof 2: ledger vs jax.live_arrays -------------------
+                tol = memplane.reconcile_tolerance()
+                rec, tracked = _reconcile_proof(tol)
+                print(f"mem-smoke: reconcile ledger={rec['ledger_bytes']} "
+                      f"jax={rec['jax_bytes']} drift="
+                      f"{rec['drift_frac']:.4f} (tol {tol:.2f}, "
+                      f"tracked_batch={tracked})")
+                if rec["available"] and not rec["within"]:
+                    print(f"mem-smoke: FAIL — ledger drifts "
+                          f"{rec['drift_frac']:.1%} from jax.live_arrays() "
+                          f"(tolerance {tol:.0%})", file=sys.stderr)
+                    return 1
+
+                # -- proof 3: measured admission --------------------------
+                measured = memplane.measured_footprint(plan_fp)
+                if not measured:
+                    print(f"mem-smoke: FAIL — no measured footprint "
+                          f"persisted for plan {plan_fp!r} under "
+                          f"{profile_dir}", file=sys.stderr)
+                    return 1
+                h2 = svc.submit(_query(QuokkaContext(), fp, dp))
+                est2 = h2._s.est_bytes
+                h2.result(timeout=600)
+                print(f"mem-smoke: admission est first={est1} "
+                      f"second={est2} measured={measured}")
+                if est2 != max(int(measured), 1 << 20):
+                    print(f"mem-smoke: FAIL — second admission charged "
+                          f"{est2}, expected the measured footprint "
+                          f"{measured}", file=sys.stderr)
+                    return 1
+                if est2 >= est1:
+                    print(f"mem-smoke: FAIL — measured admission ({est2}) "
+                          f"did not beat the size_hint estimate ({est1}) "
+                          "on this deliberately tiny plan",
+                          file=sys.stderr)
+                    return 1
+    finally:
+        if saved is None:
+            os.environ.pop("QK_MEMPROFILE_DIR", None)
+        else:
+            os.environ["QK_MEMPROFILE_DIR"] = saved
+    print("mem-smoke: OK — clean GC, ledger reconciles with jax, second "
+          "admission used the measured footprint")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -73,6 +73,12 @@ class BatchCache:
             self._bytes[name] = nb
             self._total_bytes += nb
             self._index[(tgt_actor, tgt_ch)][(src_actor, src_ch)].add(seq)
+        # memory ledger OUTSIDE the cache lock (the _account_plan
+        # discipline); track replaces on dedup exactly as the dict did
+        from quokka_tpu.obs import memplane
+
+        memplane.LEDGER.track(("cache", id(self), name),
+                              memplane.SITE_SHUFFLE, nb, query=self.owner)
 
     def puttable(self) -> bool:
         with self._lock:
@@ -208,16 +214,33 @@ class BatchCache:
             return self._data.get(name)
 
     def gc(self, names: Sequence[Tuple]) -> None:
+        removed = []
         with self._lock:
             for name in names:
                 self._data.pop(name, None)
                 nb = self._bytes.pop(name, None)
                 if nb is not None:
                     self._total_bytes -= nb
+                    removed.append(name)
                 src_actor, src_ch, seq, tgt_actor, _, tgt_ch = name
                 chans = self._index.get((tgt_actor, tgt_ch))
                 if chans is not None:
                     chans[(src_actor, src_ch)].discard(seq)
+        from quokka_tpu.obs import memplane
+
+        for name in removed:
+            memplane.LEDGER.retire(("cache", id(self), name))
+
+    def release_ledger(self) -> None:
+        """Retire every ledger entry this cache still tracks — graph
+        teardown is about to free the batches themselves, so anything left
+        here is GC'd residency, not a leak."""
+        with self._lock:
+            names = list(self._bytes.keys())
+        from quokka_tpu.obs import memplane
+
+        for name in names:
+            memplane.LEDGER.retire(("cache", id(self), name))
 
     def size(self) -> int:
         with self._lock:

@@ -61,6 +61,9 @@ class CheckpointStore:
         Stale tmp keys from crashed writers go with it."""
         if self.namespace is None:
             return
+        from quokka_tpu.obs import memplane
+
+        memplane.LEDGER.retire_prefix(("ckpt", self.root, self.namespace))
         prefix = f"ckpt-{self.namespace}-"
         if self._remote:
             try:
@@ -84,10 +87,20 @@ class CheckpointStore:
                 except OSError:
                     continue
 
+    def _track(self, actor: int, ch: int, state_seq: int,
+               nbytes: int) -> None:
+        from quokka_tpu.obs import memplane
+
+        memplane.LEDGER.track(
+            ("ckpt", self.root, self.namespace, actor, ch, state_seq),
+            memplane.SITE_CKPT, nbytes, query=self.namespace,
+            device=memplane.HOST)
+
     def save(self, actor: int, ch: int, state_seq: int, data: bytes) -> None:
         p = self._path(actor, ch, state_seq)
         if not self._remote:
             integrity.write_framed_atomic(p, data, site="ckpt")
+            self._track(actor, ch, state_seq, len(data))
             return
         framed = integrity.maybe_corrupt(integrity.frame(data), "ckpt")
         # remote: never write the final key directly — a crash mid-write
@@ -126,6 +139,7 @@ class CheckpointStore:
             raise CorruptArtifactError(
                 final, f"read-after-write mismatch (uploaded {len(framed)}B,"
                        f" landed {len(landed)}B) — torn upload removed")
+        self._track(actor, ch, state_seq, len(data))
 
     def load(self, actor: int, ch: int, state_seq: int) -> Optional[bytes]:
         """Verified snapshot bytes, None when absent.  Raises

@@ -459,6 +459,12 @@ def _load_entry(path: str):
             raise CorruptArtifactError(f"{path}: unknown entry version")
         compiled = deserialize_and_load(
             entry["exe"], entry["in_tree"], entry["out_tree"])
+        from quokka_tpu.obs import memplane
+
+        # a loaded executable is host residency for the process lifetime
+        # (same token as the persist path: load-after-persist replaces)
+        memplane.LEDGER.track(("aot", path), memplane.SITE_EXEC,
+                              len(payload), device=memplane.HOST)
         return entry["key"], compiled
     except Exception:  # noqa: BLE001 — any load failure means "not cached"
         _quarantine(path)
@@ -524,6 +530,10 @@ def _persist_now(key: Tuple, compiled) -> None:
     with open(tmp, "wb") as f:
         f.write(frame(payload))
     os.replace(tmp, path)
+    from quokka_tpu.obs import memplane
+
+    memplane.LEDGER.track(("aot", path), memplane.SITE_EXEC, len(payload),
+                          device=memplane.HOST)
 
 
 def drain_writes(timeout: float = 10.0) -> None:

@@ -45,6 +45,7 @@ from quokka_tpu.runtime.task import (
     TapedInputTask,
 )
 from quokka_tpu import obs
+from quokka_tpu.obs import memplane
 from quokka_tpu.obs import spans as tracing
 from quokka_tpu.target_info import (
     BroadcastPartitioner,
@@ -168,6 +169,9 @@ class TaskGraph:
         if self.ckpt_dir is not None and self._private_spill \
                 and not preserve_durable:
             shutil.rmtree(self.ckpt_dir, ignore_errors=True)
+            # un-namespaced checkpoints die with the dir; their ledger
+            # entries go with them (wipe_namespace covers namespaced ones)
+            memplane.LEDGER.retire_prefix(("ckpt", self.ckpt_dir))
         if self.query_id is not None and not preserve_durable:
             # GC this query's checkpoints from wherever they actually went:
             # exec_config["checkpoint_store"] (an external/shared root that
@@ -197,6 +201,18 @@ class TaskGraph:
             from quokka_tpu.runtime import scancache
 
             scancache.GLOBAL.drop_query(self.query_id)
+            # memory plane: whatever the cache still holds is freed by this
+            # teardown (retire, not leak), the measured peak persists under
+            # the plan fingerprint for admission, and anything STILL in the
+            # ledger after that is a named leak report.  A durably-preserved
+            # standing query keeps its spill entries (the files survive for
+            # resume) and only drops the per-query accounting.
+            self.cache.release_ledger()
+            if preserve_durable:
+                memplane.LEDGER.drop_query(self.query_id)
+            else:
+                memplane.LEDGER.on_query_gc(
+                    self.query_id, plan_fp=getattr(self, "plan_fp", None))
             obs.REGISTRY.remove(f"cache.plan_hit.{self.query_id}",
                                 f"cache.plan_miss.{self.query_id}",
                                 f"task.latency_s.{self.query_id}",
@@ -207,7 +223,10 @@ class TaskGraph:
                                 f"compile.prewarm_hit.{self.query_id}",
                                 f"stream.panes.{self.query_id}",
                                 f"stream.late_dropped.{self.query_id}",
-                                f"stream.watermark_lag_s.{self.query_id}")
+                                f"stream.watermark_lag_s.{self.query_id}",
+                                f"mem.live_bytes.{self.query_id}",
+                                f"mem.peak_bytes.{self.query_id}",
+                                f"mem.spill_resident_bytes.{self.query_id}")
         # persist this query's program set under its plan fingerprint so the
         # NEXT submit of the same plan shape pre-warms from disk
         fp = getattr(self, "plan_fp", None)
@@ -748,7 +767,12 @@ class Engine:
             keep = [c for c in info.projection if c in table.column_names]
             table = table.select(keep)
         with tracing.span("bridge.to_device"):
-            batch = bridge.arrow_to_device(table, sorted_by=info.sorted_by)
+            # an h2d transfer is where HBM exhaustion actually surfaces:
+            # capture the ledger state in a forensics bundle before the
+            # allocator error propagates
+            with memplane.alloc_guard(memplane.SITE_READER):
+                batch = bridge.arrow_to_device(table,
+                                               sorted_by=info.sorted_by)
         if ckey is not None:
             scancache.GLOBAL.put(ckey, batch)
         return batch

@@ -66,6 +66,14 @@ class HBQ:
         # partial or torn spills, and anything the disk mangles later
         # fails the checksum on read
         integrity.write_framed_stream(p, _write, site="spill")
+        # spill residency: logical table bytes (the figure the
+        # shuffle.spill_bytes counter reports), host-class, retired on
+        # gc/wipe/quarantine
+        from quokka_tpu.obs import memplane
+
+        memplane.LEDGER.track(("hbq", self.path, self._fname(name)),
+                              memplane.SITE_SPILL, table.nbytes,
+                              query=self.namespace, device=memplane.HOST)
 
     def get(self, name: Tuple) -> Optional[pa.Table]:
         p = os.path.join(self.path, self._fname(name))
@@ -80,6 +88,9 @@ class HBQ:
             # existence probe says gone, and let recovery regenerate the
             # object (live peer HBQ / input lineage / producer replay)
             integrity.quarantine(p, e)
+            from quokka_tpu.obs import memplane
+
+            memplane.LEDGER.retire(("hbq", self.path, self._fname(name)))
             return None
         except OSError as e:
             # transient read failure (EMFILE, EINTR, raced GC) proves
@@ -123,10 +134,14 @@ class HBQ:
         return sorted(out)
 
     def gc(self, names: Sequence[Tuple]) -> None:
+        from quokka_tpu.obs import memplane
+
         for name in names:
             p = os.path.join(self.path, self._fname(name))
             if os.path.exists(p):
                 os.remove(p)
+                memplane.LEDGER.retire(("hbq", self.path,
+                                        self._fname(name)))
 
     def wipe(self) -> None:
         """Drop this HBQ's spill.  A namespaced HBQ shares its directory
@@ -135,9 +150,12 @@ class HBQ:
         quarantined ``.corrupt`` and stale ``.tmp`` leftovers of this
         namespace go too — a long-lived service would otherwise leak them
         into the shared spill dir forever."""
+        from quokka_tpu.obs import memplane
+
         if self.namespace is None:
             shutil.rmtree(self.path, ignore_errors=True)
             os.makedirs(self.path, exist_ok=True)
+            memplane.LEDGER.retire_prefix(("hbq", self.path))
             return
         prefix = f"hbq-{self.namespace}-"
         for f in os.listdir(self.path):
@@ -146,3 +164,4 @@ class HBQ:
                     os.remove(os.path.join(self.path, f))
                 except OSError:
                     continue
+                memplane.LEDGER.retire(("hbq", self.path, f))

@@ -103,6 +103,7 @@ class ScanCache:
         snap = DeviceBatch(
             dict(batch.columns), batch.valid, batch.nrows, batch.sorted_by, batch.nrows_dev
         )
+        evicted = []
         with self._lock:
             old = self._data.pop(key, None)
             if old is not None:
@@ -110,13 +111,29 @@ class ScanCache:
             self._data[key] = (snap, nb)
             self._bytes += nb
             while self._bytes > self.cap and self._data:
-                _, (_, oldnb) = self._data.popitem(last=False)
+                k, (_, oldnb) = self._data.popitem(last=False)
                 self._bytes -= oldnb
+                evicted.append(k)
+        # memory ledger outside the LRU lock.  query=None: entries are
+        # keyed by FILE identity and deliberately outlive the query that
+        # warmed them — process-global residency, never a per-query leak
+        from quokka_tpu.obs import memplane
+
+        memplane.LEDGER.track(("scan", id(self), key),
+                              memplane.SITE_READER, nb)
+        for k in evicted:
+            if k != key:
+                memplane.LEDGER.retire(("scan", id(self), k))
 
     def clear(self) -> None:
         with self._lock:
+            keys = list(self._data.keys())
             self._data.clear()
             self._bytes = 0
+        from quokka_tpu.obs import memplane
+
+        for k in keys:
+            memplane.LEDGER.retire(("scan", id(self), k))
 
     def drop_query(self, query: str) -> None:
         """Forget a finished query's ACCOUNTING.  Cached batches stay — they

@@ -61,8 +61,23 @@ PIPELINE_OVERHEAD = 1.25
 
 def estimate_working_set(graph) -> int:
     """Estimated peak bytes a query holds across the scan cache + batch
-    cache while running: reader size hints where available (readers.py
-    ``size_hint``), floored and scaled for decode/pipeline overhead."""
+    cache while running.
+
+    Measured first: a plan that has run to completion before persisted its
+    ledger-observed ``peak_bytes`` under its plan fingerprint
+    (obs/memplane.py), and that figure beats any hint-derived guess — it
+    already includes decode expansion, pipeline depth and join build state,
+    so neither the PIPELINE_OVERHEAD scale nor the MIN_ESTIMATE_BYTES floor
+    applies (a genuinely small query should be admitted as small).  Fresh
+    plans fall back to reader size hints (readers.py ``size_hint``),
+    floored and scaled for decode/pipeline overhead."""
+    fp = getattr(graph, "plan_fp", None)
+    if fp:
+        from quokka_tpu.obs import memplane
+
+        measured = memplane.measured_footprint(fp)
+        if measured:
+            return max(int(measured), 1 << 20)
     total = 0
     for info in graph.actors.values():
         if info.kind != "input" or info.reader is None:

@@ -373,6 +373,15 @@ class QueryService:
                     "task_p50_s": lat["p50"],
                     "task_p95_s": lat["p95"],
                     "tasks": lat["count"],
+                    # memory plane columns (obs/memplane.py): snapshot
+                    # lookups, never creating — the per-query gauges GC
+                    # with the namespace and must stay gone
+                    "mem_live_bytes": counters.get(
+                        f"mem.live_bytes.{qid}", 0),
+                    "mem_peak_bytes": counters.get(
+                        f"mem.peak_bytes.{qid}", 0),
+                    "mem_spill_bytes": counters.get(
+                        f"mem.spill_resident_bytes.{qid}", 0),
                 }
                 if s.streaming:
                     # standing-query row: source watermarks + pane/late

@@ -67,6 +67,9 @@ class QuerySession:
         self.streaming = False
         # snapshotted at finish, before the namespace GC
         self.scan_stats: Optional[Dict] = None
+        # memory-plane footprint ({live, peak, spill_resident} bytes),
+        # snapshotted at finish before the ledger drops the query
+        self.mem_stats: Optional[Dict] = None
 
     # -- finish (exactly once) ----------------------------------------------
     def finish(self, error: Optional[BaseException] = None) -> bool:
@@ -96,6 +99,9 @@ class QuerySession:
                 f"task.latency_s.{self.query_id}")
             self.latency_stats = (h.stats() if h is not None
                                   else obs.Histogram.empty_stats())
+            from quokka_tpu.obs import memplane
+
+            self.mem_stats = memplane.LEDGER.query_footprint(self.query_id)
             try:
                 # a standing query that FAILED (or was shut down mid-stream)
                 # keeps its durable recovery trio — checkpoints, HBQ spill,
@@ -198,6 +204,16 @@ class QueryHandle:
         h = obs.REGISTRY.histograms().get(
             f"task.latency_s.{self.query_id}")
         return h.stats() if h is not None else obs.Histogram.empty_stats()
+
+    def memory_stats(self) -> Dict:
+        """This query's memory-ledger footprint ({live_bytes, peak_bytes,
+        spill_resident_bytes}) — live while running, snapshotted at finish
+        (the ledger drops the query's accounting with its namespace)."""
+        if self._s.mem_stats is not None:
+            return dict(self._s.mem_stats)
+        from quokka_tpu.obs import memplane
+
+        return memplane.LEDGER.query_footprint(self.query_id)
 
     def timings(self) -> Dict[str, Optional[float]]:
         s = self._s

@@ -1,0 +1,26 @@
+"""QK018 fixture: eager device allocations outside the ledgered helpers.
+
+Three findings: a jnp array constructor, a jax.device_put, and a
+jnp.asarray — all on eager (non-traced) paths, so each creates device
+residency the memory ledger never sees.  The jit-wrapped kernel is exempt:
+inside a trace these are lazy tracer ops, not allocations.
+"""
+
+
+def make_padding(jnp, n):
+    return jnp.zeros((n,))  # finding 1: eager constructor, unledgered
+
+
+def stage_batch(jax, arr, device):
+    return jax.device_put(arr, device)  # finding 2: raw transfer
+
+
+def from_host(jnp, values):
+    return jnp.asarray(values)  # finding 3: eager host->device copy
+
+
+def traced_pad(jax, jnp, n):
+    def kernel(x):
+        return x + jnp.zeros((n,))  # exempt: traces under jit below
+
+    return jax.jit(kernel)

@@ -83,6 +83,31 @@ class TestRender:
         assert 'quokka_cache_plan_hit_total{query="q1"} 1' in text
         assert "quokka_cache_plan_hit_total 1\n" not in text
 
+    def test_mem_families_render_with_labels(self):
+        """Memory-plane gauges: per-query and per-site twins render as
+        labeled families (escaping included); the aggregates keep their own
+        _all names so sum() over the labeled family never double-counts."""
+        r = Registry()
+        r.gauge("mem.live_bytes").set(1024)
+        r.gauge('mem.live_bytes.q"1').set(512)
+        r.gauge('mem.peak_bytes.q"1').set(2048)
+        r.gauge('mem.spill_resident_bytes.q"1').set(128)
+        r.gauge("mem.peak_bytes").set(4096)
+        r.gauge("mem.spill_resident_bytes").set(256)
+        r.gauge("mem.site_bytes.shuffle").set(640)
+        text = export.render(r)
+        assert "quokka_mem_live_bytes_all 1024" in text
+        assert "quokka_mem_peak_bytes_all 4096" in text
+        assert "quokka_mem_spill_resident_bytes_all 256" in text
+        assert 'quokka_mem_live_bytes{query="q\\"1"} 512' in text
+        assert 'quokka_mem_peak_bytes{query="q\\"1"} 2048' in text
+        assert ('quokka_mem_spill_resident_bytes{query="q\\"1"} 128'
+                in text)
+        assert 'quokka_mem_site_bytes{site="shuffle"} 640' in text
+        # the aggregate never renders bare under the labeled family name
+        assert "quokka_mem_live_bytes 1024" not in text
+        assert _valid_exposition(text)
+
     def test_per_query_histogram_renders_as_label(self):
         r = Registry()
         r.histogram("task.latency_s.qfoo").observe(0.01)

@@ -27,6 +27,7 @@ CASES = [
     ("QK011", "qk011_push_sync.py", 3),      # np.asarray, .item(), device_get
     ("QK012", "qk012_raw_len_key.py", 3),    # sig tuple, .get key, store key
     ("QK013", "qk013_platform_gate.py", 3),  # probe, string gate, _platform
+    ("QK018", "qk018_device_alloc.py", 3),   # jnp.zeros, device_put, asarray
 ]
 
 

@@ -1,0 +1,243 @@
+"""Memory observability plane (obs/memplane.py): known-answer ledger
+accounting, leak flagging with allocation-site attribution, the OOM
+forensics bundle, and measured-admission precedence over size_hint()."""
+
+import json
+import os
+import types
+
+import pytest
+
+from quokka_tpu.obs import memplane
+from quokka_tpu.obs.memplane import (HOST, SITE_CKPT, SITE_READER,
+                                     SITE_SHUFFLE, SITE_SPILL, MemLeakError,
+                                     MemLedger)
+
+
+class TestLedgerAccounting:
+    def test_known_answer_totals(self):
+        led = MemLedger()
+        led.track(("a",), SITE_READER, 1000, query="q1")
+        led.track(("b",), SITE_SHUFFLE, 500, query="q1")
+        led.track(("c",), SITE_SPILL, 200, query="q2", device=HOST)
+        assert led.live_bytes() == 1700
+        assert led.live_bytes("q1") == 1500
+        assert led.device_live_bytes() == 1500  # spill is host-class
+        assert led.spill_bytes() == 200 == led.spill_bytes("q2")
+        assert led.site_totals() == {"reader": 1000, "shuffle": 500,
+                                     "spill": 200}
+        assert led.entry_count() == 3 and led.entry_count("q1") == 2
+        led.retire(("b",))
+        assert led.live_bytes() == 1200
+        assert led.peak_bytes() == 1700       # high-water mark holds
+        assert led.peak_bytes("q1") == 1500
+        # re-track of an existing token REPLACES (BatchCache dedup
+        # semantics): never double-counts
+        led.track(("a",), SITE_READER, 700, query="q1")
+        assert led.live_bytes() == 900
+        assert led.live_bytes("q1") == 700
+        led.retire(("nope",))  # unknown token: no-op, no underflow
+        assert led.live_bytes() == 900
+
+    def test_retire_prefix_bulk_gc(self):
+        led = MemLedger()
+        led.track(("hbq", "/spill", "f1"), SITE_SPILL, 100, query="q",
+                  device=HOST)
+        led.track(("hbq", "/spill", "f2"), SITE_SPILL, 300, query="q",
+                  device=HOST)
+        led.track(("ckpt", "/spill", 0, 0, 1), SITE_CKPT, 50, query="q",
+                  device=HOST)
+        led.retire_prefix(("hbq", "/spill"))
+        assert led.live_bytes() == 50
+        assert led.spill_bytes("q") == 0
+        assert led.entry_count() == 1
+        fp = led.query_footprint("q")
+        assert fp == {"live_bytes": 50, "peak_bytes": 450,
+                      "spill_resident_bytes": 0}
+
+    def test_reset_peak_rearms_at_live(self):
+        led = MemLedger()
+        led.track(("a",), SITE_READER, 1000)
+        led.retire(("a",))
+        led.track(("b",), SITE_READER, 10)
+        assert led.peak_bytes() == 1000
+        led.reset_peak()
+        assert led.peak_bytes() == 10  # bench brackets each query with this
+
+    def test_reconcile_delta_math(self, monkeypatch):
+        led = MemLedger()
+        vals = iter([1000, 1500])
+        monkeypatch.setattr(memplane, "_jax_live_bytes", lambda: next(vals))
+        led.set_baseline()  # jax=1000, ledger device-class = 0
+        led.track(("a",), SITE_READER, 512)
+        rec = led.reconcile(tolerance=0.10)
+        assert rec["available"]
+        assert rec["ledger_bytes"] == 512 and rec["jax_bytes"] == 500
+        assert rec["within"] and rec["drift_frac"] <= 0.10
+
+    def test_reconcile_unavailable_is_not_a_failure(self, monkeypatch):
+        monkeypatch.setattr(memplane, "_jax_live_bytes", lambda: -1)
+        led = MemLedger()
+        led.set_baseline()
+        rec = led.reconcile()
+        assert rec["available"] is False and rec["within"] is True
+
+
+class TestLeakFlagging:
+    def test_leak_raises_with_site_attribution(self):
+        from quokka_tpu import obs
+
+        led = MemLedger()
+        led.track(("cache", 1, "p0"), SITE_SHUFFLE, 4096, query="leaky")
+        led.track(("scan", 2, "k"), SITE_READER, 100)  # query=None: exempt
+        with pytest.raises(MemLeakError) as ei:
+            led.check_leaks("leaky", strict=True)
+        err = ei.value
+        assert err.query_id == "leaky"
+        assert [leak["site"] for leak in err.leaks] == ["shuffle"]
+        assert err.leaks[0]["nbytes"] == 4096
+        assert "leaky" in str(err) and "shuffle" in str(err)
+        # the report RETIRES what it flags: no double-report, totals drop
+        assert led.live_bytes() == 100
+        assert led.check_leaks("leaky", strict=True) is None
+        if obs.RECORDER.enabled:
+            # allocation-site flight events attached, not just a byte count
+            assert err.leaks[0]["events"], err.leaks[0]
+            assert err.leaks[0]["events"][-1]["args"]["nbytes"] == 4096
+
+    def test_clean_query_reports_none(self):
+        led = MemLedger()
+        led.track(("t",), SITE_READER, 10, query="q")
+        led.retire(("t",))
+        assert led.check_leaks("q", strict=True) is None
+
+    def test_on_query_gc_reports_and_drops(self):
+        led = MemLedger()
+        led.track(("t",), SITE_READER, 10, query="q")
+        err = led.on_query_gc("q")  # non-strict by default: report, no raise
+        assert isinstance(err, MemLeakError)
+        assert led.query_footprint("q") == {
+            "live_bytes": 0, "peak_bytes": 0, "spill_resident_bytes": 0}
+
+    def test_strict_mode_env(self, monkeypatch):
+        monkeypatch.setenv("QK_MEM_STRICT", "1")
+        led = MemLedger()
+        led.track(("t",), SITE_READER, 10, query="q")
+        with pytest.raises(MemLeakError):
+            led.on_query_gc("q")
+
+
+class TestOOMForensics:
+    def test_bundle_contents(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("QK_DUMP_DIR", str(tmp_path))
+        led = MemLedger()
+        led.track(("big",), SITE_SHUFFLE, 1 << 20, query="q1")
+        path = memplane.oom_bundle("test reason", ledger=led)
+        assert path and os.path.exists(path)
+        with open(path, encoding="utf-8") as f:
+            bundle = json.load(f)
+        assert bundle["reason"] == "test reason"
+        assert bundle["live_bytes"] == 1 << 20
+        assert bundle["top_holders"][0]["site"] == "shuffle"
+        assert bundle["top_holders"][0]["nbytes"] == 1 << 20
+        assert bundle["query_footprints"]["q1"]["peak_bytes"] == 1 << 20
+        assert bundle["ledger_tail"][-1]["op"] == "track"
+        assert "flight_timeline" in bundle
+        assert bundle["site_bytes"]["shuffle"] == 1 << 20
+
+    def test_budget_breach_latches_one_bundle_per_episode(
+            self, monkeypatch, tmp_path):
+        monkeypatch.setenv("QK_DUMP_DIR", str(tmp_path))
+        monkeypatch.setenv("QK_MEM_BUDGET", "1000")
+        led = MemLedger()
+        led.track(("a",), SITE_READER, 600)
+        assert not list(tmp_path.glob("mem-*.oom.json"))  # under budget
+        led.track(("b",), SITE_READER, 600)  # 1200 > 1000: one bundle
+        assert len(list(tmp_path.glob("mem-*.oom.json"))) == 1
+        led.track(("c",), SITE_READER, 10)   # still breached: latched
+        assert len(list(tmp_path.glob("mem-*.oom.json"))) == 1
+        led.retire(("b",))
+        led.retire(("c",))
+        led.track(("d",), SITE_READER, 10)   # back under budget: re-arms
+        led.track(("e",), SITE_READER, 600)  # breach #2: new bundle
+        assert len(list(tmp_path.glob("mem-*.oom.json"))) == 2
+
+    def test_alloc_guard_bundles_only_allocation_failures(
+            self, monkeypatch, tmp_path):
+        monkeypatch.setenv("QK_DUMP_DIR", str(tmp_path))
+        with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+            with memplane.alloc_guard(SITE_READER):
+                raise RuntimeError(
+                    "RESOURCE_EXHAUSTED: while allocating 2.0G")
+        assert len(list(tmp_path.glob("mem-*.oom.json"))) == 1
+        with pytest.raises(ValueError, match="bad schema"):
+            with memplane.alloc_guard(SITE_READER):
+                raise ValueError("bad schema")  # not an allocator error
+        assert len(list(tmp_path.glob("mem-*.oom.json"))) == 1
+
+
+class TestMeasuredAdmission:
+    def test_record_and_measure_roundtrip(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("QK_MEMPROFILE_DIR", str(tmp_path))
+        memplane.record_footprint("plan-a", 123 << 20, 5 << 20)
+        assert memplane.measured_footprint("plan-a") == 123 << 20
+        # max-merge: a lightly-loaded later run never shrinks the figure
+        memplane.record_footprint("plan-a", 50 << 20)
+        assert memplane.measured_footprint("plan-a") == 123 << 20
+        memplane.record_footprint("plan-a", 200 << 20)
+        assert memplane.measured_footprint("plan-a") == 200 << 20
+        assert memplane.measured_footprint("plan-b") is None
+        assert memplane.measured_footprint(None) is None
+
+    def test_estimate_prefers_measured_over_size_hint(
+            self, monkeypatch, tmp_path):
+        from quokka_tpu.service import admission
+
+        monkeypatch.setenv("QK_MEMPROFILE_DIR", str(tmp_path))
+        reader = types.SimpleNamespace(size_hint=lambda: 100 << 20)
+        info = types.SimpleNamespace(kind="input", reader=reader)
+        graph = types.SimpleNamespace(actors={0: info}, plan_fp="fp-1")
+        est = admission.estimate_working_set(graph)
+        assert est == int((100 << 20) * admission.PIPELINE_OVERHEAD)
+        memplane.record_footprint("fp-1", 42 << 20)
+        assert admission.estimate_working_set(graph) == 42 << 20
+        # a measured figure is ground truth: a genuinely small plan is
+        # admitted as small, NOT floored to MIN_ESTIMATE_BYTES
+        graph2 = types.SimpleNamespace(actors={0: info}, plan_fp="fp-2")
+        memplane.record_footprint("fp-2", 2 << 20)
+        assert admission.estimate_working_set(graph2) == 2 << 20
+        assert (admission.estimate_working_set(graph2)
+                < admission.MIN_ESTIMATE_BYTES)
+
+    def test_foreign_fingerprint_rejected_wholesale(
+            self, monkeypatch, tmp_path):
+        from quokka_tpu.service import admission
+
+        monkeypatch.setenv("QK_MEMPROFILE_DIR", str(tmp_path))
+        memplane.record_footprint("fp-x", 42 << 20)
+        path = memplane._profile_path()
+        with open(path, encoding="utf-8") as f:
+            prof = json.load(f)
+        prof["fingerprint"] = "someone-elses-backend"
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(prof, f)
+        # footprints measured under a different device topology describe a
+        # different placement: fall back to size_hint estimation
+        assert memplane.measured_footprint("fp-x") is None
+        graph = types.SimpleNamespace(actors={}, plan_fp="fp-x")
+        assert (admission.estimate_working_set(graph)
+                == admission.MIN_ESTIMATE_BYTES)
+
+    def test_empty_profile_dir_disables(self, monkeypatch):
+        monkeypatch.setenv("QK_MEMPROFILE_DIR", "")  # QK_STRATEGY_DIR idiom
+        memplane.record_footprint("fp", 1 << 30)
+        assert memplane.measured_footprint("fp") is None
+
+    def test_corrupt_profile_is_absent_not_fatal(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("QK_MEMPROFILE_DIR", str(tmp_path))
+        memplane.record_footprint("fp", 1 << 20)
+        with open(memplane._profile_path(), "w", encoding="utf-8") as f:
+            f.write("{not json")
+        assert memplane.measured_footprint("fp") is None
+        memplane.record_footprint("fp", 2 << 20)  # recovers by rewriting
+        assert memplane.measured_footprint("fp") == 2 << 20
