@@ -4,7 +4,7 @@
 PY ?= python
 export JAX_PLATFORMS ?= cpu
 
-.PHONY: lint lint-baseline verify-static test test-slow sanitize-demo service-smoke chaos-smoke obs-smoke bench-check shuffle-smoke warmup-smoke multichip-smoke stream-smoke mem-smoke
+.PHONY: lint lint-baseline verify-static test test-slow sanitize-demo service-smoke chaos-smoke obs-smoke bench-check shuffle-smoke warmup-smoke multichip-smoke stream-smoke mem-smoke explain-smoke
 
 # engine-invariant static analysis; exits nonzero on findings beyond the
 # checked-in baseline (quokka_tpu/analysis/baseline.json)
@@ -108,6 +108,15 @@ stream-smoke:
 # under the plan fingerprint, not the size_hint() guess
 mem-smoke:
 	$(PY) -m quokka_tpu.obs.mem_smoke
+
+# EXPLAIN ANALYZE smoke: a Q3-shaped service query's operator-statistics
+# snapshot must reconcile rows end-to-end (scans == parquet rows, every
+# exec intake == its in-edges' delivered totals), carry the per-edge skew
+# report, add ZERO shuffle.host_syncs, and a second submission of the same
+# plan must be admitted on the MEASURED source cardinalities persisted
+# under the plan fingerprint
+explain-smoke:
+	$(PY) -m quokka_tpu.obs.explain_smoke
 
 # chaos plane soak: >= 20 seeded mixed-fault runs (RPC drops/delays, flaky
 # store calls, worker kills, spill + checkpoint corruption) each asserting

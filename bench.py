@@ -431,6 +431,22 @@ def bench_out_dir() -> str:
     return d
 
 
+def _operators_detail():
+    """EXPLAIN ANALYZE actuals of the most recently finished query — the
+    opstats ledger stashes its final snapshot at query GC, so reading it
+    right after a timed run attributes to that run.  None when the ledger
+    saw nothing (itself a regression on join/asof queries: `--check`)."""
+    try:
+        from quokka_tpu.obs import explain as obs_explain
+        from quokka_tpu.obs import opstats as obs_opstats
+
+        return obs_explain.operators_detail(
+            obs_opstats.OPSTATS.last_finished())
+    except Exception as e:  # noqa: BLE001 — stats must not kill the bench
+        sys.stderr.write(f"bench: operators detail unavailable: {e!r}\n")
+        return None
+
+
 def _write_obs_summary(obs_per_query):
     """Per-query span/counter breakdown JSON next to the timing output
     (BENCH_*.json gains compile-vs-compute-vs-transfer visibility)."""
@@ -608,6 +624,11 @@ def measure(paths):
             # executed during this query (ops/strategy.note_used)
             "strategy": kstrategy.used_snapshot(),
             "critpath": crit_line,
+            # EXPLAIN ANALYZE actuals of the last timed run (obs/opstats.py
+            # snapshot stashed at query GC): per-operator rows/selectivity/
+            # time share + the per-exchange-edge skew report.  `--check`
+            # treats a missing block on join/asof queries as a regression.
+            "operators": _operators_detail(),
             **extra,
         }
         # QK_SANITIZE=1: the recompile sentinel fails the run outright when
@@ -666,6 +687,7 @@ def measure(paths):
                 "seconds_all": [round(x, 4) for x in asof_times],
                 "ref_rows_per_s_per_worker": round(REF_ASOF_ROWS_PER_S_PER_WORKER),
                 "strategy": kstrategy.used_snapshot(),
+                "operators": _operators_detail(),
             },
         }))
         sys.stdout.flush()
@@ -846,6 +868,43 @@ def check_strategy_honesty(cur, require):
                              "cannot verify the measured path is the one "
                              "this platform runs"))
                 bad.append(name)
+    return rows, bad
+
+
+def check_operators_presence(cur, require):
+    """EXPLAIN ANALYZE honesty rows: benched join/asof lines must carry
+    the operator-statistics block (``detail.operators`` — per-operator
+    rows/time + the skew report) when ``require`` (fresh runs, whose
+    emitter we control).  A missing block means the opstats ledger went
+    blind on that query — a regression, exactly like a vanished metric.
+    Returns (rows, violations)."""
+    rows, bad = [], []
+    if not require:
+        return rows, bad
+
+    def _has_operators(d):
+        detail = d.get("detail") or {}
+        if detail.get("operators"):
+            return True
+        return any(isinstance(qd, dict) and qd.get("operators")
+                   for qd in (detail.get("queries") or {}).values())
+
+    for metric in STRATEGY_REQUIRED_METRICS:
+        if metric not in cur:
+            continue
+        name = f"operators[{metric}]"
+        if _has_operators(cur[metric]):
+            ops = (cur[metric].get("detail") or {}).get("operators") or {}
+            n = len(ops.get("operators") or []) if isinstance(ops, dict) \
+                else 0
+            rows.append((name, "ok",
+                         f"opstats present ({n} operator(s))"))
+        else:
+            rows.append((name, "MISSING",
+                         "benched line records no detail.operators — the "
+                         "EXPLAIN ANALYZE ledger saw nothing for this "
+                         "query (opstats regression)"))
+            bad.append(name)
     return rows, bad
 
 
@@ -1217,6 +1276,12 @@ def check_main(argv):
     s_rows, s_bad = check_strategy_honesty(
         cur, require=(args.current is None))
     regressed += s_bad
+    # EXPLAIN ANALYZE honesty: fresh join/asof lines must carry operator
+    # actuals (detail.operators) — same presence discipline as strategy
+    o_rows, o_bad = check_operators_presence(
+        cur, require=(args.current is None))
+    regressed += o_bad
+    s_rows = s_rows + o_rows
     out = sys.stdout
     out.write(f"bench --check: {cur_src} vs {against}\n")
     if base_truncated:

@@ -69,6 +69,18 @@ Rule ids:
                                 (obs/memplane.py), so per-query footprints
                                 and OOM forensics under-report exactly the
                                 allocation that mattered
+  QK019 adhoc-operator-tally    per-operator row/byte tallies grown by hand
+                                in runtime/executors/streaming/service code
+                                (``self.rows_in += ...``,
+                                ``tally["bytes_out"] += ...``) — operator
+                                cardinality accounting must go through the
+                                opstats ledger (obs/opstats.py: OPSTATS
+                                record paths or opstats.note()) so EXPLAIN
+                                ANALYZE, skew detection and the persisted
+                                cardinality profile see the same numbers;
+                                operational state (bare ``rows``,
+                                ``pending_rows``, build buffers) is not a
+                                stat and is not flagged
 
 Finding keys (``Finding.key``) are line-number-free — ``rule::relpath::
 scope::snippet[::n]`` — so a baseline survives unrelated edits above the
@@ -1423,6 +1435,97 @@ def check_unledgered_device_alloc(tree: ast.Module, path: str, rel: str,
     return out
 
 
+# ---------------------------------------------------------------------------
+# QK019 — ad-hoc per-operator row/byte tallies outside the opstats ledger
+# ---------------------------------------------------------------------------
+
+# where the rule applies: the code that moves operator rows/bytes the
+# EXPLAIN ANALYZE ledger (obs/opstats.py) must see.  obs/ is exempt — the
+# ledger and its exporter are what the rule points at.
+_QK019_SCOPED_DIRS = ("quokka_tpu/runtime/", "quokka_tpu/executors/",
+                      "quokka_tpu/streaming/", "quokka_tpu/service/")
+_QK019_EXEMPT_PREFIXES = ("quokka_tpu/obs/",)
+# the ledger's field vocabulary, matched EXACTLY (modulo leading
+# underscores): bare ``rows``, ``_build_rows``, ``pending_rows`` and
+# friends are operational state — buffers a channel drains — not
+# statistics, and substring matching would drown the rule in them.
+_QK019_STAT_NAMES = {
+    "rows_in", "rows_out", "bytes_in", "bytes_out", "batches_in",
+    "batches_out", "rows_seen", "bytes_seen", "rows_emitted",
+    "rows_delivered", "total_rows", "total_bytes_in", "total_bytes_out",
+    "dispatches", "padded_in", "rows_unknown",
+}
+
+
+def _qk019_stat_name(node: ast.AST) -> Optional[str]:
+    """The stats-shaped identifier behind a tally target: an attribute
+    name, a bare name, or a string-literal subscript key."""
+    if isinstance(node, ast.Attribute):
+        n = node.attr
+    elif isinstance(node, ast.Name):
+        n = node.id
+    elif isinstance(node, ast.Subscript) \
+            and isinstance(node.slice, ast.Constant) \
+            and isinstance(node.slice.value, str):
+        n = node.slice.value
+    else:
+        return None
+    return n if n.lstrip("_") in _QK019_STAT_NAMES else None
+
+
+def check_adhoc_operator_tally(tree: ast.Module, path: str, rel: str,
+                               src_lines: Sequence[str]) -> List[Finding]:
+    """Flags hand-grown per-operator row/byte statistics — increments of
+    stat-vocabulary names (``rows_in``, ``bytes_out``, ...) as attributes,
+    locals, or string-keyed dict slots — in runtime/executors/streaming/
+    service code.  Operator cardinality accounting must flow through the
+    opstats ledger (obs/opstats.py) so EXPLAIN ANALYZE, the skew report,
+    /status and the persisted cardinality profile all read ONE set of
+    numbers; a private tally is a second bookkeeping that drifts from the
+    one admission and calibration trust.  Deliberate exceptions baseline
+    with a rationale (shrink-only contract)."""
+    r = rel.replace("\\", "/")
+    base = r.rsplit("/", 1)[-1]
+    if r.startswith(_QK019_EXEMPT_PREFIXES):
+        return []
+    if not (any(d in r for d in _QK019_SCOPED_DIRS)
+            or base.startswith("qk019")):
+        return []
+    out: List[Finding] = []
+    for node in ast.walk(tree):
+        hit = None
+        if (isinstance(node, ast.AugAssign)
+                and isinstance(node.op, (ast.Add, ast.Sub))):
+            name = _qk019_stat_name(node.target)
+            if name is not None:
+                op = "+=" if isinstance(node.op, ast.Add) else "-="
+                hit = (node, f"'... {name} {op} ...'")
+        elif (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Subscript)):
+            # t["rows_in"] = t.get("rows_in", 0) + n — the RMW spelling
+            name = _qk019_stat_name(node.targets[0])
+            if (name is not None and isinstance(node.value, ast.BinOp)
+                    and isinstance(node.value.op, ast.Add)
+                    and any(isinstance(s, ast.Call)
+                            and isinstance(s.func, ast.Attribute)
+                            and s.func.attr == "get"
+                            for s in ast.walk(node.value))):
+                hit = (node, f"'[{name!r}] = .get({name!r}, ...) + ...'")
+        if hit is not None:
+            n, shape = hit
+            out.append(_mk(
+                "QK019", "adhoc-operator-tally", path, rel, n,
+                _scope_of(tree, n),
+                f"{shape} grows an ad-hoc per-operator row/byte tally — "
+                "route operator statistics through the opstats ledger "
+                "(quokka_tpu.obs.opstats: the engine's scan/exec_in/"
+                "exec_out record paths, or opstats.note() from inside an "
+                "executor) so EXPLAIN ANALYZE, skew detection and the "
+                "cardinality profile see it, or baseline with a rationale",
+                src_lines))
+    return out
+
+
 RULES = (
     check_module_level_jit,
     check_import_time_side_effects,
@@ -1438,6 +1541,7 @@ RULES = (
     check_raw_len_cache_key,
     check_platform_gate,
     check_unledgered_device_alloc,
+    check_adhoc_operator_tally,
 )
 
 

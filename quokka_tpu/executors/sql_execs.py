@@ -456,6 +456,11 @@ class BuildProbeJoinExecutor(Executor):
             choice=kstrategy.choice("join_build") if self.build_unique
             else "sort", unique=bool(self.build_unique),
         )
+        # EXPLAIN ANALYZE: the finalized build size on the operator's
+        # record (padded length — host-known, never a device sync)
+        from quokka_tpu.obs import opstats
+
+        opstats.note(join_build_rows=b.padded_len)
 
     def execute(self, batches, stream_id, channel):
         live = [b for b in batches if b is not None]
@@ -611,6 +616,10 @@ class BuildProbeJoinExecutor(Executor):
     def _probe(self, live):
         if self.build is None and self.build_parts:
             self._finalize_build(live[0].names)
+        from quokka_tpu.obs import opstats
+
+        opstats.note(join_probe_rows=sum(
+            b.nrows if b.nrows is not None else b.padded_len for b in live))
         # vectorized probe pipeline: the dispatch's whole ready set flows
         # through ONE bucketed join call instead of one kernel chain per
         # per-partition batch (their async live counts have landed by now,

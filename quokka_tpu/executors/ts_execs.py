@@ -212,6 +212,8 @@ class SortedAsofExecutor(Executor):
             )
 
     def execute(self, batches, stream_id, channel):
+        from quokka_tpu.obs import opstats
+
         live = [b for b in batches if b is not None and b.count_valid() > 0]
         if stream_id == 1:
             for b in live:
@@ -220,6 +222,9 @@ class SortedAsofExecutor(Executor):
                 wm = _time_max(b, self.right_on)
                 if self.q_watermark is None or wm > self.q_watermark:
                     self.q_watermark = wm
+            # quote side is the asof's build analog (counts already host-
+            # resolved by the live filter above — no extra sync)
+            opstats.note(join_build_rows=sum(b.nrows for b in live))
             return self._flush()
         for b in live:
             self._t_parts.append(b)
@@ -227,6 +232,7 @@ class SortedAsofExecutor(Executor):
             wm = _time_max(b, self.left_on)
             if self.t_watermark is None or wm > self.t_watermark:
                 self.t_watermark = wm
+        opstats.note(join_probe_rows=sum(b.nrows for b in live))
         return self._flush()
 
     def source_done(self, stream_id, channel):

@@ -41,13 +41,16 @@ import sys
 
 from quokka_tpu.obs import (
     critpath,
+    explain,
     export,
     memplane,
     merge,
     metrics,
+    opstats,
     recorder,
     spans,
 )
+from quokka_tpu.obs.opstats import OPSTATS
 from quokka_tpu.obs.merge import (
     dump_flight,
     merge_streams,

@@ -390,7 +390,7 @@ class profile:
 
         self._rec = recorder.RECORDER
         self._since = self._rec.record("critpath.begin", "")
-        self._drop0 = self._rec.dropped
+        self._drop0 = self._rec.dropped_total
         self._t0 = time.time()
         return self
 
@@ -404,5 +404,5 @@ class profile:
         merged = merge.merge_streams({"local": evs})
         self.result = analyze(
             merged, query=self.query, window=(self._t0, self._t1),
-            dropped=max(0, self._rec.dropped - self._drop0))
+            dropped=max(0, self._rec.dropped_total - self._drop0))
         return False

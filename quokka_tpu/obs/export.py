@@ -71,6 +71,17 @@ _LABEL_FAMILIES: Tuple[Tuple[str, str, str, str], ...] = (
     ("gauge", "mem.spill_resident_bytes.", "quokka_mem_spill_resident_bytes",
      "query"),
     ("gauge", "mem.site_bytes.", "quokka_mem_site_bytes", "site"),
+    # EXPLAIN ANALYZE plane (obs/opstats.py): per-query operator-row
+    # gauges and per-exchange-edge skew ratios ("<qid>.a<src>-a<tgt>"),
+    # created at snapshot time and GC'd in opstats.on_query_gc
+    ("gauge", "opstats.rows_in.", "quokka_opstats_rows_in", "query"),
+    ("gauge", "opstats.rows_out.", "quokka_opstats_rows_out", "query"),
+    ("gauge", "shuffle.skew.", "quokka_shuffle_skew_ratio", "edge"),
+    # per-query twins of the shuffle byte/sync counters (engine.py GCs the
+    # instruments with the namespace; the label keeps the family bounded)
+    ("counter", "shuffle.bytes.", "quokka_shuffle_bytes_by_query", "query"),
+    ("counter", "shuffle.host_syncs.", "quokka_shuffle_host_syncs_by_query",
+     "query"),
 )
 
 # Aggregate instruments that ALSO exist as a labeled per-query family: the
@@ -93,6 +104,11 @@ _EXACT_FAMILIES: Dict[Tuple[str, str], str] = {
     ("gauge", "mem.peak_bytes"): "quokka_mem_peak_bytes_all",
     ("gauge", "mem.spill_resident_bytes"):
         "quokka_mem_spill_resident_bytes_all",
+    # worst skew ratio observed process-wide (per-edge twins carry the
+    # labeled family above)
+    ("gauge", "shuffle.skew"): "quokka_shuffle_skew_ratio_max",
+    ("counter", "opstats.size_hint_drift_bytes"):
+        "quokka_opstats_size_hint_drift_bytes",
 }
 
 
@@ -249,7 +265,7 @@ class MetricsServer:
     # -- payloads -----------------------------------------------------------
     def metrics_text(self) -> str:
         return render(self.registry, extra_gauges={
-            "obs_dropped_events": _recorder.RECORDER.dropped,
+            "obs_dropped_events": _recorder.RECORDER.dropped_total,
             "uptime_seconds": round(time.time() - self._started, 3),
         })
 
@@ -261,7 +277,9 @@ class MetricsServer:
             "uptime_s": round(time.time() - self._started, 3),
             "obs": {
                 "recorder_enabled": _recorder.RECORDER.enabled,
-                "dropped_events": _recorder.RECORDER.dropped,
+                "dropped_events": _recorder.RECORDER.dropped_total,
+                "dropped_by_type": _recorder.RECORDER.dropped,
+                "sampled_by_type": _recorder.RECORDER.sampled,
                 "ring_capacity": _recorder.RECORDER.capacity,
             },
             # the counters an operator triages incidents from

@@ -520,6 +520,19 @@ def oom_bundle(reason: str, directory: Optional[str] = None,
                 for ev in events[-200:]],
             "chrome_trace": trace_path,
         }
+        # operator-statistics snapshots: which operator's rows/bytes were
+        # in flight when memory ran out (a blown join build reads straight
+        # off its rows_in here)
+        with contextlib.suppress(Exception):
+            from quokka_tpu.obs import opstats as _opstats
+
+            snaps = [s for s in (_opstats.OPSTATS.snapshot(q)
+                                 for q in _opstats.OPSTATS.live_queries())
+                     if s]
+            if not snaps:
+                last = _opstats.OPSTATS.last_finished()
+                snaps = [last] if last else []
+            bundle["opstats"] = snaps
         with open(path, "w", encoding="utf-8") as f:
             json.dump(bundle, f, indent=2, default=repr)
         obs.REGISTRY.counter("mem.oom_bundles").inc()

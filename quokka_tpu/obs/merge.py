@@ -121,11 +121,28 @@ def stuck_headline(stuck: List[Tuple[int, float, tuple]],
         return "no worker looks wedged (all heartbeats fresh)"
     w, age, rec = stuck[0]
     if rec is not None:
-        actor, ch, kind, t = rec
+        actor, ch, kind = rec[0], rec[1], rec[2]
+        args = rec[4] if len(rec) > 4 and rec[4] else None
         return (f"stuck worker {w}: in-flight {kind} task "
-                f"(actor {actor}, channel {ch}) — heartbeat silent "
-                f"{age:.1f}s")
+                f"(actor {actor}, channel {ch}"
+                + (f", {args}" if args else "")
+                + f") — heartbeat silent {age:.1f}s")
     return f"stuck worker {w}: heartbeat silent {age:.1f}s (no task popped)"
+
+
+def _drop_total(v) -> int:
+    """Drop counts arrive as a plain int (legacy worker states) or as the
+    recorder's per-event-type dict — normalize to a total."""
+    if isinstance(v, dict):
+        return sum(int(n) for n in v.values())
+    return int(v or 0)
+
+
+def _fmt_drops(v) -> str:
+    if isinstance(v, dict):
+        by = ",".join(f"{k}:{n}" for k, n in sorted(v.items()) if n)
+        return f"{_drop_total(v)}({by})"
+    return str(int(v))
 
 
 def stall_report(reason: str,
@@ -143,13 +160,14 @@ def stall_report(reason: str,
     stuck = find_stuck(heartbeats, inflight, now)
     lines.append(
         f"verdict: {stuck_headline(stuck, have_heartbeats=bool(heartbeats))}")
-    drops = {p: n for p, n in (dropped or {}).items() if n}
+    drops = {p: n for p, n in (dropped or {}).items() if _drop_total(n)}
     if drops:
         # a wrapped ring means the analysis below is missing its earliest
         # tail — say so before anyone trusts the timeline
         lines.append("WARNING: flight-recorder ring(s) dropped events "
                      "(oldest overwritten; raise QK_TRACE_BUFFER): "
-                     + ", ".join(f"{p}={n}" for p, n in sorted(drops.items())))
+                     + ", ".join(f"{p}={_fmt_drops(n)}"
+                                 for p, n in sorted(drops.items())))
     workers = sorted(set(heartbeats) | set(states) | set(inflight))
     lines.append(f"workers ({len(workers)}):")
     for w in workers:
@@ -157,9 +175,11 @@ def stall_report(reason: str,
         hb_s = f"heartbeat {now - hb:.1f}s ago" if hb else "no heartbeat yet"
         flight = inflight.get(w)
         if flight is not None:
-            actor, ch, kind, t = flight
+            actor, ch, kind, t = flight[0], flight[1], flight[2], flight[3]
+            args = flight[4] if len(flight) > 4 and flight[4] else None
             fl_s = (f"last pop: {kind} task (actor {actor}, channel {ch}) "
-                    f"{now - t:.1f}s ago")
+                    f"{now - t:.1f}s ago"
+                    + (f" [{args}]" if args else ""))
         else:
             fl_s = "last pop: none"
         wedged = any(sw == w for sw, _, _ in stuck)
@@ -247,6 +267,18 @@ def dump_flight(reason: str,
 
                 for cp in _critpath.summarize_queries(merged):
                     f.write(cp.render() + "\n")
+            # the operator-statistics ledger for every in-flight query:
+            # where each operator's rows had gotten to (and which exchange
+            # edges were skewed) at the moment the run wedged
+            with contextlib.suppress(Exception):
+                from quokka_tpu.obs import explain as _explain
+                from quokka_tpu.obs import opstats as _opstats
+
+                for qid in _opstats.OPSTATS.live_queries():
+                    snap = _opstats.OPSTATS.snapshot(qid)
+                    if snap:
+                        f.write("---- opstats at stall ----\n")
+                        f.write(_explain.render(snap) + "\n")
             f.write(f"chrome trace: {trace_path} "
                     f"(load at ui.perfetto.dev)\n")
         if echo:

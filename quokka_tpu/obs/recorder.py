@@ -37,6 +37,35 @@ Event = Tuple[int, float, str, str, float, str, Optional[dict]]
 _DEFAULT_CAPACITY = 4096
 _OFF_VALUES = ("0", "false", "no", "off")
 
+# the high-rate event kinds a bare QK_TRACE_SAMPLE=N applies to: these are
+# per-task / per-batch / per-store-op and can evict the rare stall/chaos/
+# strategy events a post-mortem actually needs from the ring
+_DEFAULT_SAMPLED_KINDS = ("task", "task.wait", "cache.hit", "mem.track",
+                          "rpc", "push.batch", "pull.batch")
+
+
+def _sample_from_env() -> Dict[str, int]:
+    """``QK_TRACE_SAMPLE``: per-event-type sampling — keep 1 in N of each
+    listed kind.  ``QK_TRACE_SAMPLE=8`` samples the default high-rate set
+    at 1/8; ``QK_TRACE_SAMPLE=task=8,rpc=4`` names kinds explicitly.
+    Unlisted kinds always record (rare events must never be sampled)."""
+    spec = os.environ.get("QK_TRACE_SAMPLE", "").strip()
+    if not spec or spec in ("0", "1"):
+        return {}
+    rates: Dict[str, int] = {}
+    if spec.isdigit():
+        n = int(spec)
+        return {k: n for k in _DEFAULT_SAMPLED_KINDS} if n > 1 else {}
+    for part in spec.split(","):
+        kind, _, n = part.strip().partition("=")
+        try:
+            rate = int(n)
+        except ValueError:
+            continue
+        if kind and rate > 1:
+            rates[kind] = rate
+    return rates
+
 
 def recorder_enabled() -> bool:
     """The recorder is ON unless QK_TRACE_EVENTS explicitly disables it —
@@ -65,7 +94,8 @@ class FlightRecorder:
     the marker is the only record of WHAT is blocked."""
 
     def __init__(self, capacity: int = _DEFAULT_CAPACITY,
-                 enabled: Optional[bool] = None):
+                 enabled: Optional[bool] = None,
+                 sample: Optional[Dict[str, int]] = None):
         self.capacity = max(16, int(capacity))
         self.enabled = recorder_enabled() if enabled is None else enabled
         self._buf: List[Optional[Event]] = [None] * self.capacity
@@ -75,8 +105,19 @@ class FlightRecorder:
         # tolerates (it exists to say "the ring wrapped, the tail is gone",
         # not to account bytes)
         self._last = -1
-        # thread name -> (activity, since_ts); plain dict stores are atomic
-        # under the GIL and each thread only writes its own key
+        # per-kind eviction accounting: lock-light dict increments (a rare
+        # racing undercount is within the drop counter's stated tolerance)
+        self._dropped_by: Dict[str, int] = {}
+        # per-kind sampling: kind -> keep 1 in N; per-kind admission
+        # counters via itertools.count (atomic under CPython) so the
+        # decision is deterministic, not random
+        self._sample = dict(sample if sample is not None
+                            else _sample_from_env())
+        self._sample_seq: Dict[str, itertools.count] = {
+            k: itertools.count() for k in self._sample}
+        self._sampled_by: Dict[str, int] = {}
+        # thread name -> (activity, start_ts): the per-thread marker the
+        # stall dumps read when a blocked call never completes
         self._current: Dict[str, Tuple[str, float]] = {}
 
     # -- hot path -----------------------------------------------------------
@@ -84,8 +125,21 @@ class FlightRecorder:
                **args) -> int:
         if not self.enabled:
             return -1
+        rate = self._sample.get(kind)
+        if rate is not None and next(self._sample_seq[kind]) % rate:
+            # sampled down, not dropped: the rare kinds this protects from
+            # ring eviction are never listed in the sample map
+            self._sampled_by[kind] = self._sampled_by.get(kind, 0) + 1
+            return -1
         i = next(self._seq)
-        self._buf[i % self.capacity] = (
+        slot = i % self.capacity
+        old = self._buf[slot]
+        if old is not None:
+            # the ring wrapped: the evicted event's KIND is what a
+            # post-mortem lost — account per type, not just a total
+            k = old[2]
+            self._dropped_by[k] = self._dropped_by.get(k, 0) + 1
+        self._buf[slot] = (
             i, time.time(), kind, name, float(dur),
             threading.current_thread().name, args or None,
         )
@@ -94,12 +148,24 @@ class FlightRecorder:
         return i
 
     @property
-    def dropped(self) -> int:
-        """Events silently overwritten since the last reset: once the ring
-        wraps, every record evicts the oldest event.  Nonzero means a
-        merged timeline / critical-path profile is missing its earliest
-        tail — raise QK_TRACE_BUFFER when it matters."""
-        return max(0, self._last + 1 - self.capacity)
+    def dropped(self) -> Dict[str, int]:
+        """Per-event-type counts of events silently overwritten since the
+        last reset: once the ring wraps, every record evicts the oldest
+        event.  A nonzero type means merged timelines / critical-path
+        profiles are missing that kind's earliest tail — sample the
+        high-rate kinds down (QK_TRACE_SAMPLE) or raise QK_TRACE_BUFFER."""
+        return dict(self._dropped_by)
+
+    @property
+    def dropped_total(self) -> int:
+        """Total evicted events (the scalar the drop gauges export)."""
+        return sum(self._dropped_by.values())
+
+    @property
+    def sampled(self) -> Dict[str, int]:
+        """Per-kind counts of events QK_TRACE_SAMPLE elided (never entered
+        the ring; distinct from ``dropped``, which is ring eviction)."""
+        return dict(self._sampled_by)
 
     def set_current(self, activity: str) -> None:
         if self.enabled:
@@ -170,10 +236,13 @@ class FlightRecorder:
             stream.write("[flight-recorder] current activity per thread:\n")
             for t, (name, age) in sorted(cur.items()):
                 stream.write(f"  {t}: {name} (for {age:.2f}s)\n")
-        if self.dropped:
+        if self.dropped_total:
+            by_kind = ", ".join(f"{k}={n}" for k, n in
+                                sorted(self.dropped.items()) if n)
             stream.write(f"[flight-recorder] WARNING: ring dropped "
-                         f"{self.dropped} event(s) (capacity "
-                         f"{self.capacity}; raise QK_TRACE_BUFFER)\n")
+                         f"{self.dropped_total} event(s) ({by_kind}; "
+                         f"capacity {self.capacity}; raise QK_TRACE_BUFFER "
+                         f"or sample with QK_TRACE_SAMPLE)\n")
         evs = self.snapshot(last_n=last_n)
         stream.write(f"[flight-recorder] last {len(evs)} event(s):\n")
         for (_seq, ts, kind, name, dur, thread, args) in evs:
@@ -187,6 +256,9 @@ class FlightRecorder:
         self._seq = itertools.count()
         self._last = -1
         self._current.clear()
+        self._dropped_by.clear()
+        self._sampled_by.clear()
+        self._sample_seq = {k: itertools.count() for k in self._sample}
 
 
 def _capacity_from_env() -> int:

@@ -372,8 +372,21 @@ def calibrate(rows: Optional[int] = None, reps: int = 3,
     from quokka_tpu.ops import asof as asof_ops
     from quokka_tpu.ops import hashtable, join as join_ops, kernels
 
-    rows = int(rows or int(os.environ.get("QK_STRATEGY_CALIB_ROWS",
-                                          str(1 << 16))))
+    if rows is None:
+        env = os.environ.get("QK_STRATEGY_CALIB_ROWS")
+        if env:
+            rows = int(env)
+        else:
+            # prefer measured cardinalities (obs/opstats.py cardprofile):
+            # probe at the batch sizes real plans on this backend actually
+            # produced, not a fixed guess.  Clamped — the calibration matrix
+            # times dozens of candidates and must stay sub-second-ish.
+            from quokka_tpu.obs import opstats
+
+            measured = opstats.measured_calib_rows()
+            rows = min(max(int(measured), 1 << 12), 1 << 20) \
+                if measured else (1 << 16)
+    rows = int(rows)
     r = np.random.default_rng(7)
     timings: Dict[str, Dict[str, float]] = {}
 

@@ -45,7 +45,7 @@ from quokka_tpu.runtime.task import (
     TapedInputTask,
 )
 from quokka_tpu import obs
-from quokka_tpu.obs import memplane
+from quokka_tpu.obs import memplane, opstats
 from quokka_tpu.obs import spans as tracing
 from quokka_tpu.target_info import (
     BroadcastPartitioner,
@@ -213,6 +213,10 @@ class TaskGraph:
             else:
                 memplane.LEDGER.on_query_gc(
                     self.query_id, plan_fp=getattr(self, "plan_fp", None))
+            # operator-stats plane: final snapshot, measured cardinalities
+            # persisted under the plan fingerprint, per-query gauges GC'd
+            opstats.OPSTATS.on_query_gc(
+                self.query_id, plan_fp=getattr(self, "plan_fp", None))
             obs.REGISTRY.remove(f"cache.plan_hit.{self.query_id}",
                                 f"cache.plan_miss.{self.query_id}",
                                 f"task.latency_s.{self.query_id}",
@@ -531,6 +535,11 @@ class Engine:
                 for ch in range(info.channels):
                     self.execs[(info.id, ch)] = self._bind_executor(
                         info.executor_factory())
+        # upgrade the plan's exec labels to the bound executor class names
+        # (register_plan already ran in _init_latency_hists)
+        opstats.OPSTATS.register_plan(
+            graph, op_names={aid: type(ex).__name__
+                             for (aid, ch), ex in self.execs.items()})
 
     def _bind_executor(self, executor):
         """Streaming executors resolve their pane/late counters (global +
@@ -647,7 +656,16 @@ class Engine:
                     self._shuffle_bytes.inc(nb)
                     if self._shuffle_bytes_q is not None:
                         self._shuffle_bytes_q.inc(nb)
+                qid = getattr(self.g, "query_id", None)
                 for tgt_ch, part in parts.items():
+                    # delivered rows per (edge, target channel): the skew
+                    # histogram.  Host count when known; else the part's
+                    # async nrows_dev scalar (resolved at flush) — never a
+                    # fresh device sync
+                    opstats.OPSTATS.edge(
+                        qid, actor, tgt_actor, tgt_ch,
+                        part.nrows if part.nrows is not None
+                        else part.nrows_dev)
                     name = (actor, channel, seq, tgt_actor, actor, tgt_ch)
                     if self.g.hbq is not None:
                         # spill post-partition (core.py:311-313): replayable
@@ -833,6 +851,7 @@ class Engine:
             self.store.ntt_push(task.actor, task)
             return False
         batch = self._take_prefetched(info, task, seq)
+        rows_raw = self._rows_of(batch)  # pre-predicate: what the reader read
         if info.predicate is not None:
             with tracing.span("source.predicate"):
                 batch = info.predicate(batch)
@@ -847,6 +866,10 @@ class Engine:
         # a device sync per batch when a source predicate filtered device-side
         rows = batch.nrows if batch.nrows is not None else 0
         self._metric(task.actor, task.channel, rows, _batch_nbytes(batch))
+        opstats.OPSTATS.scan(
+            getattr(self.g, "query_id", None), task.actor, task.channel,
+            rows_raw, self._rows_of(batch), _batch_nbytes(batch),
+            batch.padded_len)
         with self.store.transaction():
             self.store.sadd("GIT", (task.actor, task.channel), seq)
         nxt = task.advance()
@@ -1001,6 +1024,7 @@ class Engine:
     def handle_exec_task(self, task: ExecutorTask) -> bool:
         info = self.g.actors[task.actor]
         executor = self.execs[(task.actor, task.channel)]
+        qid = getattr(self.g, "query_id", None)
         # prune exhausted sources against DST/LIT; notify the executor so
         # multi-stream operators can finalize a side (build completion)
         out_seq = task.out_seq
@@ -1013,7 +1037,10 @@ class Engine:
                         del chans[ch]
             if not chans:
                 del task.input_reqs[src]
-                extra = executor.source_done(info.source_streams[src], task.channel)
+                with opstats.OPSTATS.current_op(qid, task.actor,
+                                                task.channel):
+                    extra = executor.source_done(
+                        info.source_streams[src], task.channel)
                 # emit decisions never inspect device data (a live-row count is
                 # a full host round trip); empty batches flow and are harmless
                 emitted = extra is not None
@@ -1021,12 +1048,15 @@ class Engine:
                     self._stamp_exec_wm(executor, extra, task.channel)
                     self._emit(info, task.channel, out_seq, extra)
                     self._metric(task.actor, task.channel, self._rows_of(extra), 0)
+                    opstats.OPSTATS.exec_out(qid, task.actor, task.channel,
+                                             self._rows_of(extra))
                     out_seq += 1
                 self._tape(task.actor, task.channel,
                            ("srcdone", info.source_streams[src], emitted))
         task.out_seq = out_seq
         if not task.input_reqs:
-            with tracing.span(f"done.{type(executor).__name__}"):
+            with tracing.span(f"done.{type(executor).__name__}"), \
+                    opstats.OPSTATS.current_op(qid, task.actor, task.channel):
                 out = executor.done(task.channel)
             # spill-tier executors (external sort, grace join) emit their
             # result as a lazy SEQUENCE of bounded batches — a generator keeps
@@ -1040,6 +1070,8 @@ class Engine:
                     self._stamp_exec_wm(executor, o, task.channel)
                     self._emit(info, task.channel, out_seq, o)
                     self._metric(task.actor, task.channel, self._rows_of(o), 0)
+                    opstats.OPSTATS.exec_out(qid, task.actor, task.channel,
+                                             self._rows_of(o))
                     out_seq += 1
             # all sink emissions must land before DST says done: a consumer
             # (collect, coordinator result read) may act on "done" immediately
@@ -1066,7 +1098,9 @@ class Engine:
         _note(src=src_actor, **{"in": [[n[1], n[2]] for n in names]})
         batches = [self.cache.get(n) for n in names]
         stream_id = info.source_streams[src_actor]
-        with tracing.span(f"exec.{type(executor).__name__}"):
+        opstats.OPSTATS.exec_in(qid, task.actor, task.channel, batches)
+        with tracing.span(f"exec.{type(executor).__name__}"), \
+                opstats.OPSTATS.current_op(qid, task.actor, task.channel):
             out = executor.execute(batches, stream_id, task.channel)
         out_seq = task.out_seq
         emitted = out is not None
@@ -1076,6 +1110,8 @@ class Engine:
                 self._emit(info, task.channel, out_seq, out)
             out_seq += 1
         self._metric(task.actor, task.channel, self._rows_of(out), 0)
+        opstats.OPSTATS.exec_out(qid, task.actor, task.channel,
+                                 self._rows_of(out))
         self._tape(task.actor, task.channel, ("exec", src_actor, tuple(names), emitted))
         consumed: Dict[int, Dict[int, int]] = {src_actor: {}}
         for (sa, sch, seq, *_rest) in names:
@@ -1141,6 +1177,9 @@ class Engine:
             with self._metrics_guard():
                 snap = m.snapshot()
             self.store.set(key, snap)
+            # same cadence for the operator-stats plane: queued nrows_dev
+            # scalars (async copies long landed) fold into the ledger here
+            opstats.OPSTATS.resolve_pending()
 
     def _shutdown_prefetch(self) -> None:
         """Cancel speculative reads and release the IO threads — without this
@@ -1625,7 +1664,10 @@ class Engine:
             t0 = time.perf_counter()
             ok = self._dispatch(task)
             if ok:
-                self._observe_latency(time.perf_counter() - t0)
+                dt = time.perf_counter() - t0
+                self._observe_latency(dt)
+                opstats.OPSTATS.dispatch_time(qid, task.actor, task.channel,
+                                              dt)
             return ok
         qargs = {"a": task.actor, "c": task.channel, "k": task.name}
         if qid is not None:
@@ -1649,6 +1691,7 @@ class Engine:
             dt = time.perf_counter() - t0
             rec.record("task", label, dur=dt, **qargs, **note)
             self._observe_latency(dt)
+            opstats.OPSTATS.dispatch_time(qid, task.actor, task.channel, dt)
             idle.discard(key)
         elif key not in idle:
             idle.add(key)
@@ -1684,6 +1727,10 @@ class Engine:
              for ev in ("cache_hit", "miss", "prewarm_hit")}
             if qid is not None else None)
         self._plan_fp = getattr(graph, "plan_fp", None)
+        # operator-statistics plane: topology registered once while the
+        # graph is alive (covers the distributed Worker too, whose __init__
+        # bypasses Engine's); recording for an unregistered query is a no-op
+        opstats.OPSTATS.register_plan(graph)
 
     def _observe_latency(self, dt: float) -> None:
         """Dispatch latency into the typed histograms (resolved once in

@@ -50,8 +50,10 @@ class WorkerState:
     events_seq: int = -1
     # flight-recorder events this worker's ring has overwritten (nonzero
     # means the coordinator's merged timeline is missing this worker's
-    # earliest tail — surfaced as a warning in stall reports)
-    dropped: int = 0
+    # earliest tail — surfaced as a warning in stall reports); ships as
+    # the recorder's per-event-type dict, but 0/int from older states is
+    # still understood downstream (merge._drop_total)
+    dropped: object = 0
     ts: float = field(default=0.0)
 
 

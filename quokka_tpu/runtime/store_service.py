@@ -31,6 +31,35 @@ from quokka_tpu.runtime.tables import ControlStore
 FLIGHT_KEEP_EVENTS = 4096
 
 
+def _task_summary(task) -> Optional[str]:
+    """Compact one-line rendering of a task's arguments for the in-flight
+    pop record: enough to replay "what was it chewing on" from a stall
+    dump without shipping the whole object.  Never raises."""
+    try:
+        kind = getattr(task, "name", "?")
+        if kind == "input":
+            tape = getattr(task, "tape", None) or []
+            head = ",".join(str(s) for s in tape[:3])
+            more = f"+{len(tape) - 3}" if len(tape) > 3 else ""
+            return f"tape=[{head}{more}]"
+        if kind in ("exec", "exectape"):
+            reqs = getattr(task, "input_reqs", None) or {}
+            req_s = ";".join(
+                f"a{a}:{{{','.join(f'{c}>={s}' for c, s in sorted(chs.items()))}}}"
+                for a, chs in sorted(reqs.items()))
+            out = (f"state_seq={getattr(task, 'state_seq', '?')} "
+                   f"out_seq={getattr(task, 'out_seq', '?')} reqs={req_s}")
+            if kind == "exectape":
+                out += f" tape_pos={getattr(task, 'tape_pos', '?')}"
+            return out
+        if kind == "replay":
+            specs = getattr(task, "replay_specs", None) or []
+            return f"replays={len(specs)}"
+        return None
+    except Exception:  # noqa: BLE001 — diagnostics must not break pops
+        return None
+
+
 class CoordinatorStore(ControlStore):
     """ControlStore + coordinator-side mailboxes, heartbeat state, flight
     streams and in-flight pop records (served by RpcServer)."""
@@ -43,10 +72,13 @@ class CoordinatorStore(ControlStore):
         self.worker_states: Dict[int, object] = {}
         # worker -> deque of flight-recorder event tuples (obs/recorder.py)
         self.flights: Dict[int, Deque[tuple]] = {}
-        # worker -> (actor, channel, task_kind, popped_at): what each worker
-        # took most recently — recorded AT POP TIME on the coordinator, so a
-        # dispatch that wedges before its next heartbeat is still named
-        self.inflight: Dict[int, Tuple[int, Optional[int], str, float]] = {}
+        # worker -> (actor, channel, task_kind, popped_at, args_summary):
+        # what each worker took most recently — recorded AT POP TIME on the
+        # coordinator, so a dispatch that wedges before its next heartbeat
+        # is still named, WITH the task's arguments (seq positions / input
+        # requests) so the dump says what the wedged dispatch was chewing on
+        self.inflight: Dict[
+            int, Tuple[int, Optional[int], str, float, Optional[str]]] = {}
         self.mailboxes: Dict[int, List] = {}
         # flight-recorder seq at this run's start: run_distributed stamps it
         # so dumps/exports exclude the process-global ring's earlier runs
@@ -93,7 +125,7 @@ class CoordinatorStore(ControlStore):
             with self._lock:
                 self.inflight[worker] = (
                     node, getattr(task, "channel", None), task.name,
-                    time.time())
+                    time.time(), _task_summary(task))
         return task
 
     def mailbox_push(self, worker_id: int, msg):

@@ -68,16 +68,22 @@ def estimate_working_set(graph) -> int:
     (obs/memplane.py), and that figure beats any hint-derived guess — it
     already includes decode expansion, pipeline depth and join build state,
     so neither the PIPELINE_OVERHEAD scale nor the MIN_ESTIMATE_BYTES floor
-    applies (a genuinely small query should be admitted as small).  Fresh
-    plans fall back to reader size hints (readers.py ``size_hint``),
-    floored and scaled for decode/pipeline overhead."""
+    applies (a genuinely small query should be admitted as small).  Next
+    preference: measured source cardinalities (obs/opstats.py cardprofile)
+    — actual bytes the plan's scans produced last run, scaled for pipeline
+    overhead but NOT floored to MIN_ESTIMATE_BYTES (measured-small stays
+    small).  Fresh plans fall back to reader size hints (readers.py
+    ``size_hint``), floored and scaled for decode/pipeline overhead."""
     fp = getattr(graph, "plan_fp", None)
     if fp:
-        from quokka_tpu.obs import memplane
+        from quokka_tpu.obs import memplane, opstats
 
         measured = memplane.measured_footprint(fp)
         if measured:
             return max(int(measured), 1 << 20)
+        src_bytes = opstats.measured_source_bytes(fp)
+        if src_bytes:
+            return max(int(src_bytes * PIPELINE_OVERHEAD), 1 << 20)
     total = 0
     for info in graph.actors.values():
         if info.kind != "input" or info.reader is None:

@@ -382,6 +382,9 @@ class QueryService:
                         f"mem.peak_bytes.{qid}", 0),
                     "mem_spill_bytes": counters.get(
                         f"mem.spill_resident_bytes.{qid}", 0),
+                    # EXPLAIN ANALYZE plane: the session's hottest operator
+                    # (non-creating ledger lookup; None before first stats)
+                    "top_operator": obs.OPSTATS.top_operator(qid),
                 }
                 if s.streaming:
                     # standing-query row: source watermarks + pane/late

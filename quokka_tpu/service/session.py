@@ -70,6 +70,9 @@ class QuerySession:
         # memory-plane footprint ({live, peak, spill_resident} bytes),
         # snapshotted at finish before the ledger drops the query
         self.mem_stats: Optional[Dict] = None
+        # operator-statistics snapshot (obs/opstats.py), taken at finish
+        # before on_query_gc drops the per-query ledger state
+        self.opstats: Optional[Dict] = None
 
     # -- finish (exactly once) ----------------------------------------------
     def finish(self, error: Optional[BaseException] = None) -> bool:
@@ -102,6 +105,9 @@ class QuerySession:
             from quokka_tpu.obs import memplane
 
             self.mem_stats = memplane.LEDGER.query_footprint(self.query_id)
+            from quokka_tpu.obs import opstats
+
+            self.opstats = opstats.OPSTATS.snapshot(self.query_id)
             try:
                 # a standing query that FAILED (or was shut down mid-stream)
                 # keeps its durable recovery trio — checkpoints, HBQ spill,
@@ -214,6 +220,20 @@ class QueryHandle:
         from quokka_tpu.obs import memplane
 
         return memplane.LEDGER.query_footprint(self.query_id)
+
+    def explain(self, as_dict: bool = False):
+        """EXPLAIN ANALYZE: the plan DAG annotated with measured actuals —
+        per-operator rows/selectivity/time share, the per-exchange-edge skew
+        report, top hot operators.  Live over the operator-stats ledger
+        while the query runs; the finish-time snapshot after.  ``as_dict``
+        returns the raw snapshot instead of the rendered text."""
+        from quokka_tpu.obs import explain as explain_mod, opstats
+
+        snap = (dict(self._s.opstats) if self._s.opstats is not None
+                else opstats.OPSTATS.snapshot(self.query_id))
+        if as_dict:
+            return snap
+        return explain_mod.render(snap)
 
     def timings(self) -> Dict[str, Optional[float]]:
         s = self._s

@@ -156,6 +156,10 @@ class StreamingWindowAggExecutor(_StreamingExecutor):
             wid = wid[~late]
         if not len(df):
             return
+        # EXPLAIN ANALYZE: rows absorbed into open panes (post-late-drop)
+        from quokka_tpu.obs import opstats
+
+        opstats.note(pane_rows=len(df))
         # de-duplicated selection: two aggs over one column (min+max) or an
         # agg column doubling as a key would otherwise produce duplicate
         # labels, and gdf[col] would hand back a DataFrame instead of a

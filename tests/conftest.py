@@ -23,6 +23,11 @@ os.environ.setdefault("QK_COORD_TIMEOUT", "120")
 # kernels tests exercise.  "" disables profile load/persist; tests that
 # exercise calibration point QK_STRATEGY_DIR at a tmp dir and reset().
 os.environ.setdefault("QK_STRATEGY_DIR", "")
+# Same discipline for the admission feedback profiles (obs/memplane.py
+# measured footprints, obs/opstats.py measured cardinalities): a developer
+# box with populated caches would flip est_bytes in admission tests.
+os.environ.setdefault("QK_MEMPROFILE_DIR", "")
+os.environ.setdefault("QK_CARDPROFILE_DIR", "")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()

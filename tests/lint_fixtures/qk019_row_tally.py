@@ -1,0 +1,26 @@
+"""QK019 fixture: ad-hoc per-operator row/byte tallies.
+
+Three findings: a stat-named attribute increment, a string-keyed dict
+tally increment, and the ``.get()`` read-modify-write spelling.  The
+operational-state names below them (``pending_rows``, ``_build_rows``)
+are buffers a channel drains, not statistics — exempt by design.
+"""
+
+
+class JoinChannel:
+    def __init__(self):
+        self.rows_in = 0
+        self._tally = {}
+        self.pending_rows = 0
+        self._build_rows = 0
+
+    def absorb(self, batch, nb):
+        self.rows_in += batch.nrows  # finding 1: attribute tally
+        self._tally["bytes_out"] += nb  # finding 2: dict-slot tally
+
+    def absorb_rmw(self, t, n):
+        t["rows_in"] = t.get("rows_in", 0) + n  # finding 3: RMW spelling
+
+    def buffer(self, table):
+        self.pending_rows += table.num_rows  # exempt: operational state
+        self._build_rows += table.num_rows  # exempt: build buffer

@@ -231,6 +231,32 @@ class TestStrategyHonesty:
         rows, bad = bench.check_strategy_honesty(cur, require=False)
         assert not bad
 
+    def test_fresh_run_requires_operators(self, bench):
+        """Fresh join/asof lines must carry the EXPLAIN ANALYZE block
+        (detail.operators); a missing block is a regression."""
+        cur = {m: _line(m, 0.5, {"platform": "cpu"})
+               for m in bench.STRATEGY_REQUIRED_METRICS}
+        rows, bad = bench.check_operators_presence(cur, require=True)
+        assert len(bad) == len(bench.STRATEGY_REQUIRED_METRICS)
+        assert all(status == "MISSING" for _, status, _ in rows)
+        # presence satisfies the gate — flat detail or nested geomean shape
+        ops = {"operators": [{"actor": 1, "op": "JoinExecutor"}],
+               "skew": [], "rows_unknown": 0}
+        cur = {m: _line(m, 0.5, {"operators": ops})
+               for m in bench.STRATEGY_REQUIRED_METRICS}
+        rows, bad = bench.check_operators_presence(cur, require=True)
+        assert not bad and all(status == "ok" for _, status, _ in rows)
+        nested = {"tpch_q3_speedup_vs_ref_per_chip": _line(
+            "tpch_q3_speedup_vs_ref_per_chip", 0.5,
+            {"queries": {"q3": {"operators": ops}}})}
+        rows, bad = bench.check_operators_presence(nested, require=True)
+        assert not bad
+        # --current file-vs-file mode never requires presence
+        rows, bad = bench.check_operators_presence(
+            {m: _line(m, 0.5) for m in bench.STRATEGY_REQUIRED_METRICS},
+            require=False)
+        assert not bad and not rows
+
 
 def test_cli_subprocess_roundtrip(tmp_path):
     """The real `python bench.py --check` entry point, end to end."""

@@ -152,17 +152,47 @@ class TestEndToEnd:
 
 
 class TestDroppedCounter:
-    def test_ring_overwrite_counts_drops(self):
-        rec = FlightRecorder(capacity=16, enabled=True)
-        assert rec.dropped == 0
-        for i in range(40):
+    def test_ring_overwrite_counts_drops_per_type(self):
+        rec = FlightRecorder(capacity=16, enabled=True, sample={})
+        assert rec.dropped_total == 0 and rec.dropped == {}
+        for i in range(24):
             rec.record("k", f"e{i}")
-        assert rec.dropped == 24  # 40 recorded - 16 retained
+        for i in range(16):
+            rec.record("other", f"o{i}")
+        # 40 recorded - 16 retained = 24 evicted, attributed by KIND: the
+        # first 16 "k" events fell to the later "k"s, then the 16 "other"s
+        assert rec.dropped_total == 24
+        assert rec.dropped == {"k": 24}
         out = io.StringIO()
         rec.dump_text(out)
         assert "dropped 24 event(s)" in out.getvalue()
+        assert "k=24" in out.getvalue()
         rec.reset()
-        assert rec.dropped == 0
+        assert rec.dropped_total == 0 and rec.dropped == {}
+
+    def test_sampling_elides_listed_kinds_only(self, monkeypatch):
+        rec = FlightRecorder(capacity=64, enabled=True, sample={"task": 4})
+        kept = sum(1 for i in range(16)
+                   if rec.record("task", f"t{i}") >= 0)
+        assert kept == 4  # deterministic 1-in-4
+        assert rec.sampled == {"task": 12}
+        assert all(rec.record("stall", f"s{i}") >= 0 for i in range(8))
+        assert rec.dropped_total == 0  # sampling is not ring eviction
+        rec.reset()
+        assert rec.sampled == {}
+
+    def test_sample_env_parsing(self, monkeypatch):
+        from quokka_tpu.obs import recorder as rmod
+        monkeypatch.setenv("QK_TRACE_SAMPLE", "8")
+        rates = rmod._sample_from_env()
+        assert rates and all(v == 8 for v in rates.values())
+        assert set(rates) == set(rmod._DEFAULT_SAMPLED_KINDS)
+        monkeypatch.setenv("QK_TRACE_SAMPLE", "task=8,rpc=2,junk,x=0")
+        assert rmod._sample_from_env() == {"task": 8, "rpc": 2}
+        monkeypatch.setenv("QK_TRACE_SAMPLE", "1")
+        assert rmod._sample_from_env() == {}
+        monkeypatch.setenv("QK_TRACE_SAMPLE", "")
+        assert rmod._sample_from_env() == {}
 
     def test_stall_report_warns_on_drops(self):
         merged = obs.merge_streams({"w0": _synthetic_stream()})
@@ -173,3 +203,12 @@ class TestDroppedCounter:
         clean = obs.stall_report("test", merged, {}, {}, {},
                                  dropped={"w0": 0})
         assert "WARNING: flight-recorder" not in clean
+
+    def test_stall_report_renders_per_type_drop_dicts(self):
+        merged = obs.merge_streams({"w0": _synthetic_stream()})
+        report = obs.stall_report(
+            "test", merged, {}, {}, {},
+            dropped={"w0": {"task": 5, "rpc": 2}, "w1": {}})
+        line = report.split("WARNING")[1].splitlines()[0]
+        assert "w0=7(rpc:2,task:5)" in line
+        assert "w1" not in line

@@ -28,6 +28,7 @@ CASES = [
     ("QK012", "qk012_raw_len_key.py", 3),    # sig tuple, .get key, store key
     ("QK013", "qk013_platform_gate.py", 3),  # probe, string gate, _platform
     ("QK018", "qk018_device_alloc.py", 3),   # jnp.zeros, device_put, asarray
+    ("QK019", "qk019_row_tally.py", 3),      # attr +=, dict-slot +=, .get RMW
 ]
 
 

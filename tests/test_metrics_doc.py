@@ -1,0 +1,84 @@
+"""Doc-drift gate: every metric family the runtime can emit must be
+documented in README.md's metric-families table.
+
+Family names come from two places, both checked:
+
+1. The naming tables in obs/export.py (_LABEL_FAMILIES, _EXACT_FAMILIES,
+   the strategy two-label special case) — the curated families.
+2. Every string-literal instrument registration in the source tree
+   (``REGISTRY.counter("...")`` etc.), mapped through export._family —
+   the fallback-named families.  F-string registrations are per-query /
+   per-site twins of families already covered by (1).
+
+Adding a metric without a README row fails here, in the same PR.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+from quokka_tpu.obs import export
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_PKG = os.path.join(_ROOT, "quokka_tpu")
+_README = os.path.join(_ROOT, "README.md")
+
+# REGISTRY.counter("a.b")-style literal registrations (f-strings excluded:
+# their families are the labeled ones declared in _LABEL_FAMILIES)
+_REG_RE = re.compile(r"\b(counter|gauge|histogram)\(\s*\"([a-z0-9_.]+)\"")
+
+
+def _source_instruments():
+    found = set()
+    for dirpath, dirnames, filenames in os.walk(_PKG):
+        dirnames[:] = [d for d in dirnames if not d.startswith("__")]
+        for fn in filenames:
+            if not fn.endswith(".py"):
+                continue
+            with open(os.path.join(dirpath, fn), encoding="utf-8") as f:
+                for kind, name in _REG_RE.findall(f.read()):
+                    found.add((kind, name))
+    assert found, "instrument scan found nothing — regex or layout drift"
+    return found
+
+
+def _documented_families():
+    with open(_README, encoding="utf-8") as f:
+        text = f.read()
+    fams = set(re.findall(r"`(quokka_[a-z0-9_]+)`", text))
+    assert fams, "README has no quokka_* family names — table moved?"
+    return fams
+
+
+def _expected_families():
+    expected = set()
+    for kind, _prefix, fam, _key in export._LABEL_FAMILIES:
+        expected.add(fam + ("_total" if kind == "counter" else ""))
+    for (kind, _name), fam in export._EXACT_FAMILIES.items():
+        expected.add(fam + ("_total" if kind == "counter" else ""))
+    expected.add("quokka_kernel_strategy_used_total")
+    for kind, name in _source_instruments():
+        fam, _label = export._family(name, kind)
+        expected.add(fam + ("_total" if kind == "counter" else ""))
+    # exporter-level extra gauges (export.metrics_text extra_gauges)
+    expected.add("quokka_obs_dropped_events")
+    expected.add("quokka_uptime_seconds")
+    return expected
+
+
+def test_every_metric_family_is_documented():
+    documented = _documented_families()
+    missing = sorted(f for f in _expected_families() if f not in documented)
+    assert not missing, (
+        "metric families missing from README.md's metric-families table "
+        f"(add a row per family): {missing}")
+
+
+def test_documented_quokka_families_parse():
+    """The table rows use real family names: each documented quokka_*
+    string must be producible by the naming rules (sanity against typos
+    going stale the other way is intentionally loose — README may
+    document families only emitted under optional planes)."""
+    for fam in _documented_families():
+        assert re.fullmatch(r"quokka_[a-z0-9_]+", fam)

@@ -259,8 +259,9 @@ def test_heartbeat_state_and_inflight_pop_records():
     cs.ntt_push(2, ExecutorTask(2, 0, 0, 0, {}))
     task = cs.ntt_pop(2, [0], 0)
     assert task is not None
-    actor, ch, kind, t = cs.inflight[0]
+    actor, ch, kind, t, args = cs.inflight[0]
     assert (actor, ch, kind) == (2, 0, "exec")
+    assert "state_seq=0" in args and "out_seq=0" in args
     cs.flight_append(0, [_ev(0, 1.0), _ev(1, 2.0)])
     assert len(cs.flight_streams()["worker-0"]) == 2
 
