@@ -4,7 +4,7 @@
 PY ?= python
 export JAX_PLATFORMS ?= cpu
 
-.PHONY: lint lint-baseline verify-static test test-slow sanitize-demo service-smoke chaos-smoke obs-smoke bench-check shuffle-smoke warmup-smoke multichip-smoke stream-smoke mem-smoke explain-smoke
+.PHONY: lint lint-baseline verify-static test test-slow sanitize-demo service-smoke chaos-smoke obs-smoke bench-check shuffle-smoke fusion-smoke warmup-smoke multichip-smoke stream-smoke mem-smoke explain-smoke
 
 # engine-invariant static analysis; exits nonzero on findings beyond the
 # checked-in baseline (quokka_tpu/analysis/baseline.json)
@@ -72,6 +72,13 @@ bench-check:
 # sanitizer sentinel), with nonzero shuffle.bytes proving the exchange ran
 shuffle-smoke:
 	$(PY) -m quokka_tpu.runtime.shuffle_smoke
+
+# whole-stage-fusion smoke: a Q3-shaped linear join chain must plan into a
+# FusedStageExecutor (stagefuse.exec > 0), run warm with ZERO real
+# recompiles and ZERO blocking host syncs, and match the QK_STAGE_FUSE=0
+# re-plan BIT-EXACTLY on integer-valued data (ops/stagefuse.py)
+fusion-smoke:
+	$(PY) -m quokka_tpu.runtime.fusion_smoke
 
 # compile-plane smoke: run a Q3-shaped query in one process (populating the
 # XLA + AOT executable caches and the plan ledger), then again in a FRESH

@@ -447,6 +447,19 @@ def _operators_detail():
         return None
 
 
+def _fused_stages(operators):
+    """How many whole-stage-fused operators actually dispatched in the last
+    timed run (detail.operators rows whose op is a FusedStage,
+    ops/stagefuse.py).  The join queries must report >= 1: `--check` treats
+    a fresh join line without the field — or with zero fused stages while
+    fusion is on by default — as the fusion win silently evaporating."""
+    if not operators:
+        return 0
+    return sum(1 for o in operators.get("operators") or ()
+               if str(o.get("op", "")).startswith("FusedStage")
+               and o.get("dispatches", 0) > 0)
+
+
 def _write_obs_summary(obs_per_query):
     """Per-query span/counter breakdown JSON next to the timing output
     (BENCH_*.json gains compile-vs-compute-vs-transfer visibility)."""
@@ -596,6 +609,7 @@ def measure(paths):
         if trace_print:
             sys.stderr.write(f"[spans] {qname} timed runs (3)\n"
                              + obs_spans.summary() + "\n")
+        ops_detail = _operators_detail()
         per_query[qname] = {
             "seconds": round(t, 4),
             "seconds_all": [round(x, 4) for x in times],
@@ -628,7 +642,11 @@ def measure(paths):
             # snapshot stashed at query GC): per-operator rows/selectivity/
             # time share + the per-exchange-edge skew report.  `--check`
             # treats a missing block on join/asof queries as a regression.
-            "operators": _operators_detail(),
+            "operators": ops_detail,
+            # proof the whole-stage-fused plan is what was measured: count
+            # of FusedStage operators that dispatched (`--check` gates the
+            # join lines on this being >= 1)
+            "fused_stages": _fused_stages(ops_detail),
             **extra,
         }
         # QK_SANITIZE=1: the recompile sentinel fails the run outright when
@@ -676,6 +694,7 @@ def measure(paths):
         asof_rows = ASOF_TRADES + ASOF_QUOTES
         asof_rps = asof_rows / asof_times[0]
         asof_speedup = asof_rps / REF_ASOF_ROWS_PER_S_PER_WORKER
+        asof_ops = _operators_detail()
         print(json.dumps({
             "metric": "tick_asof_rows_per_s_per_chip",
             "value": round(asof_rps),
@@ -687,7 +706,8 @@ def measure(paths):
                 "seconds_all": [round(x, 4) for x in asof_times],
                 "ref_rows_per_s_per_worker": round(REF_ASOF_ROWS_PER_S_PER_WORKER),
                 "strategy": kstrategy.used_snapshot(),
-                "operators": _operators_detail(),
+                "operators": asof_ops,
+                "fused_stages": _fused_stages(asof_ops),
             },
         }))
         sys.stdout.flush()
@@ -905,6 +925,49 @@ def check_operators_presence(cur, require):
                          "EXPLAIN ANALYZE ledger saw nothing for this "
                          "query (opstats regression)"))
             bad.append(name)
+    return rows, bad
+
+
+# Benched join lines that MUST prove the whole-stage-fused plan actually
+# ran (detail.fused_stages >= 1, counted off the opstats FusedStage rows):
+# Q3/Q5 are exactly the linear probe chains ops/stagefuse.py collapses.
+FUSION_REQUIRED_METRICS = (
+    "tpch_q3_speedup_vs_ref_per_chip",
+    "tpch_q5_speedup_vs_ref_per_chip",
+)
+
+
+def check_fused_stages_presence(cur, require):
+    """Whole-stage-fusion honesty rows: fresh join lines must carry
+    ``detail.fused_stages`` and report at least one fused stage that
+    dispatched.  A missing field means the emitter predates stage fusion
+    (or the opstats ledger went blind); a zero means the optimizer planned
+    no fused chain on a query shaped exactly for one.  Either way the
+    fusion win silently evaporated — a regression, same presence
+    discipline as strategy/operators.  Returns (rows, violations)."""
+    rows, bad = [], []
+    if not require:
+        return rows, bad
+    for metric in FUSION_REQUIRED_METRICS:
+        if metric not in cur:
+            continue
+        name = f"fused_stages[{metric}]"
+        detail = cur[metric].get("detail") or {}
+        n = detail.get("fused_stages")
+        if n is None:
+            rows.append((name, "MISSING",
+                         "benched join line records no detail.fused_stages "
+                         "— cannot verify the whole-stage-fused plan is "
+                         "what was measured"))
+            bad.append(name)
+        elif n < 1:
+            rows.append((name, "MISSING",
+                         "detail.fused_stages == 0 — no fused stage "
+                         "dispatched on a linear join chain (stage fusion "
+                         "regressed or was disabled for the bench)"))
+            bad.append(name)
+        else:
+            rows.append((name, "ok", f"{n} fused stage(s) dispatched"))
     return rows, bad
 
 
@@ -1281,7 +1344,12 @@ def check_main(argv):
     o_rows, o_bad = check_operators_presence(
         cur, require=(args.current is None))
     regressed += o_bad
-    s_rows = s_rows + o_rows
+    # whole-stage-fusion honesty: fresh join lines must show the fused
+    # plan actually dispatched (detail.fused_stages >= 1)
+    f_rows, f_bad = check_fused_stages_presence(
+        cur, require=(args.current is None))
+    regressed += f_bad
+    s_rows = s_rows + o_rows + f_rows
     out = sys.stdout
     out.write(f"bench --check: {cur_src} vs {against}\n")
     if base_truncated:

@@ -81,6 +81,18 @@ Rule ids:
                                 operational state (bare ``rows``,
                                 ``pending_rows``, build buffers) is not a
                                 stat and is not flagged
+  QK020 multi-program-chain     executor bodies dispatching a CHAIN of
+                                single-expression jit programs per batch —
+                                ``evaluate_predicate``/``evaluate_to_column``
+                                inside a per-expression loop, or more than
+                                two straight-line calls in one function.
+                                Each call launches its own program over the
+                                whole batch; a linear chain of them is
+                                exactly what whole-stage fusion collapses
+                                into ONE program (ops/stagefuse.py
+                                FusedElementwise, ops/fuse.py builders).
+                                Deliberate fallback/finalize paths baseline
+                                with a rationale
 
 Finding keys (``Finding.key``) are line-number-free — ``rule::relpath::
 scope::snippet[::n]`` — so a baseline survives unrelated edits above the
@@ -1526,6 +1538,99 @@ def check_adhoc_operator_tally(tree: ast.Module, path: str, rel: str,
     return out
 
 
+# ---------------------------------------------------------------------------
+# QK020 — per-batch chains of single-expression program dispatches
+# ---------------------------------------------------------------------------
+
+# where the rule applies: executor bodies — the code the optimizer's
+# whole-stage fusion rewrites past.  ops/ is exempt: the fused builders
+# themselves own the deliberate expression-at-a-time fallback paths.
+_QK020_SCOPED_DIRS = ("quokka_tpu/executors/",)
+# each of these launches ONE jit program over the whole batch
+# (expr_compile compiles per expression); a chain of them per batch is
+# exactly what ops/stagefuse.FusedElementwise / the ops/fuse.py builders
+# collapse into a single program dispatch.
+_QK020_DISPATCH_CALLS = ("evaluate_predicate", "evaluate_to_column")
+# straight-line dispatches tolerated per function body before the chain
+# counts as fusible (two ~= one predicate + one projection; a third says
+# "pipeline of expression programs" rather than "a kernel and its guard")
+_QK020_MAX_STRAIGHT = 2
+
+
+def _qk020_dispatch_name(node: ast.Call) -> Optional[str]:
+    """'evaluate_predicate' / 'evaluate_to_column' behind a call, matched
+    bare or attribute-qualified (``expr_compile.evaluate_to_column``)."""
+    d = _dotted(node.func)
+    if d is None:
+        return None
+    last = d.rsplit(".", 1)[-1]
+    return last if last in _QK020_DISPATCH_CALLS else None
+
+
+def check_multi_program_chain(tree: ast.Module, path: str, rel: str,
+                              src_lines: Sequence[str]) -> List[Finding]:
+    """Flags executor bodies that dispatch a CHAIN of single-expression jit
+    programs per batch: ``evaluate_predicate``/``evaluate_to_column`` calls
+    inside a per-expression ``for``/``while`` loop (one program launch per
+    expression per batch), or more than ``_QK020_MAX_STRAIGHT`` straight-line
+    calls in one function.  Each call compiles and launches its own program
+    over the whole padded batch; a linear chain of them re-reads every
+    column from HBM per step — the exact dispatch shape whole-stage fusion
+    (ops/stagefuse.py, ops/fuse.py) collapses into one program.  Deliberate
+    CompileError fallbacks and once-per-query finalize paths baseline with
+    a rationale (shrink-only contract)."""
+    r = rel.replace("\\", "/")
+    base = r.rsplit("/", 1)[-1]
+    if not (any(d in r for d in _QK020_SCOPED_DIRS)
+            or base.startswith("qk020")):
+        return []
+    # (owner function, call node, callee, inside-loop?) with the OWNER being
+    # the innermost enclosing def — a whole-tree walk per function would
+    # double-count calls under nested defs
+    hits: List[Tuple[ast.AST, ast.Call, str, bool]] = []
+
+    def visit(node: ast.AST, fn: Optional[ast.AST], loop_depth: int) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fn, loop_depth = node, 0
+        elif isinstance(node, (ast.For, ast.While)):
+            loop_depth += 1
+        elif isinstance(node, ast.Call) and fn is not None:
+            nm = _qk020_dispatch_name(node)
+            if nm is not None:
+                hits.append((fn, node, nm, loop_depth > 0))
+        for child in ast.iter_child_nodes(node):
+            visit(child, fn, loop_depth)
+
+    visit(tree, None, 0)
+    out: List[Finding] = []
+    straight_seen: Dict[int, int] = {}
+    for fn, call, nm, looped in hits:
+        if looped:
+            out.append(_mk(
+                "QK020", "multi-program-chain", path, rel, call,
+                _scope_of(tree, call),
+                f"'{nm}(...)' inside a loop dispatches one jit program per "
+                "expression per batch — lower the chain through a fused "
+                "single-program builder (ops/fuse.py Prepass idiom) or let "
+                "stage fusion collapse it (ops/stagefuse.FusedElementwise), "
+                "or baseline with a rationale",
+                src_lines))
+            continue
+        n = straight_seen.get(id(fn), 0) + 1
+        straight_seen[id(fn)] = n
+        if n > _QK020_MAX_STRAIGHT:
+            out.append(_mk(
+                "QK020", "multi-program-chain", path, rel, call,
+                _scope_of(tree, call),
+                f"'{nm}(...)' is straight-line program dispatch #{n} in "
+                "this body (> " f"{_QK020_MAX_STRAIGHT} per batch) — a "
+                "fusible elementwise chain; fold it into one program "
+                "(ops/stagefuse.FusedElementwise / ops/fuse.py builders) "
+                "or baseline with a rationale",
+                src_lines))
+    return out
+
+
 RULES = (
     check_module_level_jit,
     check_import_time_side_effects,
@@ -1542,6 +1647,7 @@ RULES = (
     check_platform_gate,
     check_unledgered_device_alloc,
     check_adhoc_operator_tally,
+    check_multi_program_chain,
 )
 
 

@@ -132,6 +132,15 @@ def use_hash_tables() -> bool:
     return strategy.choice("groupby") == "hashtable"
 
 
+def stage_fuse_enabled() -> bool:
+    """Whole-stage fusion escape hatch (ops/stagefuse.py): QK_STAGE_FUSE=0
+    disables the optimizer's fuse_stages pass so a suspect plan can be
+    re-run with per-operator actors.  Read dynamically (not cached at
+    import) so one process can plan both variants — the fusion smoke
+    compares fused vs unfused results in-process."""
+    return os.environ.get("QK_STAGE_FUSE", "1") not in ("0", "false", "no")
+
+
 def use_host_asof() -> bool:
     """Whether the as-of match runs as a native sequential merge on host
     (ops/asof._asof_match_host -> native/columnar.cpp).  Thin delegate to

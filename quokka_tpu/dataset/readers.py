@@ -14,9 +14,13 @@ JSON-lines, and in-memory Arrow tables.
 
 from __future__ import annotations
 
+import collections
+import functools
 import glob as globmod
 import io
 import os
+import threading
+from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -66,6 +70,70 @@ class InputArrowDataset:
     def size_hint(self) -> int:
         """Estimated source bytes (query-service admission control)."""
         return self.table.nbytes
+
+
+class _Readahead:
+    """One-segment scan readahead: while a channel's current batch executes,
+    the NEXT lineage in that channel's schedule is read on a small IO pool,
+    so a cold scan overlaps disk latency with device work instead of
+    alternating read-then-compute (Q1 cold scan sat at 0.13 GB/s without it).
+
+    Reads are pure (lineage -> same bytes every time), so serving a prefetch
+    changes nothing the lineage/replay machinery can observe — a mismatched
+    or failed prefetch silently falls back to the synchronous read.  One slot
+    per (dataset, channel); the slot table is FIFO-bounded so dead datasets
+    can't pin prefetched tables forever."""
+
+    _MAX_SLOTS = 64
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pool: Optional[ThreadPoolExecutor] = None
+        self._slots: "collections.OrderedDict" = collections.OrderedDict()
+
+    def _ensure_pool(self) -> ThreadPoolExecutor:
+        with self._lock:
+            if self._pool is None:
+                self._pool = ThreadPoolExecutor(
+                    max_workers=2, thread_name_prefix="quokka-readahead"
+                )
+            return self._pool
+
+    def take(self, ds, channel: int, lineage):
+        """The prefetched table for this exact lineage, or None."""
+        key = (id(ds), channel)
+        with self._lock:
+            ent = self._slots.pop(key, None)
+        if ent is None or ent[0] != lineage:
+            return None
+        try:
+            table = ent[1].result()
+        except Exception:
+            return None
+        from quokka_tpu.obs.metrics import REGISTRY
+
+        REGISTRY.counter("scan.readahead_hit").inc()
+        return table
+
+    def arm(self, ds, channel: int, lineage, read_fn) -> None:
+        key = (id(ds), channel)
+        fut = self._ensure_pool().submit(read_fn)
+        with self._lock:
+            self._slots[key] = (lineage, fut)
+            while len(self._slots) > self._MAX_SLOTS:
+                self._slots.popitem(last=False)
+
+
+_READAHEAD = _Readahead()
+
+
+def _successor_map(state: Dict[int, List]) -> Dict:
+    """(channel, lineage) -> the channel's next lineage."""
+    succ = {}
+    for ch, pieces in state.items():
+        for cur, nxt in zip(pieces, pieces[1:]):
+            succ[(ch, cur)] = nxt
+    return succ
 
 
 def _expand_paths(path) -> List[str]:
@@ -124,9 +192,21 @@ class InputParquetDataset:
                 ):
                     continue
                 pieces.append((f, rg))
-        return {ch: pieces[ch::num_channels] for ch in range(num_channels)}
+        state = {ch: pieces[ch::num_channels] for ch in range(num_channels)}
+        self._succ = _successor_map(state)
+        return state
 
     def execute(self, channel: int, lineage) -> pa.Table:
+        table = _READAHEAD.take(self, channel, lineage)
+        if table is None:
+            table = self._read(lineage)
+        nxt = getattr(self, "_succ", {}).get((channel, lineage))
+        if nxt is not None:
+            _READAHEAD.arm(self, channel, nxt,
+                           functools.partial(self._read, nxt))
+        return table
+
+    def _read(self, lineage) -> pa.Table:
         f, rg = lineage
         # read_dictionary: string columns whose parquet pages are already
         # dictionary-encoded come back as DictionaryArray — the bridge then
@@ -267,7 +347,9 @@ class InputCSVDataset:
                 end = min(start + self.stride, size)
                 pieces.append((f, start, end))
                 start = end
-        return {ch: pieces[ch::num_channels] for ch in range(num_channels)}
+        state = {ch: pieces[ch::num_channels] for ch in range(num_channels)}
+        self._succ = _successor_map(state)
+        return state
 
     def size_hint(self) -> int:
         """Estimated source bytes (query-service admission control)."""
@@ -280,6 +362,16 @@ class InputCSVDataset:
         return total
 
     def execute(self, channel: int, lineage) -> pa.Table:
+        table = _READAHEAD.take(self, channel, lineage)
+        if table is None:
+            table = self._read(lineage)
+        nxt = getattr(self, "_succ", {}).get((channel, lineage))
+        if nxt is not None:
+            _READAHEAD.arm(self, channel, nxt,
+                           functools.partial(self._read, nxt))
+        return table
+
+    def _read(self, lineage) -> pa.Table:
         f, start, end = lineage
         data = _read_line_range(f, start, end)
         if not data:

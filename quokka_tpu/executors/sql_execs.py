@@ -48,6 +48,11 @@ def _coalesce(live: List[DeviceBatch],
 class UDFExecutor(Executor):
     """Stateless per-batch transform (DataStream.transform)."""
 
+    # carries no cross-batch state: a fused stage containing one of these
+    # checkpoints without snapshotting it (ops/stagefuse.py) — tape replay
+    # already relies on transform purity engine-wide
+    STATELESS = True
+
     def __init__(self, fn: Callable[[DeviceBatch], DeviceBatch]):
         self.fn = fn
 

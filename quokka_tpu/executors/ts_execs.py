@@ -155,6 +155,13 @@ class SortedAsofExecutor(Executor):
     # asof (they simply lose to later quotes)
     PRUNE_ROWS = 1 << 23
 
+    # asof_probe="coalesced" (ops/strategy.py): on big streams, hold ready
+    # trades until at least this many accumulate so each flush's joint sort
+    # amortizes over one large probe instead of per-dispatch slivers.  Safe
+    # to hold: quotes arrive at/after the watermark that made these trades
+    # ready, so a later flush computes the identical matches.
+    COALESCE_ROWS = 1 << 15
+
     def __init__(self, left_on: str, right_on: str, left_by, right_by,
                  suffix: str = "_2", keep_unmatched: bool = False,
                  direction: str = "backward"):
@@ -213,8 +220,18 @@ class SortedAsofExecutor(Executor):
 
     def execute(self, batches, stream_id, channel):
         from quokka_tpu.obs import opstats
+        from quokka_tpu.ops import strategy as kstrategy
 
         live = [b for b in batches if b is not None and b.count_valid() > 0]
+        if stream_id == 0:
+            mode = kstrategy.choice("asof_probe")
+            kstrategy.note_used("asof_probe", mode)
+            if mode == "coalesced" and len(live) > 1:
+                # the join probe's bucketed concat path: a dispatch's small
+                # per-partition slices merge cap-aware before buffering
+                from quokka_tpu.executors.sql_execs import _coalesce
+
+                live = _coalesce(live)
         if stream_id == 1:
             for b in live:
                 self._q_parts.append(b)
@@ -232,7 +249,8 @@ class SortedAsofExecutor(Executor):
             wm = _time_max(b, self.left_on)
             if self.t_watermark is None or wm > self.t_watermark:
                 self.t_watermark = wm
-        opstats.note(join_probe_rows=sum(b.nrows for b in live))
+        opstats.note(join_probe_rows=sum(
+            b.nrows if b.nrows is not None else b.padded_len for b in live))
         return self._flush()
 
     def source_done(self, stream_id, channel):
@@ -298,6 +316,21 @@ class SortedAsofExecutor(Executor):
         big = self._t_rows + self._q_rows > 4 * self.MIN_FLUSH_ROWS
         if big and not self.q_done and nready < self.MIN_FLUSH_ROWS:
             return None
+        # asof_probe="coalesced": mid-size streams also hold sliver flushes
+        # until a worthwhile probe accumulates (each flush pays a joint sort
+        # over the whole quote buffer).  Content-identical output — quotes
+        # arriving after the hold are at/above the watermark that made these
+        # trades ready, so they can't change a held trade's match.  The gate
+        # keys on VALID counts only (deterministic under tape replay).
+        if (
+            not self.q_done
+            and nready < self.COALESCE_ROWS
+            and self._t_rows + self._q_rows > 2 * self.COALESCE_ROWS
+        ):
+            from quokka_tpu.ops import strategy as kstrategy
+
+            if kstrategy.choice("asof_probe") == "coalesced":
+                return None
         self._materialize_quotes()
         ready = kernels.compact(kernels.apply_mask(self.trades, ready_mask))
         if ready.count_valid() == 0:

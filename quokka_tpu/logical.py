@@ -385,6 +385,127 @@ class AggNode(Node):
         return f"Agg(keys={self.keys}, out={[n for n, _ in self.plan.finals]})"
 
 
+class FusedStageNode(Node):
+    """A maximal fusible linear chain rewritten into ONE exec actor
+    (optimizer.fuse_stages).  parents[0] is the chain head's main input;
+    parents[1:] are the member joins' build sides in chain order.  Lowers to
+    a single FusedStageExecutor actor (ops/stagefuse.py): consecutive
+    filter/project/expression-map members collapse into one jitted
+    elementwise program, and a tail AggNode contributes its partial half
+    in-stage with the final-agg actors emitted exactly as AggNode.lower
+    would."""
+
+    def __init__(self, members: List[Node], parents: List[int],
+                 schema: List[str]):
+        super().__init__(parents, schema)
+        self.members = members
+        self.build_parents = list(range(1, len(parents)))
+
+    def describe(self):
+        inner = "\n".join("  " + m.describe() for m in self.members)
+        return "FusedStage(\n" + inner + "\n)"
+
+    def lower(self, ctx, graph, actor_of, node_id):
+        from quokka_tpu.executors.sql_execs import (
+            BuildProbeJoinExecutor,
+            FinalAggExecutor,
+            PartialAggExecutor,
+            UDFExecutor,
+        )
+        from quokka_tpu.ops.stagefuse import (
+            FusedElementwise,
+            FusedStageExecutor,
+            StageSpec,
+        )
+
+        steps: List[Tuple[str, Callable]] = []
+        routing: Dict[int, Tuple[int, int]] = {}
+        sources: Dict[int, Tuple[int, TargetInfo]] = {}
+        builds = iter(self.parents[1:])
+        elem: List[Tuple] = []
+        agg: Optional[AggNode] = None
+
+        def flush_elem():
+            if elem:
+                steps.append(("Elemwise", functools.partial(
+                    UDFExecutor, FusedElementwise(list(elem)))))
+                elem.clear()
+
+        head = self.members[0]
+        if isinstance(head, JoinNode) and not head.broadcast:
+            sources[0] = (actor_of[head.parents[0]],
+                          TargetInfo(HashPartitioner(head.left_on)))
+        else:
+            sources[0] = (actor_of[head.parents[0]], _passthrough_edge())
+        for m in self.members:
+            if isinstance(m, FilterNode):
+                elem.append(("filter", m.predicate))
+            elif isinstance(m, ProjectionNode):
+                elem.append(("project", list(m.schema)))
+            elif isinstance(m, MapNode) and m.exprs:
+                elem.append(("map", list(m.exprs.items())))
+            elif isinstance(m, MapNode):
+                flush_elem()
+                steps.append(("Map", functools.partial(UDFExecutor, m.fn)))
+            elif isinstance(m, JoinNode):
+                flush_elem()
+                part = (BroadcastPartitioner() if m.broadcast
+                        else HashPartitioner(m.right_on))
+                stream = len(sources)
+                sources[stream] = (actor_of[next(builds)], TargetInfo(part))
+                routing[stream] = (len(steps), 1)
+                label = "BroadcastJoin" if m.broadcast else "HashJoin"
+                steps.append((label, functools.partial(
+                    BuildProbeJoinExecutor, m.left_on, m.right_on, m.how,
+                    m.suffix, m.rename, out_schema=list(m.schema))))
+            elif isinstance(m, AggNode):
+                flush_elem()
+                steps.append(("PartialAgg", functools.partial(
+                    PartialAggExecutor, m.keys, m.plan)))
+                agg = m
+            else:  # pragma: no cover - fuse_stages only admits the above
+                raise TypeError(f"unfusible member {type(m).__name__}")
+        flush_elem()
+        fused = graph.new_exec_node(
+            functools.partial(FusedStageExecutor, StageSpec(steps, routing)),
+            sources,
+            self.channels or ctx.exec_channels,
+            self.stage,
+        )
+        if agg is None:
+            actor_of[node_id] = fused
+            return
+        # the tail agg's final half: identical actors to AggNode.lower, fed
+        # by the fused stage's in-stage partials
+        keys, plan = agg.keys, agg.plan
+        n_final = (self.channels or ctx.exec_channels) if keys else 1
+        part = HashPartitioner(keys) if keys else PassThroughPartitioner()
+        final = graph.new_exec_node(
+            functools.partial(FinalAggExecutor, keys, plan, agg.having,
+                              agg.order_by, agg.limit),
+            {0: (fused, TargetInfo(part))},
+            n_final,
+            self.stage,
+        )
+        if (agg.order_by or agg.limit is not None) and n_final > 1:
+            from quokka_tpu.executors.sql_execs import SortExecutor, TopKExecutor
+
+            names = [n for n, _ in (agg.order_by or [])]
+            desc = [d for _, d in (agg.order_by or [])]
+            if agg.limit is not None:
+                merge_factory = functools.partial(
+                    TopKExecutor, names, agg.limit, desc)
+            else:
+                merge_factory = functools.partial(SortExecutor, names, desc)
+            final = graph.new_exec_node(
+                merge_factory,
+                {0: (final, TargetInfo(PassThroughPartitioner()))},
+                1,
+                self.stage,
+            )
+        actor_of[node_id] = final
+
+
 class DistinctNode(Node):
     def __init__(self, parents, schema, keys):
         super().__init__(parents, schema)

@@ -33,6 +33,7 @@ def optimize(sub: Dict[int, logical.Node], sink_id: int,
     plan_parallel_sorts(sub, sink_id, exec_channels)
     push_ann(sub, sink_id)
     fold_maps(sub, sink_id)
+    fuse_stages(sub, sink_id)
     return sink_id
 
 
@@ -341,6 +342,112 @@ def fold_maps(sub: Dict[int, logical.Node], sink_id: int) -> None:
         if isinstance(parent, logical.SourceNode):
             continue  # the source predicate path already fuses; keep readers lean
         node.folded = True
+
+
+def _fusible_member(node: logical.Node) -> bool:
+    """May this node live inside a fused stage?  Non-blocking, streaming,
+    unordered, placement-free operators only — exactly the set
+    FusedStageNode.lower knows how to turn into in-stage steps."""
+    if node.sorted_by is not None or node.placement is not None:
+        return False
+    return isinstance(node, (
+        logical.FilterNode,
+        logical.ProjectionNode,
+        logical.MapNode,
+        logical.JoinNode,
+        logical.AggNode,
+    ))
+
+
+def fuse_stages(sub: Dict[int, logical.Node], sink_id: int) -> None:
+    """Whole-stage fusion (ROADMAP item 1, ops/stagefuse.py): rewrite each
+    maximal single-consumer linear chain of fusible operators into ONE
+    FusedStageNode, so the whole chain runs inside one exec dispatch with no
+    store round-trip between members.  Chain rules:
+
+    - extension follows the consumer's MAIN input (parents[0]) only, and only
+      while the producer has exactly one consumer;
+    - a non-broadcast hash join may only HEAD a chain (its probe-side hash
+      edge partitions the stage's stream 0); interior joins must be broadcast
+      — a hash build mid-chain would need the probe re-partitioned by a
+      different key than the stage's input edge delivers;
+    - an AggNode terminates the chain (its partial half fuses in-stage, the
+      final half stays a separate key-partitioned actor);
+    - blocking operators (sort, top-k, distinct, sinks) and stateful/ordered
+      nodes never fuse;
+    - 1-member "chains" are left untouched.
+
+    Runs LAST: it consumes the shapes the earlier passes settle (broadcast
+    choices, folded maps, reordered joins).  QK_STAGE_FUSE=0 disables it.
+    """
+    from quokka_tpu import config
+
+    if not config.stage_fuse_enabled():
+        return
+    cons = _consumers(sub, sink_id)
+    absorbed: Set[int] = set()
+    for nid in _reachable(sub, sink_id):
+        if nid in absorbed:
+            continue
+        node = sub.get(nid)
+        if node is None or not _fusible_member(node):
+            continue
+        if isinstance(node, logical.AggNode):
+            continue  # terminal-only: an agg heads nothing
+        members = [node]
+        ids = [nid]
+        cur = nid
+        while True:
+            c = cons.get(cur, [])
+            if len(c) != 1:
+                break
+            nxt = sub[c[0]]
+            if nxt.parents[0] != cur:
+                break  # we feed a build side, not the main input
+            if not _fusible_member(nxt):
+                break
+            if isinstance(nxt, logical.JoinNode) and not nxt.broadcast:
+                break
+            members.append(nxt)
+            ids.append(c[0])
+            cur = c[0]
+            if isinstance(nxt, logical.AggNode):
+                break
+        if len(members) < 2:
+            continue
+        chans = {m.channels for m in members if m.channels is not None}
+        if len(chans) > 1:
+            continue  # members pinned to conflicting widths
+        tail = members[-1]
+        tail_id = ids[-1]
+        parents = [members[0].parents[0]] + [
+            m.parents[1] for m in members if isinstance(m, logical.JoinNode)
+        ]
+        fused = logical.FusedStageNode(members, parents, list(tail.schema))
+        fused.channels = chans.pop() if chans else None
+        # the tail's id survives so consumers' parent links stay valid
+        sub[tail_id] = fused
+        for i in ids[:-1]:
+            del sub[i]
+        absorbed.update(ids)
+        cons = _consumers(sub, sink_id)
+
+
+def unfuse_stages(sub: Dict[int, logical.Node]) -> Dict[int, logical.Node]:
+    """Inverse of fuse_stages, for executors that lower logical nodes
+    themselves (the mesh SPMD path): expand every FusedStageNode back into
+    its member chain.  Fusion never rewrote the members' own parent links —
+    member[i].parents[0] still names member[i-1]'s pre-fusion id and the
+    tail kept its id — so the original graph is recoverable exactly.
+    Returns a new dict; the caller's (fused) plan is untouched."""
+    out = dict(sub)
+    for nid, node in sub.items():
+        if not isinstance(node, logical.FusedStageNode):
+            continue
+        ids = [m.parents[0] for m in node.members[1:]] + [nid]
+        for mid, m in zip(ids, node.members):
+            out[mid] = m
+    return out
 
 
 def reorder_joins(sub: Dict[int, logical.Node], sink_id: int) -> None:

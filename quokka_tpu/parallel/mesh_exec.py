@@ -923,7 +923,12 @@ class MeshExecutor:
         # runs (data-dependent bailouts like a non-unique join build side can
         # still abort mid-run and re-execute on the engine — unavoidable)
         from quokka_tpu import windows as W
+        from quokka_tpu.optimizer import unfuse_stages
 
+        # whole-stage fusion is an ENGINE-actor regrouping; the mesh lowers
+        # logical nodes itself, so expand fused chains back to their members
+        # (a copy — an engine fallback still runs the fused plan)
+        sub = unfuse_stages(sub)
         for node in sub.values():
             if not isinstance(node, self.SUPPORTED):
                 raise MeshUnsupported(f"node {type(node).__name__} on mesh")

@@ -536,9 +536,11 @@ class Engine:
                     self.execs[(info.id, ch)] = self._bind_executor(
                         info.executor_factory())
         # upgrade the plan's exec labels to the bound executor class names
-        # (register_plan already ran in _init_latency_hists)
+        # (register_plan already ran in _init_latency_hists); executors may
+        # carry an OP_NAME override — a fused stage labels itself with its
+        # member chain so opstats rows stay legible per logical operator
         opstats.OPSTATS.register_plan(
-            graph, op_names={aid: type(ex).__name__
+            graph, op_names={aid: getattr(ex, "OP_NAME", type(ex).__name__)
                              for (aid, ch), ex in self.execs.items()})
 
     def _bind_executor(self, executor):
@@ -1086,7 +1088,12 @@ class Engine:
             task.input_reqs,
             self._actor_stages(),
             self._sorted_actors(),
-            max_batches=self.max_batches,
+            # a fused stage amortizes its whole member chain over one
+            # dispatch — let it drain a wider slice of the ready queue than
+            # the per-operator default (still deterministic: the cap is a
+            # static executor attribute, so tape replay sees the same sets)
+            max_batches=getattr(executor, "MAX_PIPELINE_BATCHES", None)
+            or self.max_batches,
             channel_major=self._channel_major_actors(),
         )
         if plan is None:

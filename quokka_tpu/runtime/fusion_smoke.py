@@ -1,0 +1,145 @@
+"""Whole-stage-fusion smoke: a linear join chain must run as ONE device
+program chain, warm and sync-free, and match the unfused plan bit-exactly.
+
+    python -m quokka_tpu.runtime.fusion_smoke      (or: make fusion-smoke)
+
+A seeded Q3-shaped pipeline (fact filter, two broadcast dim joins, grouped
+aggregate — exactly the linear chain ops/stagefuse.py collapses) runs warm
+and then steady-state; the steady run must show
+
+1. at least one FUSED stage actually dispatching batches (the
+   ``stagefuse.exec`` counter FusedStageExecutor increments per intake),
+2. ZERO real backend compiles (the sanitizer's recompile sentinel,
+   ``analysis/sanitize.check_no_recompiles`` with force=True), and
+3. ZERO blocking host readbacks on the push path (``shuffle.host_syncs``
+   stays flat).
+
+The same query is then re-planned IN-PROCESS with ``QK_STAGE_FUSE=0`` (the
+optimizer reads the switch per plan) and the unfused result must be
+BIT-EXACT vs the fused one — integer-valued columns, so any drift is a
+wrong answer, not a rounding story.  Exit nonzero on any violation.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+
+
+def _make_tables(tmp: str, seed: int = 20260805):
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    r = np.random.default_rng(seed)
+    n_fact, n_dim1, n_dim2 = 300_000, 8_000, 500
+    fact = pa.table({
+        "fk": r.integers(0, n_dim1, n_fact).astype(np.int64),
+        "v": r.integers(0, 1000, n_fact).astype(np.int64),
+        "flag": r.integers(0, 4, n_fact).astype(np.int64),
+    })
+    dim1 = pa.table({
+        "pk": np.arange(n_dim1, dtype=np.int64),
+        "ck": r.integers(0, n_dim2, n_dim1).astype(np.int64),
+    })
+    dim2 = pa.table({
+        "pk2": np.arange(n_dim2, dtype=np.int64),
+        "grp": r.integers(0, 32, n_dim2).astype(np.int64),
+    })
+    paths = []
+    for name, tbl in (("fact", fact), ("dim1", dim1), ("dim2", dim2)):
+        p = os.path.join(tmp, f"{name}.parquet")
+        pq.write_table(tbl, p, row_group_size=1 << 17)
+        paths.append(p)
+    return paths
+
+
+def _query(ctx, fp, d1, d2):
+    from quokka_tpu.expression import col
+
+    fact = ctx.read_parquet(fp)
+    dim1 = ctx.read_parquet(d1)
+    dim2 = ctx.read_parquet(d2)
+    return (
+        fact.filter(col("flag") < 3)
+        .join(dim1, left_on="fk", right_on="pk")
+        .join(dim2, left_on="ck", right_on="pk2")
+        .groupby("grp")
+        .agg_sql("sum(v) as sv, count(*) as n")
+    )
+
+
+def _canon(df):
+    """Order-independent canonical form: the fused and unfused plans are
+    free to emit groups in different orders; the CONTENT must be identical."""
+    return df.sort_values(list(df.columns)).reset_index(drop=True)
+
+
+def main() -> int:
+    from quokka_tpu import QuokkaContext, obs
+    from quokka_tpu.analysis import sanitize
+    from quokka_tpu.utils import compilestats
+
+    with tempfile.TemporaryDirectory(prefix="qk-fusion-smoke-") as tmp:
+        fp, d1, d2 = _make_tables(tmp)
+        ctx = QuokkaContext(io_channels=2, exec_channels=2)
+        warm = _query(ctx, fp, d1, d2).collect()  # pays the compiles
+        assert len(warm) > 0, "smoke query returned no rows"
+
+        c0 = compilestats.snapshot()
+        snap0 = obs.REGISTRY.snapshot()
+        steady = _query(ctx, fp, d1, d2).collect()
+        c1 = compilestats.snapshot()
+        snap1 = obs.REGISTRY.snapshot()
+
+        assert warm.equals(steady), "steady-state run changed the result"
+        fused = snap1.get("stagefuse.exec", 0) - snap0.get("stagefuse.exec", 0)
+        syncs = snap1.get("shuffle.host_syncs", 0) - snap0.get(
+            "shuffle.host_syncs", 0)
+        print(f"fusion-smoke: steady-state stagefuse.exec={fused} "
+              f"host_syncs={syncs} real_compiles="
+              f"{c1['real_compiles'] - c0['real_compiles']}")
+        if fused <= 0:
+            print("fusion-smoke: FAIL — no fused stage dispatched on a "
+                  "linear join chain (optimizer.fuse_stages planned "
+                  "nothing, or FusedStageExecutor never ran)",
+                  file=sys.stderr)
+            return 1
+        if syncs > 0:
+            print(f"fusion-smoke: FAIL — {syncs} blocking host readback(s) "
+                  "during the steady fused run (shuffle.host_syncs)",
+                  file=sys.stderr)
+            return 1
+        # recompile sentinel: the warmed fused pipeline must reuse its
+        # executables (raises RecompileError on violation)
+        sanitize.check_no_recompiles(c0, c1, context="fusion-smoke steady run",
+                                     force=True)
+
+        # the escape hatch must exist AND agree: re-plan the same query
+        # unfused in this very process and compare content bit-exactly
+        os.environ["QK_STAGE_FUSE"] = "0"
+        try:
+            u0 = obs.REGISTRY.snapshot()
+            unfused = _query(ctx, fp, d1, d2).collect()
+            u1 = obs.REGISTRY.snapshot()
+        finally:
+            os.environ.pop("QK_STAGE_FUSE", None)
+        leaked = u1.get("stagefuse.exec", 0) - u0.get("stagefuse.exec", 0)
+        if leaked > 0:
+            print("fusion-smoke: FAIL — QK_STAGE_FUSE=0 still dispatched "
+                  f"{leaked} fused intake(s); the kill switch is dead",
+                  file=sys.stderr)
+            return 1
+        if not _canon(steady).equals(_canon(unfused)):
+            print("fusion-smoke: FAIL — fused and unfused plans disagree "
+                  "on integer-valued data (bit-exactness violated)",
+                  file=sys.stderr)
+            return 1
+    print("fusion-smoke: OK — fused chain ran warm with zero recompiles, "
+          "zero host syncs, bit-exact vs QK_STAGE_FUSE=0")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,32 @@
+"""QK020 fixture: per-batch chains of single-expression program dispatches.
+
+Three findings: a loop-borne ``evaluate_to_column`` (one program launch per
+expression per batch) and the third and fourth straight-line dispatches in
+one body (beyond the two-per-batch allowance).  The two-dispatch body below
+them — one predicate, one projection — is within the allowance and exempt.
+"""
+
+from quokka_tpu.ops.expr_compile import evaluate_predicate, evaluate_to_column
+
+
+class ChainedExecutor:
+    def __init__(self, exprs, preds):
+        self.exprs = exprs
+        self.preds = preds
+
+    def execute(self, batch):
+        b = batch
+        for name, e in self.exprs:
+            b = b.with_column(name, evaluate_to_column(e, b))  # finding 1
+        return b
+
+    def probe(self, batch, p1, p2, e1, e2):
+        m = evaluate_predicate(p1, batch)
+        m = m & evaluate_predicate(p2, batch)
+        b = batch.with_column("a", evaluate_to_column(e1, batch))  # finding 2
+        return b.with_column("z", evaluate_to_column(e2, b))  # finding 3
+
+    def guarded(self, batch, pred, expr):
+        m = evaluate_predicate(pred, batch)  # exempt: one predicate...
+        b = batch.with_column("y", evaluate_to_column(expr, batch))
+        return b, m  # ...plus one projection is within the allowance

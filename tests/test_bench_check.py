@@ -257,6 +257,30 @@ class TestStrategyHonesty:
             require=False)
         assert not bad and not rows
 
+    def test_fresh_run_requires_fused_stages(self, bench):
+        """Fresh join lines must prove the whole-stage-fused plan ran:
+        a missing detail.fused_stages OR a zero count is a regression."""
+        cur = {m: _line(m, 0.5, {"platform": "cpu"})
+               for m in bench.FUSION_REQUIRED_METRICS}
+        rows, bad = bench.check_fused_stages_presence(cur, require=True)
+        assert len(bad) == len(bench.FUSION_REQUIRED_METRICS)
+        assert all(status == "MISSING" for _, status, _ in rows)
+        # zero fused stages on a join query is the win evaporating
+        cur = {m: _line(m, 0.5, {"fused_stages": 0})
+               for m in bench.FUSION_REQUIRED_METRICS}
+        rows, bad = bench.check_fused_stages_presence(cur, require=True)
+        assert len(bad) == len(bench.FUSION_REQUIRED_METRICS)
+        # >= 1 satisfies the gate
+        cur = {m: _line(m, 0.5, {"fused_stages": 1})
+               for m in bench.FUSION_REQUIRED_METRICS}
+        rows, bad = bench.check_fused_stages_presence(cur, require=True)
+        assert not bad and all(status == "ok" for _, status, _ in rows)
+        # --current file-vs-file mode never requires presence
+        rows, bad = bench.check_fused_stages_presence(
+            {m: _line(m, 0.5) for m in bench.FUSION_REQUIRED_METRICS},
+            require=False)
+        assert not bad and not rows
+
 
 def test_cli_subprocess_roundtrip(tmp_path):
     """The real `python bench.py --check` entry point, end to end."""

@@ -77,9 +77,11 @@ class TestCalibrationPersistence:
         monkeypatch.setenv("QK_STRATEGY_DIR", str(tmp_path))
         strategy.reset()
         res = strategy.calibrate(rows=2048, reps=1)
-        # shuffle is timed for information but never picked by calibration
-        # (pipeline property, not a kernel wall — see calibrate())
-        assert set(res["choices"]) == set(strategy.OPS) - {"shuffle"}
+        # shuffle and asof_probe are never picked by calibration (pipeline
+        # properties, not kernel walls — see calibrate(); shuffle is still
+        # timed for the profile's information)
+        assert set(res["choices"]) == set(strategy.OPS) - {"shuffle",
+                                                           "asof_probe"}
         for op, ch in res["choices"].items():
             assert ch in strategy.OPS[op]
         assert res["timings_s"]["shuffle"].keys() == {"masked", "compacted"}
