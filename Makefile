@@ -4,7 +4,7 @@
 PY ?= python
 export JAX_PLATFORMS ?= cpu
 
-.PHONY: lint lint-baseline verify-static test test-slow sanitize-demo service-smoke chaos-smoke obs-smoke bench-check shuffle-smoke fusion-smoke warmup-smoke multichip-smoke stream-smoke mem-smoke explain-smoke
+.PHONY: lint lint-baseline verify-static plan-fuzz test test-slow sanitize-demo service-smoke chaos-smoke obs-smoke bench-check shuffle-smoke fusion-smoke warmup-smoke multichip-smoke stream-smoke mem-smoke explain-smoke
 
 # engine-invariant static analysis; exits nonzero on findings beyond the
 # checked-in baseline (quokka_tpu/analysis/baseline.json)
@@ -24,9 +24,20 @@ lint-baseline:
 verify-static:
 	$(PY) -m pytest tests/test_lint_clean.py tests/test_lint_rules.py \
 		tests/test_flow.py tests/test_protocol.py tests/test_schedex.py \
+		tests/test_planck.py \
 		-q -p no:cacheprovider
 	$(PY) -m quokka_tpu.analysis.protocol quokka_tpu/
 	$(PY) -m quokka_tpu.analysis.schedex --seeds 120
+	$(PY) -m quokka_tpu.analysis.planck
+	$(MAKE) plan-fuzz
+
+# differential optimizer fuzzer: 200 seeded random plans, each planned
+# under the full pass pipeline vs every cumulative pass prefix vs
+# QK_STAGE_FUSE=0; plans must verify statically (planck QK021-QK024) and
+# execute bit-identically to the unoptimized plan on tiny int data.  A
+# failing seed prints a ddmin-shrunk 1-minimal repro op list.
+plan-fuzz:
+	$(PY) -m quokka_tpu.analysis.planfuzz --seeds 200
 
 test:
 	$(PY) -m pytest tests/ -q -m 'not slow'

@@ -44,6 +44,12 @@ REF_SECONDS_SF100_4W = {"q1": 9.559, "q3": 14.579, "q5": 22.081}
 # (BASELINE.md / blog/orderedstreams.md:51) => rows/s per worker
 REF_ASOF_ROWS_PER_S_PER_WORKER = (1.3e9 + 2.5e8) / 35.0 / 4.0
 
+# Plan-invariant verification (analysis/planck.py QK021-QK024) is default-ON
+# for the bench: every optimizer pass of every benched plan is checked, and
+# the per-query cost is reported as detail.plan_verify (plan-time only —
+# never on the push path; acceptance is <= 5 ms per plan).
+os.environ.setdefault("QK_PLAN_VERIFY", "1")
+
 SF = float(os.environ.get("QUOKKA_BENCH_SF", "1.0"))
 CACHE = os.environ.get("QUOKKA_BENCH_CACHE", "/tmp/quokka_tpu_bench")
 # generous: first compile of the full kernel set over the remote-compile
@@ -516,6 +522,7 @@ def measure(paths):
         return {k: snap.get(k, 0) for k in
                 ("shuffle.bytes", "shuffle.host_syncs", "shuffle.spill_bytes")}
 
+    from quokka_tpu.analysis import planck as qk_planck
     from quokka_tpu.obs import memplane
 
     for qname, fn in QUERIES.items():
@@ -524,6 +531,7 @@ def measure(paths):
         kstrategy.reset_used()
         c0 = compilestats.snapshot()
         sh0 = _shuffle_snap()
+        pv0 = dict(qk_planck.VERIFY_STATS)
         # memory plane: peak resets to current live before the query, so
         # detail.memory reports THIS query's high-water mark, not the
         # session's
@@ -610,6 +618,8 @@ def measure(paths):
             sys.stderr.write(f"[spans] {qname} timed runs (3)\n"
                              + obs_spans.summary() + "\n")
         ops_detail = _operators_detail()
+        pv_plans = qk_planck.VERIFY_STATS["plans"] - pv0["plans"]
+        pv_ms = qk_planck.VERIFY_STATS["ms_total"] - pv0["ms_total"]
         per_query[qname] = {
             "seconds": round(t, 4),
             "seconds_all": [round(x, 4) for x in times],
@@ -647,6 +657,13 @@ def measure(paths):
             # of FusedStage operators that dispatched (`--check` gates the
             # join lines on this being >= 1)
             "fused_stages": _fused_stages(ops_detail),
+            # plan-invariant verifier cost (QK021-QK024, plan-time only):
+            # per-plan average must stay <= 5 ms
+            "plan_verify": {
+                "plans": pv_plans,
+                "ms_total": round(pv_ms, 3),
+                "ms_per_plan": round(pv_ms / pv_plans, 3) if pv_plans else 0.0,
+            },
             **extra,
         }
         # QK_SANITIZE=1: the recompile sentinel fails the run outright when

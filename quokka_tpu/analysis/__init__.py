@@ -15,11 +15,23 @@ round-5 multi-process hang showed are violated silently when they slip:
 - shared runtime tables only mutated under their owning lock,
 - no silently swallowed exceptions in runtime loops.
 
-Two enforcement layers:
+Enforcement layers:
 
-- ``python -m quokka_tpu.analysis.lint quokka_tpu/`` — AST rules QK001-QK006
-  (``rules.py``) with a checked-in baseline (``baseline.json``) that may only
-  shrink; the tier-1 gate is ``tests/test_lint_clean.py``.
+- ``python -m quokka_tpu.analysis.lint quokka_tpu/`` — AST rules QK001-QK013
+  and QK018-QK020 (``rules.py``) with a checked-in baseline
+  (``baseline.json``) that may only shrink; the tier-1 gate is
+  ``tests/test_lint_clean.py``.
+- ``python -m quokka_tpu.analysis.protocol quokka_tpu/`` — interprocedural
+  control-store protocol verifier (QK014-QK017, ``protocol.py``), no baseline.
+- ``python -m quokka_tpu.analysis.planck`` — typed plan-invariant verifier
+  (QK021-QK024, ``planck.py``): schema propagation, exchange-key coverage,
+  fusion legality (incl. the fuse/unfuse involution, proven by digest) and
+  streaming legality, checked per optimizer pass under ``QK_PLAN_VERIFY=1``.
+- ``python -m quokka_tpu.analysis.planfuzz`` — seeded differential optimizer
+  fuzzer (``planfuzz.py``): random logical plans executed bit-exact across
+  pass prefixes, failures ddmin-shrunk (``shrink.py``) to 1-minimal repros.
+- ``python -m quokka_tpu.analysis.schedex`` — deterministic-schedule race
+  explorer (``schedex.py``) over the recovery protocol, seeded + shrinking.
 - ``QK_SANITIZE=1`` — runtime sanitizer (``sanitize.py``): a deadlock
   watchdog that dumps every thread's stack and fails fast when a worker stops
   making progress, a lock-order recorder on the runtime's shared locks, and a

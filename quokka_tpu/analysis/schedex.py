@@ -287,24 +287,13 @@ def explore(rule: str, seeds: int = 300) -> List[Tuple[int, Result]]:
 
 def minimize(trace: Sequence[Action], rule: str) -> List[Action]:
     """ddmin to a 1-minimal wedging schedule: removing any single action
-    no longer wedges."""
-    cur = list(trace)
-    n = 2
-    while len(cur) >= 2:
-        chunk = max(1, len(cur) // n)
-        shrunk = False
-        for i in range(0, len(cur), chunk):
-            cand = cur[:i] + cur[i + chunk:]
-            if run_schedule(None, rule, trace=cand).wedged:
-                cur = cand
-                n = max(2, n - 1)
-                shrunk = True
-                break
-        if not shrunk:
-            if chunk == 1:
-                break
-            n = min(len(cur), n * 2)
-    return cur
+    no longer wedges.  The loop itself is the shared analysis/shrink.py
+    minimizer (the plan fuzzer uses the same one); replay tolerates
+    arbitrary subsequences because run_schedule skips disabled actions."""
+    from quokka_tpu.analysis.shrink import ddmin
+
+    return ddmin(list(trace),
+                 lambda cand: run_schedule(None, rule, trace=cand).wedged)
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:

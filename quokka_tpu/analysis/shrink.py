@@ -1,0 +1,45 @@
+"""Shared delta-debugging minimizer (Zeller ddmin).
+
+Extracted from the schedule explorer (``analysis/schedex.py``) so the plan
+fuzzer (``analysis/planfuzz.py``) shrinks failing op lists with the SAME
+proven loop the schedule minimizer uses.  The contract both callers rely on:
+
+- ``failing(items)`` must be a pure predicate — re-runnable, deterministic,
+  and tolerant of arbitrary subsequences (schedex replays skip disabled
+  actions; the plan builder skips inapplicable ops), and
+- the result is 1-minimal: removing ANY single remaining element makes
+  ``failing`` return False.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Sequence, TypeVar
+
+T = TypeVar("T")
+
+
+def ddmin(items: Sequence[T], failing: Callable[[List[T]], bool]) -> List[T]:
+    """Smallest subsequence of ``items`` still satisfying ``failing``.
+
+    Classic ddmin complement-removal: try dropping chunks of 1/n of the
+    current sequence; on success restart with the shrunk sequence, otherwise
+    halve the chunk size until single-element removals all fail — at which
+    point the result is 1-minimal by construction.  ``items`` is never
+    mutated; the caller's ordering is preserved."""
+    cur = list(items)
+    n = 2
+    while len(cur) >= 2:
+        chunk = max(1, len(cur) // n)
+        shrunk = False
+        for i in range(0, len(cur), chunk):
+            cand = cur[:i] + cur[i + chunk:]
+            if failing(cand):
+                cur = cand
+                n = max(2, n - 1)
+                shrunk = True
+                break
+        if not shrunk:
+            if chunk == 1:
+                break
+            n = min(len(cur), n * 2)
+    return cur

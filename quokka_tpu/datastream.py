@@ -104,7 +104,8 @@ class DataStream:
     def rename(self, mapping: Dict[str, str]) -> "DataStream":
         new_schema = [mapping.get(c, c) for c in self.schema]
         return self._child(
-            logical.MapNode([self.node_id], new_schema, logical.RenameFn(mapping))
+            logical.MapNode([self.node_id], new_schema, logical.RenameFn(mapping),
+                            rename=dict(mapping))
         )
 
     def with_columns(self, exprs: Dict[str, Union[Expr, str]]) -> "DataStream":
@@ -142,7 +143,9 @@ class DataStream:
                 return None
             return bridge.arrow_to_device(pa.Table.from_pandas(out, preserve_index=False))
 
-        return self._child(logical.MapNode([self.node_id], new_schema, wrapped))
+        return self._child(
+            logical.MapNode([self.node_id], new_schema, wrapped, declared=True)
+        )
 
     def stateful_transform(self, executor, new_schema: List[str],
                            required_columns=None, by=None,
@@ -425,6 +428,9 @@ class _HeadNode(logical.Node):
         super().__init__(parents, schema)
         self.limit = limit
 
+    def derive_schema(self, parents):
+        return list(parents[0])
+
     def lower(self, ctx, graph, actor_of, node_id):
         from quokka_tpu.executors.sql_execs import TopKExecutor
 
@@ -456,6 +462,20 @@ class _HeadNode(logical.Node):
 
 
 class _UnionNode(logical.Node):
+    def derive_schema(self, parents):
+        # _Align selects self.schema from EVERY input stream, so the output
+        # is the declared columns still present in all parents — early
+        # projection may prune each side differently (e.g. one side keeps a
+        # pushed predicate's column); re-deriving keeps the runtime select
+        # legal instead of asking a pruned side for a column it dropped
+        keep = set(parents[0])
+        for p in parents[1:]:
+            keep &= set(p)
+        out = [c for c in self.schema if c in keep]
+        if not out:
+            raise ValueError(f"union inputs share no declared columns: {parents}")
+        return out
+
     def lower(self, ctx, graph, actor_of, node_id):
         from quokka_tpu.executors.sql_execs import StorageExecutor
 

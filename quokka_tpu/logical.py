@@ -43,8 +43,26 @@ class Node:
     def lower(self, ctx, graph, actor_of: Dict[int, int], node_id: int) -> None:
         raise NotImplementedError
 
+    def derive_schema(self, parents: List[List[str]]) -> Optional[List[str]]:
+        """Output columns derivable from the parents' schemas plus this
+        node's own metadata (keys, expressions, rename maps, ...).
+
+        Returns None when the DECLARED schema is the source of truth (sources
+        and opaque user executors); otherwise returns the derived column list
+        and raises ValueError when a parent is missing a column this node
+        requires — the contract the plan verifier (analysis/planck.py QK021)
+        checks node-by-node and optimizer.early_projection uses to keep
+        interior schemas exact after source pruning."""
+        return None
+
     def describe(self) -> str:
         return type(self).__name__
+
+
+def _require(cols, parent: List[str], what: str) -> None:
+    missing = [c for c in cols if c not in set(parent)]
+    if missing:
+        raise ValueError(f"{what} references columns {missing} not in input {parent}")
 
 
 class SourceNode(Node):
@@ -119,6 +137,10 @@ class FilterNode(Node):
         super().__init__(parents, schema)
         self.predicate = predicate
 
+    def derive_schema(self, parents):
+        _require(self.predicate.required_columns(), parents[0], "filter predicate")
+        return list(parents[0])
+
     def lower(self, ctx, graph, actor_of, node_id):
         from quokka_tpu.executors.sql_execs import UDFExecutor
         from quokka_tpu.ops.fuse import FusedPredicate
@@ -140,6 +162,10 @@ class ProjectionNode(Node):
     def __init__(self, parents, schema):
         super().__init__(parents, schema)
 
+    def derive_schema(self, parents):
+        _require(self.schema, parents[0], "projection")
+        return list(self.schema)
+
     def lower(self, ctx, graph, actor_of, node_id):
         from quokka_tpu.executors.sql_execs import UDFExecutor
 
@@ -158,13 +184,37 @@ class ProjectionNode(Node):
 
 class MapNode(Node):
     """with_columns / rename / transform: a per-batch device function.
-    ``exprs`` (when set) makes the map foldable by the optimizer."""
+    ``exprs`` (when set) makes the map foldable by the optimizer.
 
-    def __init__(self, parents, schema, fn: Callable, exprs: Optional[Dict[str, Expr]] = None):
+    Every MapNode must carry EXPLICIT output-schema metadata — one of
+    ``exprs`` (with_columns), ``rename`` (a column-rename map), or
+    ``declared=True`` (an opaque UDF whose declared schema is trusted).  A
+    bare fn with none of the three has no derivable output schema and fails
+    plan verification (QK021)."""
+
+    def __init__(self, parents, schema, fn: Callable, exprs: Optional[Dict[str, Expr]] = None,
+                 rename: Optional[Dict[str, str]] = None, declared: bool = False):
         super().__init__(parents, schema)
         self.fn = fn
         self.exprs = exprs
+        self.rename = rename
+        self.declared = declared
         self.folded = False  # set by optimizer.fold_maps: ride the edge
+
+    def derive_schema(self, parents):
+        if self.exprs is not None:
+            for k, e in self.exprs.items():
+                _require(e.required_columns(), parents[0], f"map expr {k}")
+            # with_column replaces in place when present, appends when new —
+            # mirror DeviceBatch.with_column exactly
+            return list(parents[0]) + [k for k in self.exprs if k not in set(parents[0])]
+        if self.rename is not None:
+            # a mapping key absent from the input is a no-op (matches
+            # DeviceBatch.rename), so only the output list is derived
+            return [self.rename.get(c, c) for c in parents[0]]
+        if self.declared:
+            return None  # opaque UDF: the declared schema is the contract
+        raise ValueError("MapNode without exprs/rename/declared schema metadata")
 
     def lower(self, ctx, graph, actor_of, node_id):
         from quokka_tpu.executors.sql_execs import UDFExecutor
@@ -239,6 +289,15 @@ class AsofJoinNode(StatefulNode):
         self.suffix = suffix
         self.direction = direction
 
+    def derive_schema(self, parents):
+        _require([self.left_on] + self.left_by, parents[0], "asof left keys")
+        _require([self.right_on] + self.right_by, parents[1], "asof right keys")
+        rpayload = [c for c in parents[1]
+                    if c not in set(self.right_by) and c != self.right_on]
+        return list(parents[0]) + [
+            c + self.suffix if c in set(parents[0]) else c for c in rpayload
+        ]
+
     def describe(self):
         return f"AsofJoin({self.direction} on {self.left_on})"
 
@@ -260,6 +319,21 @@ class WindowAggNode(StatefulNode):
         self.plan = plan
         self.trigger = trigger
 
+    def derive_schema(self, parents):
+        from quokka_tpu import windows as W
+
+        _require([self.time_col] + self.by, parents[0], "window keys")
+        for name, e in self.plan.pre:
+            _require(e.required_columns(), parents[0], f"window agg input {name}")
+        finals = [n for n, _ in self.plan.finals]
+        if isinstance(self.window, W.SlidingWindow):
+            return list(parents[0]) + finals
+        if isinstance(self.window, W.SessionWindow):
+            extra = ["session_start", "session_end"]
+        else:
+            extra = ["window_start", "window_end"]
+        return list(self.by) + extra + finals
+
     def describe(self):
         return f"WindowAgg({type(self.window).__name__})"
 
@@ -280,6 +354,10 @@ class ShiftNode(StatefulNode):
         self.columns = list(columns)
         self.n = n
 
+    def derive_schema(self, parents):
+        _require([self.time_col] + self.by + self.columns, parents[0], "shift")
+        return list(parents[0]) + [f"{c}_shifted_{self.n}" for c in self.columns]
+
     def describe(self):
         return f"Shift(n={self.n})"
 
@@ -299,6 +377,15 @@ class JoinNode(Node):
         # when the optimizer prunes the clashing probe column)
         self.rename = rename
         self.build_parents = [1]
+
+    def derive_schema(self, parents):
+        _require(self.left_on, parents[0], "join left keys")
+        _require(self.right_on, parents[1], "join right keys")
+        if self.how in ("semi", "anti"):
+            return list(parents[0])
+        rename = self.rename or {}
+        rpayload = [c for c in parents[1] if c not in set(self.right_on)]
+        return list(parents[0]) + [rename.get(c, c) for c in rpayload]
 
     def lower(self, ctx, graph, actor_of, node_id):
         from quokka_tpu.executors.sql_execs import BuildProbeJoinExecutor
@@ -343,6 +430,14 @@ class AggNode(Node):
         self.having = having
         self.order_by = order_by
         self.limit = limit
+
+    def derive_schema(self, parents):
+        _require(self.keys, parents[0], "groupby keys")
+        for name, e in self.plan.pre:
+            _require(e.required_columns(), parents[0], f"aggregate input {name}")
+        return list(self.keys) + [
+            n for n, _ in self.plan.finals if n not in set(self.keys)
+        ]
 
     def lower(self, ctx, graph, actor_of, node_id):
         from quokka_tpu.executors.sql_execs import FinalAggExecutor, PartialAggExecutor
@@ -400,6 +495,23 @@ class FusedStageNode(Node):
         super().__init__(parents, schema)
         self.members = members
         self.build_parents = list(range(1, len(parents)))
+
+    def derive_schema(self, parents):
+        # replay the member chain: member i's main input is member i-1's
+        # derived output; join members consume build sides in chain order
+        builds = iter(parents[1:])
+        cur = list(parents[0])
+        for m in self.members:
+            if isinstance(m, JoinNode):
+                cur = m.derive_schema([cur, list(next(builds))])
+            else:
+                d = m.derive_schema([cur])
+                cur = list(m.schema) if d is None else d
+        leftover = list(builds)
+        if leftover:
+            raise ValueError(
+                f"fused stage has {len(leftover)} build inputs with no join member")
+        return cur
 
     def describe(self):
         inner = "\n".join("  " + m.describe() for m in self.members)
@@ -511,6 +623,10 @@ class DistinctNode(Node):
         super().__init__(parents, schema)
         self.keys = keys
 
+    def derive_schema(self, parents):
+        _require(self.keys, parents[0], "distinct keys")
+        return list(self.keys)
+
     def lower(self, ctx, graph, actor_of, node_id):
         from quokka_tpu.executors.sql_execs import DistinctExecutor
 
@@ -532,6 +648,10 @@ class TopKNode(Node):
         self.by = by
         self.k = k
         self.descending = descending
+
+    def derive_schema(self, parents):
+        _require(self.by, parents[0], "top_k keys")
+        return list(parents[0])
 
     def lower(self, ctx, graph, actor_of, node_id):
         from quokka_tpu.executors.sql_execs import TopKExecutor
@@ -566,6 +686,10 @@ class SortNode(Node):
         self.by = by
         self.descending = descending
         self.boundaries = None  # filled by the optimizer/sampling when possible
+
+    def derive_schema(self, parents):
+        _require(self.by, parents[0], "sort keys")
+        return list(parents[0])
 
     def lower(self, ctx, graph, actor_of, node_id):
         from quokka_tpu.executors.sql_execs import SortExecutor
@@ -609,6 +733,12 @@ class SinkNode(Node):
 
     def __init__(self, parents, schema):
         super().__init__(parents, schema)
+
+    def derive_schema(self, parents):
+        # the sink SELECTS its declared columns (SelectingStorageExecutor);
+        # a superset input is legal, a missing column is not
+        _require(self.schema, parents[0], "collect")
+        return list(self.schema)
 
     def lower(self, ctx, graph, actor_of, node_id):
         from quokka_tpu.executors.sql_execs import SelectingStorageExecutor

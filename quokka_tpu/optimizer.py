@@ -10,8 +10,9 @@ Pass lineup mirrors the reference driver (pyquokka/df.py:887-907):
                          shuffle join to a broadcast join (the cardinality
                          role of df.py:1401-1513's join ordering)
 Stage assignment (df.py:1530-1621) runs afterwards in context._assign_stages.
-All passes are pure rewrites of the node dict; unreachable nodes are simply
-never lowered.
+All passes are pure rewrites of the node dict; nodes a rewrite disconnects
+are garbage-collected between passes (pass_pipeline), so the dict always
+holds exactly the live graph.
 """
 
 from __future__ import annotations
@@ -24,16 +25,59 @@ from quokka_tpu.expression import Expr, conjoin, rename_columns, split_conjuncts
 BROADCAST_THRESHOLD = 65_536  # build rows below this skip the probe-side shuffle
 
 
+def pass_pipeline(exec_channels: int = 2):
+    """The canonical pass lineup as (name, fn(sub, sink_id)) pairs — the
+    unit of pass-level verification (analysis/planck.py) and of the plan
+    fuzzer's pass-subset differential (analysis/planfuzz.py)."""
+    def wrap(fn):
+        def run(sub, sid):
+            fn(sub, sid)
+            # rewrites leave disconnected leftovers behind (a pushed
+            # filter's original node); collect them so the plan dict holds
+            # exactly the live graph — EXPLAIN and the plan verifier scan it
+            live = _reachable(sub, sid)
+            for nid in set(sub) - set(live):
+                del sub[nid]
+            # structural passes may stale interior schemas (a swapped
+            # filter, a pruned source): re-derive so declared stays exact
+            _recompute_schemas(sub, live)
+
+        return run
+
+    return [
+        (name, wrap(fn))
+        for name, fn in [
+            ("push_filters", push_filters),
+            ("early_projection", early_projection),
+            ("reorder_joins", reorder_joins),
+            ("choose_broadcast", choose_broadcast),
+            ("plan_parallel_sorts",
+             lambda sub, sid: plan_parallel_sorts(sub, sid, exec_channels)),
+            ("push_ann", push_ann),
+            ("fold_maps", fold_maps),
+            ("fuse_stages", fuse_stages),
+        ]
+    ]
+
+
 def optimize(sub: Dict[int, logical.Node], sink_id: int,
              exec_channels: int = 2) -> int:
-    push_filters(sub, sink_id)
-    early_projection(sub, sink_id)
-    reorder_joins(sub, sink_id)
-    choose_broadcast(sub, sink_id)
-    plan_parallel_sorts(sub, sink_id, exec_channels)
-    push_ann(sub, sink_id)
-    fold_maps(sub, sink_id)
-    fuse_stages(sub, sink_id)
+    """Run the full pass pipeline.  Under QK_PLAN_VERIFY=1 every pass's
+    (before, after) pair is checked against the plan invariants QK021-QK024;
+    a violation raises PlanInvariantError naming the pass and the offending
+    node (never on the push path — this is all plan-time)."""
+    from quokka_tpu.analysis import planck
+
+    verify = planck.enabled()
+    if verify:
+        planck.verify_plan(sub, sink_id, where="pre-optimize")
+    for name, fn in pass_pipeline(exec_channels):
+        before = planck.digest(sub, sink_id) if verify else None
+        fn(sub, sink_id)
+        if verify:
+            planck.verify_pass(sub, sink_id, name, before)
+    if verify:
+        planck.finish_plan()
     return sink_id
 
 
@@ -158,8 +202,11 @@ def _try_push_one(sub, sink_id, fid, fnode, pid, parent, cons) -> bool:
     if isinstance(parent, (logical.ProjectionNode, logical.SortNode, logical.DistinctNode)):
         if parent_shared:
             return False
-        # swap: filter below, parent above
+        # swap: filter below, parent above.  The filter now sees the
+        # parent's INPUT: inherit that node's order metadata (pushing below
+        # a sort means the filter's input is no longer sorted — QK024)
         fnode.parents = list(parent.parents)
+        fnode.sorted_by = _copy_order(sub[fnode.parents[0]])
         parent.parents = [fid]
         _relink_except(sub, sink_id, fid, pid, skip=pid)
         return True
@@ -170,6 +217,7 @@ def _try_push_one(sub, sink_id, fid, fnode, pid, parent, cons) -> bool:
         new_pred = substitute_columns(pred, parent.exprs)
         fnode.predicate = new_pred
         fnode.parents = list(parent.parents)
+        fnode.sorted_by = _copy_order(sub[fnode.parents[0]])
         parent.parents = [fid]
         _relink_except(sub, sink_id, fid, pid, skip=pid)
         return True
@@ -206,6 +254,10 @@ def _try_push_one(sub, sink_id, fid, fnode, pid, parent, cons) -> bool:
     return False
 
 
+def _copy_order(node: logical.Node):
+    return list(node.sorted_by) if node.sorted_by is not None else None
+
+
 def _relink_except(sub, sink_id, fid, pid, skip):
     """After swapping filter below `pid`: consumers of fid (other than pid)
     should now consume pid."""
@@ -237,18 +289,49 @@ def early_projection(sub: Dict[int, logical.Node], sink_id: int) -> None:
         need = req[nid] | set()
         if isinstance(node, logical.SinkNode):
             need = set(node.schema)
+        # a with_columns output nobody consumes must be PRUNED, not just
+        # skipped in the requirement walk: the runtime map computes every
+        # expr it carries, so its inputs would otherwise need to survive
+        # source pruning (planfuzz-found: dead expr over a pruned column)
+        if isinstance(node, logical.MapNode) and node.exprs is not None \
+                and any(k not in need for k in node.exprs):
+            node.exprs = {k: e for k, e in node.exprs.items() if k in need}
+            node.fn = logical.WithColumnsFn(node.exprs)
         for i, pid in enumerate(node.parents):
             req[pid] |= _needed_from_parent(sub, node, i, need)
     for nid in order:
         node = sub[nid]
         if isinstance(node, logical.SourceNode):
-            needed = [c for c in node.schema if c in req[nid]]
+            keep = set(req[nid])
             if node.predicate is not None:
-                pred_cols = node.predicate.required_columns()
-                needed = [c for c in node.schema if c in req[nid] or c in pred_cols]
+                keep |= node.predicate.required_columns()
+            # a sorted source's order columns stay readable: downstream
+            # ordered operators key off them and the plan invariant
+            # (QK024) requires sorted_by ⊆ schema
+            keep |= set(node.sorted_by or [])
+            needed = [c for c in node.schema if c in keep]
             if 0 < len(needed) < len(node.schema):
                 node.projection = needed
                 node.schema = needed
+    _recompute_schemas(sub, order)
+
+
+def _recompute_schemas(sub: Dict[int, logical.Node], order: List[int]) -> None:
+    """Re-derive interior output schemas after source pruning, so every
+    node's declared schema stays EXACTLY what the runtime will produce
+    (planck QK021 checks declared == derived).  Nodes whose declared schema
+    is the source of truth (sources, opaque UDFs) return None and keep it;
+    a derivation error here is left for the plan verifier to report."""
+    for nid in order:
+        node = sub[nid]
+        if not node.parents:
+            continue
+        try:
+            d = node.derive_schema([list(sub[p].schema) for p in node.parents])
+        except (ValueError, KeyError):
+            continue
+        if d is not None:
+            node.schema = d
 
 
 def _needed_from_parent(sub, node: logical.Node, i: int, need: Set[str]) -> Set[str]:

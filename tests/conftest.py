@@ -28,6 +28,10 @@ os.environ.setdefault("QK_STRATEGY_DIR", "")
 # box with populated caches would flip est_bytes in admission tests.
 os.environ.setdefault("QK_MEMPROFILE_DIR", "")
 os.environ.setdefault("QK_CARDPROFILE_DIR", "")
+# Plan-invariant verification (analysis/planck.py QK021-QK024) is default-ON
+# for every test: each optimizer pass's (before, after) plan pair is checked
+# and a violation fails the test naming the pass and offending node.
+os.environ.setdefault("QK_PLAN_VERIFY", "1")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()

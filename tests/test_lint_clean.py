@@ -65,3 +65,31 @@ def test_baseline_entries_carry_rationales():
     bad = [k for k, v in entries.items()
            if not isinstance(v, str) or len(v.strip()) < 10 or "TODO" in v]
     assert not bad, f"baseline entries without a real rationale: {bad}"
+
+
+def test_plan_verifier_corpus_is_clean():
+    """QK021-QK024 run with NO baseline over every plannable query shape
+    the tests and bench exercise (same corpus as `python -m
+    quokka_tpu.analysis.planck`): a schema-propagation break, an uncovered
+    exchange key, an illegal fusion, or unsafe order metadata fails tier-1
+    outright."""
+    from quokka_tpu.analysis import planck
+
+    failures = planck.check_corpus()
+    assert not failures, "plan invariant violations (no baseline):\n" \
+        + "\n".join(f"{name}: {err}" for name, err in failures)
+    assert len(planck.corpus()) >= 12, "planck lost its query corpus"
+
+
+def test_plan_fuzz_batch_is_clean():
+    """A small deterministic slice of the differential plan fuzzer runs in
+    tier-1 (the full 200-seed sweep is `make plan-fuzz`): each seed's plan
+    under every pass prefix and QK_STAGE_FUSE=0 must verify statically and
+    execute bit-identically to the unoptimized plan."""
+    from quokka_tpu.analysis import planfuzz
+
+    dirty = [planfuzz.run_seed(s, shrink=False)
+             for s in range(40)]
+    dirty = [r for r in dirty if not r.ok]
+    assert not dirty, "differential fuzz failures:\n" \
+        + "\n".join(r.summary() for r in dirty)

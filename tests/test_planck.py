@@ -1,0 +1,391 @@
+"""Plan-invariant verifier (analysis/planck.py QK021-QK024) and the
+differential optimizer fuzzer (analysis/planfuzz.py).
+
+Three layers:
+- known-answer fixtures: hand-broken plans per rule (bad schema, uncovered
+  exchange key, illegal fusion, order claimed over unordered input,
+  checkpoint barrier inside a fused stage) must raise naming that rule;
+- regression tests for the true positives the verifier/fuzzer surfaced
+  while being brought up (dead with_columns expr over a pruned source
+  column, filter swapped below a sort claiming stale order, union sides
+  pruned apart, disconnected leftovers after a rewrite);
+- fuzzer harness self-tests: determinism, clean seeds, and injected
+  optimizer bugs (BREAKERS) caught with a 1-minimal ddmin repro.
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from quokka_tpu import logical, optimizer
+from quokka_tpu.analysis import planck, planfuzz
+from quokka_tpu.analysis.shrink import ddmin
+from quokka_tpu.context import QuokkaContext
+from quokka_tpu.expression import col
+
+
+def _fact(n=32):
+    r = np.random.default_rng(3)
+    return pa.table({
+        "k": r.integers(0, 5, n).astype(np.int64),
+        "j": r.integers(0, 3, n).astype(np.int64),
+        "x": r.integers(0, 100, n).astype(np.int64),
+    })
+
+
+def _dim():
+    return pa.table({"k": np.arange(5, dtype=np.int64),
+                     "w": np.arange(5, dtype=np.int64) * 10})
+
+
+def _plan(build, optimize=True):
+    qc = QuokkaContext(optimize=optimize)
+    ds = build(qc)
+    sub, sink_id = qc._prepare_plan(ds.node_id)
+    return sub, sink_id
+
+
+def _join_shape(qc):
+    return (qc.from_arrow(_fact()).filter(col("x") > 10)
+            .join(qc.from_arrow(_dim()), on="k").select(["k", "j", "w"]))
+
+
+def _rules_of(err: planck.PlanInvariantError):
+    return {v.rule for v in err.violations}
+
+
+# -- known-answer fixtures ----------------------------------------------------
+
+
+def test_clean_plan_verifies():
+    sub, sid = _plan(_join_shape)
+    planck.verify_plan(sub, sid)  # no raise
+
+
+def test_qk021_phantom_schema_column():
+    sub, sid = _plan(_join_shape, optimize=False)
+    join = next(n for n in sub.values() if isinstance(n, logical.JoinNode))
+    join.schema = list(join.schema) + ["__phantom"]
+    with pytest.raises(planck.PlanInvariantError) as e:
+        planck.verify_plan(sub, sid)
+    assert "QK021" in _rules_of(e.value)
+    assert "__phantom" in str(e.value)
+
+
+def test_qk021_bare_map_without_schema_metadata():
+    sub, sid = _plan(_join_shape, optimize=False)
+    fid = next(i for i, n in sub.items()
+               if isinstance(n, logical.FilterNode))
+    f = sub[fid]
+    sub[fid] = logical.MapNode(list(f.parents), list(f.schema),
+                               fn=lambda b: b)  # no exprs/rename/declared
+    with pytest.raises(planck.PlanInvariantError) as e:
+        planck.verify_plan(sub, sid)
+    assert "QK021" in _rules_of(e.value)
+    assert "exprs/rename/declared" in str(e.value)
+
+
+def test_qk022_uncovered_exchange_key():
+    sub, sid = _plan(_join_shape, optimize=False)
+    join = next(n for n in sub.values() if isinstance(n, logical.JoinNode))
+    join.right_on = ["nope"]
+    with pytest.raises(planck.PlanInvariantError) as e:
+        planck.verify_plan(sub, sid)
+    assert "QK022" in _rules_of(e.value)
+    assert "nope" in str(e.value)
+
+
+def test_qk022_stateful_partitioner_on_pruned_column():
+    from quokka_tpu.target_info import HashPartitioner
+
+    def build(qc):
+        return qc.from_arrow(_fact()).select(["k", "x"])
+
+    sub, sid = _plan(build, optimize=False)
+    src = next(i for i, n in sub.items()
+               if isinstance(n, logical.SourceNode))
+    proj = next(i for i, n in sub.items()
+                if isinstance(n, logical.ProjectionNode))
+    sub[proj] = logical.StatefulNode(
+        [src], ["k", "x"], executor_factory=None,
+        partitioners={0: HashPartitioner(["gone"])})
+    with pytest.raises(planck.PlanInvariantError) as e:
+        planck.verify_plan(sub, sid)
+    assert "QK022" in _rules_of(e.value)
+
+
+def test_qk022_sort_boundary_arity():
+    def build(qc):
+        return qc.from_arrow(_fact(n=64)).sort("x")
+
+    sub, sid = _plan(build)
+    srt = next(n for n in sub.values() if isinstance(n, logical.SortNode))
+    assert srt.boundaries is not None, "parallel sort planning regressed"
+    srt.boundaries = srt.boundaries[:-1]
+    with pytest.raises(planck.PlanInvariantError) as e:
+        planck.verify_plan(sub, sid)
+    assert "QK022" in _rules_of(e.value)
+
+
+def _fused_plan():
+    sub, sid = _plan(_join_shape)
+    fused = [n for n in sub.values()
+             if isinstance(n, logical.FusedStageNode)]
+    assert fused, "join+select no longer fuses — fixture shape regressed"
+    return sub, sid, fused[0]
+
+
+def test_qk023_order_carrying_member():
+    sub, sid, stage = _fused_plan()
+    stage.members[-1].sorted_by = ["k"]
+    with pytest.raises(planck.PlanInvariantError) as e:
+        planck.verify_plan(sub, sid)
+    assert "QK023" in _rules_of(e.value)
+
+
+def test_qk023_single_member_stage():
+    sub, sid, stage = _fused_plan()
+    keep = next(m for m in stage.members
+                if not isinstance(m, logical.JoinNode))
+    stage.members = [keep]
+    stage.parents = stage.parents[:1]
+    with pytest.raises(planck.PlanInvariantError) as e:
+        planck.verify_plan(sub, sid)
+    assert "QK023" in _rules_of(e.value)
+
+
+def test_qk023_interior_hash_join():
+    sub, sid, stage = _fused_plan()
+    join = next(m for m in stage.members if isinstance(m, logical.JoinNode))
+    if stage.members.index(join) == 0:
+        # make the join interior by prepending a trivial member
+        head = stage.members[0]
+        f = logical.FilterNode(list(head.parents), list(sub[head.parents[0]].schema),
+                               col("x") > -1)
+        stage.members = [f] + stage.members
+    join.broadcast = False
+    with pytest.raises(planck.PlanInvariantError) as e:
+        planck.verify_plan(sub, sid)
+    assert "QK023" in _rules_of(e.value)
+
+
+def test_qk023_fuse_round_trip_drift_caught():
+    """verify_pass compares unfuse_stages(after) against the pre-pass
+    digest — a pass that fuses AND rewrites a member is caught even when
+    the rewritten plan is internally consistent."""
+    sub, sid = _plan(_join_shape, optimize=False)
+    for name, fn in optimizer.pass_pipeline():
+        if name == "fuse_stages":
+            before = planck.digest(sub, sid)
+            fn(sub, sid)
+            stage = next(n for n in sub.values()
+                         if isinstance(n, logical.FusedStageNode))
+            join = next(m for m in stage.members
+                        if isinstance(m, logical.JoinNode))
+            join.how = "left"  # semantics changed, schema identical
+            with pytest.raises(planck.PlanInvariantError) as e:
+                planck.verify_pass(sub, sid, name, before)
+            assert "QK023" in _rules_of(e.value)
+            assert "not structurally identical" in str(e.value)
+            return
+        fn(sub, sid)
+    raise AssertionError("fuse_stages missing from pass pipeline")
+
+
+def test_qk024_barrier_inside_fused_stage():
+    sub, sid, stage = _fused_plan()
+    stage.members[-1].checkpoint_barrier = True
+    with pytest.raises(planck.PlanInvariantError) as e:
+        planck.verify_plan(sub, sid)
+    assert "QK024" in _rules_of(e.value)
+    assert "checkpoints as one unit" in str(e.value)
+
+
+def test_qk024_order_claimed_over_unordered_input():
+    sub, sid = _plan(_join_shape, optimize=False)
+    filt = next(n for n in sub.values() if isinstance(n, logical.FilterNode))
+    filt.sorted_by = [filt.schema[0]]
+    with pytest.raises(planck.PlanInvariantError) as e:
+        planck.verify_plan(sub, sid)
+    assert "QK024" in _rules_of(e.value)
+
+
+def test_qk024_unbounded_source_single_channel():
+    sub, sid = _plan(_join_shape, optimize=False)
+    src = next(n for n in sub.values() if isinstance(n, logical.SourceNode))
+    src.reader.UNBOUNDED = True
+    src.channels = 2
+    with pytest.raises(planck.PlanInvariantError) as e:
+        planck.verify_plan(sub, sid)
+    assert "QK024" in _rules_of(e.value)
+
+
+# -- optimizer instrumentation ------------------------------------------------
+
+
+def test_optimize_names_offending_pass(monkeypatch):
+    """Under QK_PLAN_VERIFY a broken pass fails AT that pass, not at the
+    end of the pipeline — the error names it."""
+    real = optimizer.early_projection
+
+    def broken(sub, sid):
+        real(sub, sid)
+        # corrupt metadata the post-pass schema recompute can NOT heal:
+        # claim order on a filter over an unordered input (QK024)
+        for n in sub.values():
+            if isinstance(n, logical.JoinNode):
+                n.sorted_by = [n.schema[0]]
+                return
+
+    monkeypatch.setattr(optimizer, "early_projection", broken)
+    with pytest.raises(planck.PlanInvariantError) as e:
+        _plan(_join_shape)
+    assert e.value.where == "pass early_projection"
+    assert "QK024" in _rules_of(e.value)
+
+
+def test_verify_disabled_skips_checks(monkeypatch):
+    monkeypatch.setenv("QK_PLAN_VERIFY", "0")
+    assert not planck.enabled()
+    before = planck.VERIFY_STATS["plans"]
+    _plan(_join_shape)
+    assert planck.VERIFY_STATS["plans"] == before
+
+
+def test_verifier_overhead_within_budget():
+    """Acceptance: per-query verifier overhead <= 5 ms at plan time."""
+    _plan(_join_shape)
+    assert planck.VERIFY_STATS["ms_last_plan"] <= 5.0, planck.VERIFY_STATS
+
+
+def test_no_disconnected_nodes_after_optimize():
+    """Pass pipeline garbage-collects nodes a rewrite disconnects (the
+    pushed filter's original node used to linger)."""
+    sub, sid = _plan(_join_shape)
+    assert set(sub) == set(optimizer._reachable(sub, sid))
+
+
+# -- regression tests for verifier/fuzzer-found true positives ----------------
+
+
+def test_dead_with_columns_expr_is_pruned():
+    """planfuzz-found: a with_columns output nobody consumes kept its input
+    column requirement invisible to early_projection — the source pruned
+    the column while the map still computed the expr.  The fix prunes the
+    dead expr itself."""
+    ops = [("with_columns", 34056, 13305), ("agg", 22200, 3536)]
+    assert planfuzz.check_ops(ops) is None
+
+    qc = QuokkaContext(optimize=False)
+    ds = planfuzz.build(qc, ops)
+    sub, sid = qc._prepare_plan(ds.node_id)
+    for _, fn in optimizer.pass_pipeline():
+        fn(sub, sid)
+    for n in sub.values():
+        if isinstance(n, logical.MapNode) and n.exprs is not None:
+            assert "e0" not in n.exprs, "dead expr survived early_projection"
+    planck.verify_plan(sub, sid)
+
+
+def test_filter_below_sort_inherits_order():
+    """push_filters swapping a filter below an order-producing node must
+    re-derive the filter's sorted_by from its NEW input (QK024-found)."""
+    def build(qc):
+        return qc.from_arrow(_fact(n=64)).sort("x").filter(col("k") > 1)
+
+    sub, sid = _plan(build)
+    planck.verify_plan(sub, sid)
+    for n in sub.values():
+        if isinstance(n, logical.FilterNode) and n.sorted_by is not None:
+            parent = sub[n.parents[0]]
+            assert parent.sorted_by is not None
+
+
+def test_union_sides_pruned_apart_rederives_schema():
+    """QK021-found: early projection prunes union inputs differently (the
+    pushed-predicate side keeps an extra column); the union schema must be
+    re-derived as the intersection or the align step selects a missing
+    column."""
+    def build(qc):
+        a = qc.from_arrow(_fact()).filter(col("x") > 50)
+        b = qc.from_arrow(_fact())
+        return a.union(b).select(["k"]).distinct()
+
+    sub, sid = _plan(build)
+    planck.verify_plan(sub, sid)
+
+
+def test_sorted_source_keeps_order_column():
+    """QK024-found: pruning a sorted source's projection must not drop the
+    column the order contract names."""
+    def build(qc):
+        t = pa.table({"time": np.arange(32, dtype=np.int64),
+                      "s": np.arange(32, dtype=np.int64) % 3,
+                      "size": np.arange(32, dtype=np.int64)})
+        return qc.from_arrow_sorted(t, sorted_by="time").select(["s", "size"])
+
+    sub, sid = _plan(build)
+    planck.verify_plan(sub, sid)
+    src = next(n for n in sub.values() if isinstance(n, logical.SourceNode))
+    assert "time" in src.schema
+
+
+# -- shared ddmin (analysis/shrink.py) ----------------------------------------
+
+
+def test_ddmin_is_1_minimal():
+    trace = list(range(20))
+    failing = lambda cand: 3 in cand and 11 in cand
+    out = ddmin(trace, failing)
+    assert sorted(out) == [3, 11]
+
+
+def test_ddmin_single_culprit():
+    assert ddmin(list(range(50)), lambda c: 37 in c) == [37]
+
+
+def test_schedex_minimize_still_delegates():
+    """schedex.minimize kept its public contract after extracting ddmin
+    into analysis/shrink.py (tests/test_schedex.py runs the full check)."""
+    from quokka_tpu.analysis import schedex
+
+    assert callable(schedex.minimize)
+
+
+# -- fuzzer harness self-tests ------------------------------------------------
+
+
+def test_fuzzer_is_deterministic():
+    assert planfuzz.gen_ops(17) == planfuzz.gen_ops(17)
+    r1 = planfuzz.run_seed(5, shrink=False)
+    r2 = planfuzz.run_seed(5, shrink=False)
+    assert r1.ok == r2.ok and r1.ops == r2.ops and r1.summary() == r2.summary()
+
+
+def test_fuzzer_clean_seed_batch():
+    for seed in range(10):
+        r = planfuzz.run_seed(seed, shrink=False)
+        assert r.ok, r.summary()
+
+
+def test_injected_drop_filter_caught_differentially_with_1_minimal_repro():
+    r = planfuzz.run_seed(5, breaker="drop-filter")
+    assert not r.ok and r.kind == "diff", r.summary()
+    assert r.shrunk is not None and 1 <= len(r.shrunk) <= len(r.ops)
+    # 1-minimality: removing ANY single op from the repro kills the failure
+    check = lambda ops: planfuzz.check_ops(
+        list(ops), breaker=planfuzz.BREAKERS["drop-filter"])
+    assert check(r.shrunk) is not None
+    for i in range(len(r.shrunk)):
+        assert check(r.shrunk[:i] + r.shrunk[i + 1:]) is None, (
+            f"repro is not 1-minimal: op {i} is removable")
+
+
+def test_injected_phantom_column_caught_statically():
+    r = planfuzz.run_seed(5, breaker="phantom-column", shrink=False)
+    assert not r.ok and r.kind == "static" and "QK021" in r.detail
+
+
+def test_injected_claim_order_caught_statically():
+    r = planfuzz.run_seed(5, breaker="claim-order", shrink=False)
+    assert not r.ok and r.kind == "static" and "QK024" in r.detail
