@@ -4,7 +4,7 @@
 PY ?= python
 export JAX_PLATFORMS ?= cpu
 
-.PHONY: lint lint-baseline verify-static plan-fuzz test test-slow sanitize-demo service-smoke chaos-smoke obs-smoke bench-check shuffle-smoke fusion-smoke warmup-smoke multichip-smoke stream-smoke mem-smoke explain-smoke
+.PHONY: lint lint-baseline verify-static plan-fuzz test test-slow sanitize-demo service-smoke chaos-smoke obs-smoke bench-check bench-trend shuffle-smoke fusion-smoke warmup-smoke multichip-smoke stream-smoke mem-smoke explain-smoke health-smoke
 
 # engine-invariant static analysis; exits nonzero on findings beyond the
 # checked-in baseline (quokka_tpu/analysis/baseline.json)
@@ -143,3 +143,18 @@ explain-smoke:
 # and an exact replay command.  Bounded for the 1-core CI box (~1 min).
 chaos-smoke:
 	QK_COORD_TIMEOUT=240 $(PY) -m quokka_tpu.chaos.soak --runs 20
+
+# health-plane smoke: two service queries polled live — progress must run
+# monotone 0->1 (cold on the size_hint basis, warm on the measured
+# cardprofile basis with a finite ETA), /history must accumulate samples
+# with derived rates, /health must degrade under an injected skew fault and
+# recover when it clears, and the whole plane must add ZERO host syncs
+health-smoke:
+	$(PY) -m quokka_tpu.obs.health_smoke
+
+# cross-round perf trajectory: every committed BENCH_r*.json as one table
+# (vs_baseline per round + slope per metric); exits nonzero when a metric
+# declined strictly monotonically over its last 3 consecutive rounds — the
+# slow leak each individual bench-check stayed inside its threshold on
+bench-trend:
+	$(PY) bench.py --trend $(TREND_ARGS)

@@ -453,6 +453,25 @@ def _operators_detail():
         return None
 
 
+def _progress_detail():
+    """Final progress snapshot of the most recently finished query (the
+    health plane stashes it at query GC, same discipline as the opstats
+    detail): fraction/basis/elapsed prove the estimator tracked the run.
+    None when the tracker saw nothing."""
+    try:
+        from quokka_tpu.obs import progress as obs_progress
+
+        snap = obs_progress.TRACKER.last_finished()
+        if not snap:
+            return None
+        return {k: snap.get(k) for k in
+                ("fraction", "basis", "elapsed_s", "source_bytes_done",
+                 "source_bytes_total", "profiled_ops")}
+    except Exception as e:  # noqa: BLE001 — stats must not kill the bench
+        sys.stderr.write(f"bench: progress detail unavailable: {e!r}\n")
+        return None
+
+
 def _fused_stages(operators):
     """How many whole-stage-fused operators actually dispatched in the last
     timed run (detail.operators rows whose op is a FusedStage,
@@ -657,6 +676,9 @@ def measure(paths):
             # of FusedStage operators that dispatched (`--check` gates the
             # join lines on this being >= 1)
             "fused_stages": _fused_stages(ops_detail),
+            # health plane: the progress estimator's final snapshot for the
+            # last timed run (obs/progress.py, stashed at query GC)
+            "progress": _progress_detail(),
             # plan-invariant verifier cost (QK021-QK024, plan-time only):
             # per-plan average must stay <= 5 ms
             "plan_verify": {
@@ -1401,6 +1423,114 @@ def check_main(argv):
     return 0
 
 
+def _trend_slope(points):
+    """Least-squares slope of [(x, y)] (per-round change); 0.0 for < 2
+    points."""
+    n = len(points)
+    if n < 2:
+        return 0.0
+    mx = sum(x for x, _ in points) / n
+    my = sum(y for _, y in points) / n
+    den = sum((x - mx) ** 2 for x, _ in points)
+    if den == 0:
+        return 0.0
+    return sum((x - mx) * (y - my) for x, y in points) / den
+
+
+def trend_main(argv):
+    """``bench.py --trend``: the cross-round view no single --check gives.
+    Reads EVERY committed BENCH_r*.json, prints each metric's trajectory
+    (vs_baseline ratio per round, least-squares slope) and exits 1 when a
+    metric declines strictly monotonically over its last ``--window``
+    CONSECUTIVE rounds — a slow leak each individual --check stayed inside
+    its threshold on.  Truncated driver tails contribute the metrics they
+    kept; a metric absent from a round is a gap, never a regression (which
+    round survives a 2000-byte tail is arbitrary), and a decline spanning
+    a gap doesn't trip the gate either — artifacts across gaps often span
+    box re-baselines, so the change is not attributable round-to-round."""
+    import argparse
+    import glob
+
+    ap = argparse.ArgumentParser(
+        prog="bench.py --trend",
+        description="Cross-round trajectory over committed BENCH_r*.json "
+                    "artifacts; exit 1 on a monotone multi-round decline.")
+    ap.add_argument("--dir", default=None,
+                    help="artifact directory (default: next to bench.py)")
+    ap.add_argument("--window", type=int, default=3,
+                    help="consecutive recorded declines that count as a "
+                         "regression (default 3)")
+    args = ap.parse_args(argv)
+
+    here = args.dir or os.path.dirname(os.path.abspath(__file__))
+    paths = sorted(glob.glob(os.path.join(here, "BENCH_r*.json")))
+    if len(paths) < 2:
+        sys.stderr.write(f"bench --trend: need >= 2 BENCH_r*.json under "
+                         f"{here}, found {len(paths)}\n")
+        return 2
+    rounds = []  # (label, {metric: ratio})
+    for p in paths:
+        label = os.path.basename(p)[len("BENCH_"):-len(".json")]
+        try:
+            metrics, _ = _parse_artifact(p)
+        except (OSError, ValueError) as e:
+            sys.stderr.write(f"bench --trend: skipping unreadable {p}: "
+                             f"{e}\n")
+            continue
+        vals = {}
+        for name, d in metrics.items():
+            try:
+                vals[name] = _metric_ratio(d)
+            except (TypeError, ValueError, KeyError):
+                pass
+        rounds.append((label, vals))
+    series = {}  # metric -> [(round_index, ratio)]
+    for i, (_, vals) in enumerate(rounds):
+        for name, v in vals.items():
+            series.setdefault(name, []).append((i, v))
+
+    out = sys.stdout
+    labels = [lab for lab, _ in rounds]
+    width = max(len(lab) for lab in labels)
+    out.write(f"bench --trend: {len(rounds)} round(s) "
+              f"({labels[0]}..{labels[-1]}), window={args.window}\n")
+    regressed = []
+    window = max(2, args.window)
+    for name in sorted(series):
+        pts = series[name]
+        if len(pts) < 2:
+            status = "sparse"  # one recorded round: no trajectory yet
+        else:
+            tail = pts[-window:]
+            declining = (
+                len(tail) >= window
+                # consecutive rounds only: a decline across a recording
+                # gap is not attributable to any single round
+                and all(i2 == i1 + 1 for (i1, _), (i2, _)
+                        in zip(tail, tail[1:]))
+                and all(v1 > v2 for (_, v1), (_, v2)
+                        in zip(tail, tail[1:])))
+            status = "DECLINING" if declining else "ok"
+            if declining:
+                regressed.append(name)
+        slope = _trend_slope(pts)
+        by_round = dict(pts)
+        cells = " ".join(
+            f"{by_round[i]:>8.4f}" if i in by_round else f"{'-':>8}"
+            for i in range(len(rounds)))
+        out.write(f"  {status:>9}  {name:<42} {cells}  "
+                  f"slope {slope:+.4f}/round\n")
+    out.write("  rounds: " + " ".join(f"{lab:>8}" for lab in labels)
+              + "\n")
+    if regressed:
+        out.write(f"TREND REGRESSION: {len(regressed)} metric(s) declined "
+                  f"monotonically over their last {window} recorded "
+                  f"round(s): {', '.join(regressed)}\n")
+        return 1
+    out.write("clean: no metric declined monotonically across rounds\n")
+    return 0
+
+
 # ---------------------------------------------------------------------------
 # --multichip: timed N-device scaling line (mesh execution plane)
 # ---------------------------------------------------------------------------
@@ -1664,6 +1794,11 @@ if __name__ == "__main__":
         # newest BENCH_r*.json (or --against); exit 1 on regression with
         # the regressed queries' critical-path diffs printed
         sys.exit(check_main(sys.argv[2:]))
+    elif len(sys.argv) > 1 and sys.argv[1] == "--trend":
+        # cross-round trajectory over every committed BENCH_r*.json; exit 1
+        # when a metric declined monotonically across the last N rounds —
+        # the slow leak each individual --check stayed under threshold on
+        sys.exit(trend_main(sys.argv[2:]))
     elif len(sys.argv) > 1 and sys.argv[1] == "--chaos":
         # seeded mixed-fault soak (the chaos plane, quokka_tpu/chaos):
         # bit-exact-under-injection is a robustness benchmark, so it rides

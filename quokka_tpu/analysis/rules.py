@@ -93,6 +93,16 @@ Rule ids:
                                 FusedElementwise, ops/fuse.py builders).
                                 Deliberate fallback/finalize paths baseline
                                 with a rationale
+  QK025 obs-lock-blocking-io    blocking I/O (``open``/``time.sleep``/
+                                socket/``urlopen``) executed — directly or
+                                through a reachable helper — while holding
+                                an obs-plane ``*_lock``.  The registry lock
+                                serializes every hot-path counter increment
+                                and histogram observe; a file write or
+                                sleep under it stalls every engine thread
+                                at once.  Snapshot under the lock, do the
+                                I/O outside (obs/progress.py
+                                ``_profile_for`` is the pattern)
 
 Finding keys (``Finding.key``) are line-number-free — ``rule::relpath::
 scope::snippet[::n]`` — so a baseline survives unrelated edits above the
@@ -1631,6 +1641,142 @@ def check_multi_program_chain(tree: ast.Module, path: str, rel: str,
     return out
 
 
+# ---------------------------------------------------------------------------
+# QK025 — blocking I/O while holding an obs-plane lock
+# ---------------------------------------------------------------------------
+
+# where the rule applies: the observability plane.  Its locks (the metrics
+# Registry's, the opstats ledger's, the history ring's, the alert engine's,
+# the progress tracker's) sit on every hot-path counter increment; blocking
+# under any of them stalls all engine threads at once.
+_QK025_SCOPED_DIRS = ("quokka_tpu/obs/",)
+
+
+def _qk025_blocking_name(node: ast.Call) -> Optional[str]:
+    """The dotted name when `node` is a blocking I/O call: file opens,
+    sleeps, socket construction/connection, urllib fetches.  Condition/
+    event ``wait`` is deliberately NOT here — waiting on a condition under
+    its own lock is the correct pattern, not a defect."""
+    d = _dotted(node.func)
+    if d is None:
+        return None
+    base, _, tail = d.rpartition(".")
+    if tail == "open" and base in ("", "io", "os", "gzip"):
+        return d
+    if tail == "sleep" and base in ("", "time"):
+        return d
+    if tail == "urlopen":
+        return d
+    if base == "socket" or base.endswith(".socket") \
+            or tail == "create_connection":
+        return d
+    return None
+
+
+def _qk025_lock_name(item: ast.withitem) -> Optional[str]:
+    """The dotted lock name when a with-item acquires an obs-style lock
+    (last path segment ends in ``_lock``: ``self._lock``,
+    ``_sampler_lock``, ``REGISTRY._lock``)."""
+    d = _dotted(item.context_expr)
+    if d is not None and d.rsplit(".", 1)[-1].endswith("_lock"):
+        return d
+    return None
+
+
+def _qk025_body_calls(stmts: Sequence[ast.stmt]) -> Iterable[ast.Call]:
+    """Every call executed WITHIN the with-body's dynamic extent: nested
+    defs/lambdas are skipped — their bodies run later, after release."""
+    stack: List[ast.AST] = list(stmts)
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            continue
+        if isinstance(n, ast.Call):
+            yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _qk025_reached_blocking(ctx: FlowContext, tgt) -> Optional[Tuple[str,
+                                                                     str]]:
+    """(blocking dotted name, owning qualname) for the first blocking call
+    in `tgt`'s same-module call-graph closure, else None."""
+    tmt = ctx.modules.get(tgt.module)
+    if tmt is None:
+        return None
+    for fid in sorted(_module_reachable(ctx, tmt, [tgt.fid])):
+        fi = ctx.funcs[fid]
+        for node in FlowContext._own_nodes(fi.node):
+            if isinstance(node, ast.Call):
+                b = _qk025_blocking_name(node)
+                if b is not None:
+                    return b, fi.qualname
+    return None
+
+
+def check_obs_lock_blocking_io(tree: ast.Module, path: str, rel: str,
+                               src_lines: Sequence[str],
+                               ctx: FlowContext) -> List[Finding]:
+    """Flags blocking I/O reachable while an obs-plane ``*_lock`` is held:
+    ``open``/``time.sleep``/socket/``urlopen`` either directly inside a
+    ``with <lock>:`` body, or inside a helper the body calls (same-module
+    call-graph closure via the flow engine).  The registry lock is on the
+    increment path of every operator in every engine thread — one /status
+    scrape doing file I/O under it would stall the whole data plane.  The
+    correct shape copies the figures under the lock and performs the I/O
+    outside (``HistoryRing.record``, ``ProgressTracker._profile_for``).
+    Nested defs under the lock are exempt: their bodies run after release."""
+    r = rel.replace("\\", "/")
+    base = r.rsplit("/", 1)[-1]
+    if not (any(d in r for d in _QK025_SCOPED_DIRS)
+            or base.startswith("qk025")):
+        return []
+    mt = ctx.module_table(rel)
+    if mt is None:
+        return []
+    out: List[Finding] = []
+    for fi in mt.functions.values():
+        for node in FlowContext._own_nodes(fi.node):
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            locks = [nm for nm in map(_qk025_lock_name, node.items)
+                     if nm is not None]
+            if not locks:
+                continue
+            for call in _qk025_body_calls(node.body):
+                d = _qk025_blocking_name(call)
+                if d is not None:
+                    out.append(_mk(
+                        "QK025", "obs-lock-blocking-io", path, rel, call,
+                        _scope_of(tree, call),
+                        f"'{d}(...)' runs while holding '{locks[0]}' — "
+                        "blocking I/O under an obs lock stalls every "
+                        "thread incrementing through it; copy the figures "
+                        "under the lock and do the I/O outside, or "
+                        "baseline with a rationale",
+                        src_lines))
+                    continue
+                for tgt in ctx._call_targets(mt, fi, call):
+                    hit = _qk025_reached_blocking(ctx, tgt)
+                    if hit is not None:
+                        blk, owner = hit
+                        cd = _dotted(call.func) or call.func.__class__.__name__
+                        out.append(_mk(
+                            "QK025", "obs-lock-blocking-io", path, rel,
+                            call, _scope_of(tree, call),
+                            f"'{cd}(...)' called while holding "
+                            f"'{locks[0]}' reaches blocking '{blk}(...)' "
+                            f"(in '{owner}') — hoist the helper call out "
+                            "of the critical section, or baseline with a "
+                            "rationale",
+                            src_lines))
+                        break
+    return out
+
+
+check_obs_lock_blocking_io._needs_flow = True
+
+
 RULES = (
     check_module_level_jit,
     check_import_time_side_effects,
@@ -1648,6 +1794,7 @@ RULES = (
     check_unledgered_device_alloc,
     check_adhoc_operator_tally,
     check_multi_program_chain,
+    check_obs_lock_blocking_io,
 )
 
 

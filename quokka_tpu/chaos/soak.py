@@ -347,6 +347,9 @@ def main(argv=None) -> int:
                     help="replay a single run index (failure triage)")
     args = ap.parse_args(argv)
 
+    from quokka_tpu import obs
+    from quokka_tpu.obs import alerts
+
     publish_env(None)  # baselines run undisturbed
     tabs = _tables()
     t0 = time.time()
@@ -360,6 +363,14 @@ def main(argv=None) -> int:
     for i in indices:
         name, spec_fn, fn, expect_detect = MODES[i % len(MODES)]
         seed = args.seed + i
+        if expect_detect:
+            # storm modes also prove the ALERT plane sees the storm: two
+            # back-to-back evaluations flush any pending integrity delta
+            # and guarantee the rule is INACTIVE going in, so the post-run
+            # evaluation below must re-fire it edge-triggered
+            alerts.ENGINE.evaluate_now()
+            alerts.ENGINE.evaluate_now()
+        fired0 = obs.REGISTRY.counter("alert.integrity").value
         before = _snap()
         t0 = time.time()
         spec = spec_fn(seed)
@@ -373,6 +384,16 @@ def main(argv=None) -> int:
                     "corruption was injected on every artifact write but "
                     "ZERO corruptions were detected on read — the "
                     "integrity check is not being exercised")
+            if expect_detect:
+                alerts.ENGINE.evaluate_now()
+                fired = obs.REGISTRY.counter(
+                    "alert.integrity").value - fired0
+                if fired < 1:
+                    raise AssertionError(
+                        f"{detected} corruption(s) were detected but the "
+                        "alert engine's integrity rule never fired — "
+                        "/health would have slept through the storm")
+                d["alert.integrity"] = fired
             print(f"[chaos-smoke] run {i:>2} {name:<16} seed={seed} "
                   f"ok in {time.time() - t0:5.1f}s  {d}", flush=True)
         except Exception as e:  # noqa: BLE001 — report, count, continue

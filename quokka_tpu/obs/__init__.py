@@ -40,13 +40,16 @@ import contextlib
 import sys
 
 from quokka_tpu.obs import (
+    alerts,
     critpath,
     explain,
     export,
+    history,
     memplane,
     merge,
     metrics,
     opstats,
+    progress,
     recorder,
     spans,
 )

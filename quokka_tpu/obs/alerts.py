@@ -1,0 +1,283 @@
+"""Rule-driven alert engine: the health verdict over the metrics timeline.
+
+Every rule is a pure predicate over (current sample, previous sample,
+per-rule streak state) — samples being the history ring's typed registry
+snapshots — returning a human-readable message when the condition holds.
+The engine evaluates the rule table per sample (the history sampler's
+cadence, or forced via ``evaluate_now()``), EDGE-TRIGGERED: entering the
+firing state emits one flight-recorder ``alert.<rule>`` event and bumps the
+``alert.<rule>`` counter; staying in it does neither; leaving it clears the
+rule from the active set.  ``health()`` folds the active set into the
+ok/degraded/critical verdict ``/health`` serves — the placement signal a
+multi-replica router reads per replica.
+
+Rule table (thresholds are env knobs, one per rule):
+
+============== ======== ======================================================
+rule           severity fires when
+============== ======== ======================================================
+channel_skew   warn     any per-edge ``shuffle.skew.<qid>.*`` gauge >=
+                        QK_SKEW_RATIO (the opstats threshold)
+watermark_lag  warn     any ``stream.watermark_lag_s*`` gauge >=
+                        QK_ALERT_WM_LAG_S (default 30)
+mem_budget     critical max ``mem.live_bytes*`` gauge >= QK_ALERT_MEM_PCT
+                        (default 0.9) of the QK_SERVICE_MEM_BUDGET
+queue_wait     warn     ``admission.queue_wait_s`` p95 >=
+                        QK_ALERT_QUEUE_P95_S (default 10) while new waits
+                        keep arriving (count moved since last sample)
+no_progress    warn     some ``progress.fraction.<qid>`` gauge unchanged and
+                        < 0.99 for QK_ALERT_STALL_EVALS (default 3)
+                        consecutive samples — the stall-dump precursor
+mem_leak       warn     ``mem.leaked`` counter moved since last sample
+integrity      warn     ``integrity.corrupt`` counter moved since last
+                        sample (chaos-detected checksum rejections)
+============== ======== ======================================================
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+_WARN, _CRITICAL = "warn", "critical"
+
+
+def _envf(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _gauges(sample: dict, prefix: str, exact_too: bool = False
+            ) -> Dict[str, float]:
+    """Gauges under a dotted prefix (optionally the bare name too)."""
+    g = sample.get("gauges") or {}
+    out = {n: v for n, v in g.items() if n.startswith(prefix)}
+    bare = prefix.rstrip(".")
+    if exact_too and bare in g:
+        out[bare] = g[bare]
+    return out
+
+
+def _counter_delta(cur: dict, prev: Optional[dict], name: str) -> float:
+    v1 = (cur.get("counters") or {}).get(name, 0)
+    v0 = ((prev or {}).get("counters") or {}).get(name, 0)
+    return v1 - v0
+
+
+# -- rule predicates: (cur, prev, state) -> Optional[message] ----------------
+# state is a per-rule dict the engine persists between evaluations (streak
+# counters live there); rules never touch the registry directly — they see
+# only the sampled timeline, same as the operator.
+
+
+def _rule_channel_skew(cur, prev, state):
+    from quokka_tpu.obs import opstats
+
+    thresh = opstats.skew_ratio_threshold()
+    hot = {n: v for n, v in _gauges(cur, "shuffle.skew.").items()
+           if v >= thresh}
+    if not hot:
+        return None
+    worst = max(hot, key=hot.get)
+    return (f"{len(hot)} exchange edge(s) at skew >= {thresh:g}; "
+            f"worst {worst} = {hot[worst]:.2f}")
+
+
+def _rule_watermark_lag(cur, prev, state):
+    thresh = _envf("QK_ALERT_WM_LAG_S", 30.0)
+    hot = {n: v for n, v in
+           _gauges(cur, "stream.watermark_lag_s.", exact_too=True).items()
+           if v >= thresh}
+    if not hot:
+        return None
+    worst = max(hot, key=hot.get)
+    return (f"watermark lag >= {thresh:g}s on {len(hot)} stream(s); "
+            f"worst {worst} = {hot[worst]:.1f}s")
+
+
+def _rule_mem_budget(cur, prev, state):
+    from quokka_tpu.service import admission
+
+    budget = admission.mem_budget_bytes()
+    if budget <= 0:
+        return None
+    pct = _envf("QK_ALERT_MEM_PCT", 0.9)
+    live = max(_gauges(cur, "mem.live_bytes.", exact_too=True).values(),
+               default=0.0)
+    if live < pct * budget:
+        return None
+    return (f"live tracked memory {int(live)} B is "
+            f"{live / budget:.0%} of the {budget} B service budget")
+
+
+def _rule_queue_wait(cur, prev, state):
+    thresh = _envf("QK_ALERT_QUEUE_P95_S", 10.0)
+    h = (cur.get("histograms") or {}).get("admission.queue_wait_s")
+    if not h or h[0] == 0:
+        return None
+    # only while waits keep ARRIVING: the histogram is cumulative, so a
+    # long-past pileup would otherwise pin the alert forever
+    h0 = ((prev or {}).get("histograms") or {}).get(
+        "admission.queue_wait_s", (0, 0.0))
+    if h[0] <= h0[0]:
+        return None
+    from quokka_tpu import obs
+
+    p95 = obs.REGISTRY.histogram("admission.queue_wait_s").quantile(0.95)
+    if p95 is None or p95 < thresh:
+        return None
+    return f"admission queue wait p95 {p95:.1f}s >= {thresh:g}s"
+
+
+def _rule_no_progress(cur, prev, state):
+    need = max(1, int(_envf("QK_ALERT_STALL_EVALS", 3)))
+    streaks: Dict[str, int] = state.setdefault("streaks", {})
+    fracs = _gauges(cur, "progress.fraction.")
+    prev_fracs = _gauges(prev, "progress.fraction.") if prev else {}
+    stalled = []
+    for name, v in fracs.items():
+        if name in prev_fracs and v == prev_fracs[name] and v < 0.99:
+            streaks[name] = streaks.get(name, 0) + 1
+            if streaks[name] >= need:
+                stalled.append((name, v))
+        else:
+            streaks.pop(name, None)
+    for name in list(streaks):
+        if name not in fracs:
+            del streaks[name]  # query finished/GC'd: forget its streak
+    if not stalled:
+        return None
+    name, v = stalled[0]
+    qid = name.rsplit(".", 1)[-1]
+    return (f"{len(stalled)} query(ies) made no progress for {need} "
+            f"samples; e.g. {qid} stuck at {v:.0%}")
+
+
+def _rule_mem_leak(cur, prev, state):
+    d = _counter_delta(cur, prev, "mem.leaked")
+    if d <= 0:
+        return None
+    return f"{int(d)} allocation(s) leaked past query GC since last sample"
+
+
+def _rule_integrity(cur, prev, state):
+    d = _counter_delta(cur, prev, "integrity.corrupt")
+    if d <= 0:
+        return None
+    return f"{int(d)} checksum rejection(s) since last sample"
+
+
+RULES = (
+    ("channel_skew", _WARN, _rule_channel_skew),
+    ("watermark_lag", _WARN, _rule_watermark_lag),
+    ("mem_budget", _CRITICAL, _rule_mem_budget),
+    ("queue_wait", _WARN, _rule_queue_wait),
+    ("no_progress", _WARN, _rule_no_progress),
+    ("mem_leak", _WARN, _rule_mem_leak),
+    ("integrity", _WARN, _rule_integrity),
+)
+
+
+class AlertEngine:
+    """Evaluates the rule table per sample and keeps the active set.  All
+    state is under the engine's own lock; rule predicates run OUTSIDE it
+    (they only read the passed samples + their private state dict)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._prev: Optional[dict] = None
+        # rule name -> {"severity", "message", "since"}
+        self._active: Dict[str, dict] = {}
+        self._state: Dict[str, dict] = {}
+        self._evaluated_at: Optional[float] = None
+
+    def evaluate(self, sample: dict) -> List[dict]:
+        """Run every rule against (sample, previous sample); returns the
+        alerts that NEWLY fired this evaluation."""
+        with self._lock:
+            prev = self._prev
+            states = {name: self._state.setdefault(name, {})
+                      for name, _, _ in RULES}
+        results = {}
+        for name, severity, fn in RULES:
+            msg = None
+            try:
+                msg = fn(sample, prev, states[name])
+            except Exception as e:  # a broken rule must not sink the sampler
+                from quokka_tpu import obs
+
+                obs.diag(f"[alerts] rule {name} raised: {e!r}")
+            results[name] = (severity, msg)
+        fired = []
+        now = sample.get("t", time.time())
+        with self._lock:
+            self._prev = sample
+            self._evaluated_at = now
+            for name, (severity, msg) in results.items():
+                if msg is None:
+                    self._active.pop(name, None)
+                    continue
+                ent = self._active.get(name)
+                if ent is None:
+                    ent = {"rule": name, "severity": severity,
+                           "message": msg, "since": now}
+                    self._active[name] = ent
+                    fired.append(dict(ent))
+                else:
+                    ent["message"] = msg  # refresh text, keep the edge time
+        from quokka_tpu import obs
+
+        for ent in fired:
+            obs.REGISTRY.counter(f"alert.{ent['rule']}").inc()
+            obs.RECORDER.record(f"alert.{ent['rule']}", ent["message"],
+                                severity=ent["severity"])
+        self._export_health_gauge()
+        return fired
+
+    def evaluate_now(self) -> List[dict]:
+        """Force one sample + evaluation (smokes/tests; also useful when
+        the periodic sampler is disabled)."""
+        from quokka_tpu.obs import history, progress
+
+        progress.refresh_live()
+        return self.evaluate(history.RING.record())
+
+    def health(self) -> dict:
+        """The /health verdict: critical if any active critical rule,
+        degraded if anything at all is firing, ok otherwise."""
+        with self._lock:
+            firing = [dict(ent) for ent in self._active.values()]
+            evaluated_at = self._evaluated_at
+        if any(f["severity"] == _CRITICAL for f in firing):
+            status = "critical"
+        elif firing:
+            status = "degraded"
+        else:
+            status = "ok"
+        return {
+            "status": status,
+            "firing": sorted(firing, key=lambda f: f["rule"]),
+            "evaluated_at": evaluated_at,
+        }
+
+    def _export_health_gauge(self) -> None:
+        from quokka_tpu import obs
+
+        status = self.health()["status"]
+        obs.REGISTRY.gauge("health.status").set(
+            {"ok": 0, "degraded": 1, "critical": 2}[status])
+
+    def reset(self) -> None:
+        """Tests only."""
+        with self._lock:
+            self._prev = None
+            self._active.clear()
+            self._state.clear()
+            self._evaluated_at = None
+
+
+ENGINE = AlertEngine()

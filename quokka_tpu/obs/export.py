@@ -7,7 +7,14 @@ outside the process:
 
     GET /metrics   Prometheus text: counters, gauges, histograms
     GET /status    JSON: live QueryService.stats() (when a service is
-                   attached), process info, recorder drop counter
+                   attached), process info, recorder drop counter.
+                   ``?format=json`` is an explicit alias (the machine
+                   contract a router scrapes); ``?format=text`` renders a
+                   human-readable summary instead
+    GET /history   JSON: the bounded metrics-history ring (obs/history.py)
+                   with derived per-counter rates
+    GET /health    JSON: the alert engine's ok/degraded/critical verdict
+                   plus the firing rules (obs/alerts.py)
 
 ``QK_METRICS_PORT`` opts in: QueryService starts a sidecar on that port at
 construction and stops it at shutdown (port ``0`` binds an ephemeral port,
@@ -82,6 +89,11 @@ _LABEL_FAMILIES: Tuple[Tuple[str, str, str, str], ...] = (
     ("counter", "shuffle.bytes.", "quokka_shuffle_bytes_by_query", "query"),
     ("counter", "shuffle.host_syncs.", "quokka_shuffle_host_syncs_by_query",
      "query"),
+    # health plane (obs/progress.py + obs/alerts.py): per-query progress
+    # gauges GC'd with the query, per-rule alert-fired counters
+    ("gauge", "progress.fraction.", "quokka_progress_fraction", "query"),
+    ("gauge", "progress.eta_s.", "quokka_progress_eta_seconds", "query"),
+    ("counter", "alert.", "quokka_alerts_fired", "rule"),
 )
 
 # Aggregate instruments that ALSO exist as a labeled per-query family: the
@@ -234,19 +246,43 @@ class MetricsServer:
                 self.wfile.write(body)
 
             def do_GET(self):
-                path = self.path.split("?", 1)[0]
+                path, _, query = self.path.partition("?")
+                params = dict(
+                    kv.partition("=")[::2] for kv in query.split("&") if kv)
                 try:
                     if path == "/metrics":
                         self._send(200, outer.metrics_text().encode(),
                                    CONTENT_TYPE)
                     elif path == "/status":
+                        # JSON is (and stays) the default; ?format=json is
+                        # the explicit machine-contract spelling, text the
+                        # human one
+                        if params.get("format") == "text":
+                            self._send(200, outer.status_text().encode(),
+                                       "text/plain; charset=utf-8")
+                        else:
+                            self._send(200,
+                                       json.dumps(outer.status(),
+                                                  default=repr).encode(),
+                                       "application/json")
+                    elif path == "/history":
+                        from quokka_tpu.obs import history
+
                         self._send(200,
-                                   json.dumps(outer.status(),
+                                   json.dumps(history.RING.payload(),
+                                              default=repr).encode(),
+                                   "application/json")
+                    elif path == "/health":
+                        from quokka_tpu.obs import alerts
+
+                        self._send(200,
+                                   json.dumps(alerts.ENGINE.health(),
                                               default=repr).encode(),
                                    "application/json")
                     else:
-                        self._send(404, b"not found: try /metrics or "
-                                        b"/status\n", "text/plain")
+                        self._send(404, b"not found: try /metrics, "
+                                        b"/status, /history or /health\n",
+                                   "text/plain")
                 except Exception as e:  # noqa: BLE001 — a scrape must not
                     # take the serving thread down with it; if even the
                     # 500 cannot be sent the scraper already hung up
@@ -294,6 +330,37 @@ class MetricsServer:
             except Exception as e:  # noqa: BLE001 — a torn-down service
                 out["service"] = {"error": repr(e)}  # must not 500 /status
         return out
+
+    def status_text(self) -> str:
+        """Human-readable /status?format=text render of the same dict the
+        JSON twin serves — a terminal-width summary, not a new contract."""
+        st = self.status()
+        from quokka_tpu.obs import alerts
+
+        health = alerts.ENGINE.health()
+        lines = [
+            f"quokka pid={st['pid']} uptime={st['uptime_s']:.1f}s "
+            f"health={health['status']}",
+        ]
+        for f in health["firing"]:
+            lines.append(f"  ALERT [{f['severity']}] {f['rule']}: "
+                         f"{f['message']}")
+        svc = st.get("service")
+        if isinstance(svc, dict) and "error" not in svc:
+            lines.append(
+                f"service: pool={svc.get('pool_size')} "
+                f"alive={svc.get('workers_alive')} "
+                f"finished={svc.get('finished')}")
+            for qid, row in sorted(svc.get("sessions", {}).items()):
+                frac = row.get("progress")
+                eta = row.get("eta_s")
+                prog = (f" {frac:.0%}" if isinstance(frac, float) else "")
+                prog += (f" eta={eta:.1f}s" if isinstance(eta, float)
+                         else "")
+                lines.append(f"  {qid} [{row.get('status')}]{prog}")
+        if st.get("integrity_corrupt"):
+            lines.append(f"integrity.corrupt={st['integrity_corrupt']}")
+        return "\n".join(lines) + "\n"
 
     def url(self, path: str = "/metrics") -> str:
         return f"http://{self.host}:{self.port}{path}"

@@ -1,0 +1,228 @@
+"""Health-plane smoke: live progress runs monotone 0→1, /health degrades
+under an injected fault and recovers, and none of it costs a host sync.
+
+    python -m quokka_tpu.obs.health_smoke      (or: make health-smoke)
+
+One process, four proofs over two queries submitted through a live
+QueryService with its metrics sidecar up:
+
+1. **monotone progress** — polling ``QueryHandle.progress()`` through each
+   run yields a nondecreasing fraction that ends pinned at exactly 1.0;
+   the first (cold) query estimates on the ``size_hint`` basis, the second
+   (same plan, profile now persisted) on the measured ``cardprofile``
+   basis and produces at least one finite ETA while live;
+2. **endpoints** — ``/status?format=json`` carries the per-session
+   progress columns, and ``/history`` has accumulated samples with derived
+   counter rates;
+3. **degrade + recover** — an injected per-edge skew gauge above
+   QK_SKEW_RATIO flips ``/health`` to degraded with ``channel_skew``
+   firing (``alert.channel_skew`` counter bumped); clearing the gauge and
+   re-evaluating recovers it to ok;
+4. **zero added syncs** — the whole run, progress polling included, adds
+   ZERO ``shuffle.host_syncs``.
+
+Exit nonzero on any violation, with the observed figures printed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+import urllib.request
+
+
+def _make_tables(tmp: str, seed: int = 20260807):
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    r = np.random.default_rng(seed)
+    n_fact, n_dim = 200_000, 20_000
+    fact = pa.table({
+        "fk": r.integers(0, n_dim, n_fact).astype(np.int64),
+        "v": r.integers(0, 1000, n_fact).astype(np.int64),
+        "flag": r.integers(0, 4, n_fact).astype(np.int64),
+    })
+    dim = pa.table({
+        "pk": np.arange(n_dim, dtype=np.int64),
+        "grp": r.integers(0, 64, n_dim).astype(np.int64),
+    })
+    fp = os.path.join(tmp, "fact.parquet")
+    dp = os.path.join(tmp, "dim.parquet")
+    pq.write_table(fact, fp, row_group_size=1 << 14)
+    pq.write_table(dim, dp)
+    return fp, dp
+
+
+def _query(ctx, fp, dp):
+    from quokka_tpu.expression import col
+
+    fact = ctx.read_parquet(fp)
+    dim = ctx.read_parquet(dp)
+    return (
+        fact.filter(col("flag") < 3)
+        .join(dim, left_on="fk", right_on="pk")
+        .groupby("grp")
+        .agg_sql("sum(v) as sv, count(*) as n")
+    )
+
+
+def _poll_to_done(handle):
+    """Poll progress until the query finishes; returns the fraction series
+    (including the final snapshot) plus the bases and ETAs seen."""
+    fracs, bases, etas = [], set(), []
+    while not handle.done:
+        p = handle.progress()
+        if p is not None:
+            fracs.append(p["fraction"])
+            bases.add(p["basis"])
+            if p["eta_s"] is not None:
+                etas.append(p["eta_s"])
+        time.sleep(0.01)
+    handle.wait(600)
+    final = handle.progress()
+    if final is not None:
+        fracs.append(final["fraction"])
+        bases.add(final["basis"])
+    return fracs, bases, etas, final
+
+
+def _fetch(url: str):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def main() -> int:  # noqa: C901 — linear proof script, explain_smoke idiom
+    env_overrides = {
+        # the memory profile must not shortcut admission; the cardinality
+        # profile is the thing under test, isolated in a temp dir
+        "QK_MEMPROFILE_DIR": "",
+        "QK_CARDPROFILE_DIR": tempfile.mkdtemp(prefix="qk-health-card-"),
+        # sidecar on an ephemeral port; fast sampler so /history fills
+        "QK_METRICS_PORT": "0",
+        "QK_HISTORY_INTERVAL_S": "0.2",
+    }
+    saved = {k: os.environ.get(k) for k in env_overrides}
+    os.environ.update(env_overrides)
+
+    def fail(msg: str) -> int:
+        sys.stderr.write(f"health-smoke: FAIL — {msg}\n")
+        return 1
+
+    try:
+        from quokka_tpu import QuokkaContext, obs
+
+        from quokka_tpu.service import QueryService
+
+        with tempfile.TemporaryDirectory(prefix="qk-health-smoke-") as tmp:
+            fp, dp = _make_tables(tmp)
+            syncs0 = obs.REGISTRY.snapshot().get("shuffle.host_syncs", 0)
+            with QueryService(pool_size=2) as svc:
+                if svc.metrics_server is None:
+                    return fail("metrics sidecar did not start under "
+                                "QK_METRICS_PORT=0")
+                url = svc.metrics_server.url
+
+                # -- proof 1: monotone 0→1 progress, cold then warm -------
+                results = []
+                for label in ("cold", "warm"):
+                    ctx = QuokkaContext(io_channels=2, exec_channels=2)
+                    h = svc.submit(_query(ctx, fp, dp))
+                    fracs, bases, etas, final = _poll_to_done(h)
+                    if h.error is not None:
+                        return fail(f"{label} query failed: {h.error!r}")
+                    if len(fracs) < 3:
+                        return fail(f"{label} query finished with only "
+                                    f"{len(fracs)} progress sample(s) — "
+                                    "nothing was observable live")
+                    if any(a > b for a, b in zip(fracs, fracs[1:])):
+                        return fail(f"{label} fraction series is not "
+                                    f"monotone: {fracs}")
+                    if fracs[-1] != 1.0:
+                        return fail(f"{label} final fraction "
+                                    f"{fracs[-1]} != 1.0")
+                    results.append((label, fracs, bases, etas, final))
+                    print(f"health-smoke: {label} run {len(fracs)} "
+                          f"sample(s), basis={sorted(bases)}, "
+                          f"max_live={max(fracs[:-1]):.3f}, "
+                          f"etas_seen={len(etas)}")
+                if "size_hint" not in results[0][2]:
+                    return fail("cold run never used the size_hint basis "
+                                f"(saw {sorted(results[0][2])})")
+                if "cardprofile" not in results[1][2]:
+                    return fail("warm run never used the cardprofile basis "
+                                "— measured cardinalities did not persist "
+                                f"(saw {sorted(results[1][2])})")
+                if not any(e >= 0 for e in results[1][3]):
+                    return fail("warm run produced no finite ETA")
+
+                # -- proof 2: endpoints -----------------------------------
+                st = _fetch(url("/status?format=json"))
+                svc_stats = st.get("service") or {}
+                rows = svc_stats.get("sessions")
+                if rows is None:
+                    return fail("/status?format=json carries no service "
+                                "sessions block")
+                hist = _fetch(url("/history"))
+                if len(hist.get("samples") or []) < 2:
+                    return fail(f"/history holds "
+                                f"{len(hist.get('samples') or [])} "
+                                "sample(s); sampler never ran")
+                if not hist.get("rates"):
+                    return fail("/history derived no counter rates over a "
+                                "two-query run")
+                print(f"health-smoke: /history {len(hist['samples'])} "
+                      f"sample(s), {len(hist['rates'])} rated counter(s)")
+
+                # -- proof 3: degrade + recover ---------------------------
+                if _fetch(url("/health"))["status"] != "ok":
+                    return fail("baseline /health is not ok: "
+                                f"{_fetch(url('/health'))}")
+                fired0 = obs.REGISTRY.snapshot().get(
+                    "alert.channel_skew", 0)
+                fake = "shuffle.skew.qfake.a0-a1"
+                obs.REGISTRY.gauge(fake).set(99.0)
+                obs.alerts.ENGINE.evaluate_now()
+                health = _fetch(url("/health"))
+                firing = [f["rule"] for f in health["firing"]]
+                if health["status"] != "degraded" \
+                        or "channel_skew" not in firing:
+                    return fail("injected skew did not degrade /health: "
+                                f"{health}")
+                fired = obs.REGISTRY.snapshot().get(
+                    "alert.channel_skew", 0) - fired0
+                if fired != 1:
+                    return fail(f"alert.channel_skew counter moved by "
+                                f"{fired}, want exactly 1 (edge-triggered)")
+                obs.REGISTRY.remove(fake)
+                obs.alerts.ENGINE.evaluate_now()
+                health = _fetch(url("/health"))
+                if health["status"] != "ok":
+                    return fail(f"/health did not recover after the fault "
+                                f"cleared: {health}")
+                print("health-smoke: /health ok -> degraded(channel_skew) "
+                      "-> ok, alert counter +1")
+
+                # -- proof 4: zero added host syncs -----------------------
+                syncs = obs.REGISTRY.snapshot().get(
+                    "shuffle.host_syncs", 0) - syncs0
+                print(f"health-smoke: host_syncs delta {syncs}")
+                if syncs:
+                    return fail(f"the health plane cost {syncs} host "
+                                "sync(s) — progress must consume only "
+                                "host-side ledger figures")
+        print("health-smoke: OK")
+        return 0
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,184 @@
+"""Metrics history: a bounded ring of periodic full-registry snapshots.
+
+Every instrument on /metrics is an instant — skew ratios, watermark lag,
+mem.live_bytes, admission queue depth all answer "now?" but never
+"trending which way?".  This module adds the time dimension: a sampler
+thread records ``Registry.typed_snapshot()`` every ``QK_HISTORY_INTERVAL_S``
+seconds into a ring of ``QK_HISTORY_DEPTH`` samples, derives per-counter
+rates from adjacent samples, and serves the whole thing as JSON at
+``/history`` on the metrics sidecar.
+
+Each recorded sample is also handed to the alert engine
+(:mod:`quokka_tpu.obs.alerts`) — history IS the alert cadence, so every
+rule sees the same timeline the operator sees.
+
+The sampler is refcounted process-wide: each ``QueryService`` acquires it
+on start and releases on shutdown, so N in-process services share ONE
+thread and the last shutdown stops it.  ``interval_s <= 0`` disables
+periodic sampling entirely (tests and smokes then drive ``RING.record()``
+by hand for determinism).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+def _interval_s() -> float:
+    """``QK_HISTORY_INTERVAL_S`` (seconds between samples; default 5.0;
+    ``0``/empty disables the sampler)."""
+    raw = os.environ.get("QK_HISTORY_INTERVAL_S")
+    if raw is None:
+        return 5.0
+    if not raw.strip():
+        return 0.0
+    try:
+        return max(0.0, float(raw))
+    except ValueError:
+        return 5.0
+
+
+def _depth() -> int:
+    """``QK_HISTORY_DEPTH`` (ring capacity; default 120 samples — 10 min
+    at the default 5 s interval; floor 2 so rates stay derivable)."""
+    try:
+        return max(2, int(os.environ.get("QK_HISTORY_DEPTH", 120)))
+    except ValueError:
+        return 120
+
+
+class HistoryRing:
+    """The bounded sample ring.  ``record()`` takes one registry snapshot
+    (outside this ring's lock — the registry has its own), appends it, and
+    evicts past depth.  Rate derivation happens at read time so the hot
+    record path stays a list append."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._samples: List[dict] = []
+
+    def record(self, now: Optional[float] = None) -> dict:
+        """Take and store one sample; returns it (the alert engine and the
+        smokes evaluate the sample they just forced)."""
+        from quokka_tpu import obs
+
+        snap = obs.REGISTRY.typed_snapshot()
+        sample = {
+            "t": time.time() if now is None else now,
+            "counters": snap["counters"],
+            "gauges": snap["gauges"],
+            "histograms": snap["histograms"],
+        }
+        depth = _depth()
+        with self._lock:
+            self._samples.append(sample)
+            if len(self._samples) > depth:
+                del self._samples[:len(self._samples) - depth]
+        obs.REGISTRY.counter("history.samples").inc()
+        return sample
+
+    def samples(self) -> List[dict]:
+        with self._lock:
+            return list(self._samples)
+
+    def last(self, n: int = 2) -> List[dict]:
+        """The newest n samples, oldest first (what the alert engine's
+        delta rules compare)."""
+        with self._lock:
+            return self._samples[-n:]
+
+    def rates(self) -> Dict[str, List[dict]]:
+        """Per-counter rate series derived from adjacent sample pairs:
+        ``{counter: [{t, rate}]}`` where rate = (v1-v0)/dt at t1.  Only
+        counters that moved at least once appear — a full cross-product of
+        every counter times every interval would dwarf the samples
+        themselves.  Histogram counts rate the same way under a
+        ``<name>.count`` key (observations/second)."""
+        samples = self.samples()
+        out: Dict[str, List[dict]] = {}
+        for prev, cur in zip(samples, samples[1:]):
+            dt = cur["t"] - prev["t"]
+            if dt <= 0:
+                continue
+            for name, v1 in cur["counters"].items():
+                v0 = prev["counters"].get(name, 0)
+                if v1 != v0:
+                    out.setdefault(name, []).append(
+                        {"t": cur["t"], "rate": round((v1 - v0) / dt, 6)})
+            for name, (c1, _) in cur["histograms"].items():
+                c0 = prev["histograms"].get(name, (0, 0.0))[0]
+                if c1 != c0:
+                    out.setdefault(f"{name}.count", []).append(
+                        {"t": cur["t"], "rate": round((c1 - c0) / dt, 6)})
+        return out
+
+    def payload(self) -> dict:
+        """What /history serves."""
+        return {
+            "interval_s": _interval_s(),
+            "depth": _depth(),
+            "samples": self.samples(),
+            "rates": self.rates(),
+        }
+
+    def reset(self) -> None:
+        """Tests only."""
+        with self._lock:
+            self._samples.clear()
+
+
+RING = HistoryRing()
+
+
+# ---------------------------------------------------------------------------
+# Refcounted global sampler thread
+# ---------------------------------------------------------------------------
+
+_sampler_lock = threading.Lock()
+_sampler_refs = 0
+_sampler_stop: Optional[threading.Event] = None
+_sampler_thread: Optional[threading.Thread] = None
+
+
+def _sampler_loop(stop: threading.Event, interval: float) -> None:
+    from quokka_tpu.obs import alerts, progress
+
+    while not stop.wait(interval):
+        # refresh progress gauges first so the stall rule sees fractions
+        # even when no client polls /status between samples
+        progress.refresh_live()
+        sample = RING.record()
+        alerts.ENGINE.evaluate(sample)
+
+
+def acquire_sampler() -> None:
+    """Refcount up; the first acquirer starts the sampler thread (no-op
+    when QK_HISTORY_INTERVAL_S disables sampling)."""
+    global _sampler_refs, _sampler_stop, _sampler_thread
+    interval = _interval_s()
+    with _sampler_lock:
+        _sampler_refs += 1
+        if _sampler_thread is not None or interval <= 0:
+            return
+        stop = threading.Event()
+        t = threading.Thread(
+            target=_sampler_loop, args=(stop, interval),
+            name="qk-history-sampler", daemon=True)
+        _sampler_stop, _sampler_thread = stop, t
+        t.start()
+
+
+def release_sampler() -> None:
+    """Refcount down; the last release stops and joins the thread."""
+    global _sampler_refs, _sampler_stop, _sampler_thread
+    with _sampler_lock:
+        _sampler_refs = max(0, _sampler_refs - 1)
+        if _sampler_refs > 0 or _sampler_thread is None:
+            return
+        stop, t = _sampler_stop, _sampler_thread
+        _sampler_stop = _sampler_thread = None
+    stop.set()
+    t.join(timeout=5.0)

@@ -223,6 +223,19 @@ class Registry:
                 out[f"{n}.sum"] = round(h._sum, 6)
         return out
 
+    def typed_snapshot(self) -> Dict[str, Dict]:
+        """One atomic read of the whole registry, KEPT BY KIND — what the
+        history ring records.  ``snapshot()`` flattens histograms into
+        ``.count``/``.sum`` keys, which loses the kind distinction rate
+        derivation needs (counters are rateable, gauges are not)."""
+        with self._lock:
+            return {
+                "counters": {n: c.value for n, c in self._counters.items()},
+                "gauges": {n: g.value for n, g in self._gauges.items()},
+                "histograms": {n: (h._count, round(h._sum, 6))
+                               for n, h in self._histograms.items()},
+            }
+
     def remove(self, *names: str) -> None:
         """Drop named instruments (per-query counters GC with their query —
         a long-lived service would otherwise grow one pair per query id)."""

@@ -479,6 +479,45 @@ class OpStats:
         with self._lock:
             return self._last
 
+    def progress_view(self, qid: str) -> Optional[dict]:
+        """The HOST-SIDE figures the progress estimator consumes — plan
+        fingerprint, start time, reader size-hint total, scanned source
+        bytes/rows so far, and per-exec-operator ``rows_out`` keyed the way
+        the cardinality profile keys them (``a<aid>:<op>``).  Deliberately
+        skips the pending device scalars: a progress poll must never force
+        a device sync, so a not-yet-flushed device count simply isn't
+        visible until the engine's next metric-flush cadence.  None for an
+        unregistered query id."""
+        with self._lock:
+            plan = self._plans.get(qid)
+            if plan is None:
+                return None
+            scanned_bytes = 0
+            scanned_rows = 0
+            rows_out: Dict[int, int] = {}
+            for (q, aid, ch), r in self._ops.items():
+                if q != qid:
+                    continue
+                ent = plan["actors"].get(aid)
+                if ent is not None and ent["kind"] == "input":
+                    scanned_bytes += r["bytes_out"]
+                    scanned_rows += r["rows_out"]
+                else:
+                    rows_out[aid] = rows_out.get(aid, 0) + r["rows_out"]
+            return {
+                "query_id": qid,
+                "plan_fp": plan.get("plan_fp"),
+                "t0": plan["t0"],
+                "size_hint_bytes": plan.get("size_hint_bytes", 0),
+                "scanned_bytes": scanned_bytes,
+                "scanned_rows": scanned_rows,
+                "op_rows_out": {
+                    f"a{aid}:{plan['actors'][aid]['op']}": n
+                    for aid, n in rows_out.items()
+                    if aid in plan["actors"]
+                },
+            }
+
     def live_queries(self) -> list:
         """Query ids with a registered plan (stall dumps snapshot each of
         these to say where the rows had gotten to when the run wedged)."""

@@ -213,6 +213,12 @@ class TaskGraph:
             else:
                 memplane.LEDGER.on_query_gc(
                     self.query_id, plan_fp=getattr(self, "plan_fp", None))
+            # progress plane: final snapshot stashed, fraction gauges GC'd
+            # (idempotent — the service path already finalized in finish();
+            # must run BEFORE opstats GC while its ledger view still exists)
+            from quokka_tpu.obs import progress
+
+            progress.TRACKER.on_query_gc(self.query_id)
             # operator-stats plane: final snapshot, measured cardinalities
             # persisted under the plan fingerprint, per-query gauges GC'd
             opstats.OPSTATS.on_query_gc(

@@ -100,6 +100,14 @@ def estimate_working_set(graph) -> int:
     return max(int(total * PIPELINE_OVERHEAD), MIN_ESTIMATE_BYTES)
 
 
+def mem_budget_bytes() -> int:
+    """The configured service memory budget (``QK_SERVICE_MEM_BUDGET``) —
+    what a controller constructed with defaults would use.  The alert
+    engine reads this to turn ``mem.live_bytes`` gauges into a
+    percent-of-budget verdict without holding a controller handle."""
+    return _env_int("QK_SERVICE_MEM_BUDGET", 4 << 30)
+
+
 class AdmissionController:
     """Budget ledger + bounded FIFO wait queue.  Driven by the service
     scheduler: ``offer`` at submit, ``poll`` each scheduling round (returns

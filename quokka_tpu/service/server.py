@@ -134,6 +134,10 @@ class QueryService:
         # QK_METRICS_PORT: external scrapers watch this service live
         # (/metrics Prometheus text + /status JSON of stats())
         self.metrics_server = obs.export.start_from_env(service=self)
+        # health plane: the refcounted history sampler records registry
+        # snapshots every QK_HISTORY_INTERVAL_S and drives the alert engine
+        # (/history + /health); released at shutdown
+        obs.history.acquire_sampler()
         # QK_PREWARM=1: load every recorded plan's persisted executables in
         # the background at startup, so even the first-ever submit of a
         # known plan shape dispatches against warm programs
@@ -386,6 +390,18 @@ class QueryService:
                     # (non-creating ledger lookup; None before first stats)
                     "top_operator": obs.OPSTATS.top_operator(qid),
                 }
+                if not s.streaming:
+                    # health plane: completion estimate + ETA (a standing
+                    # query has no completion fraction — its row carries
+                    # the watermark/pane figures instead)
+                    prog = (dict(s.progress_snap)
+                            if s.progress_snap is not None
+                            else obs.progress.TRACKER.snapshot(qid))
+                    sessions[qid].update({
+                        "progress": prog["fraction"] if prog else None,
+                        "eta_s": prog["eta_s"] if prog else None,
+                        "progress_basis": prog["basis"] if prog else None,
+                    })
                 if s.streaming:
                     # standing-query row: source watermarks + pane/late
                     # counters (snapshot lookups — a scrape must never
@@ -437,6 +453,7 @@ class QueryService:
             shutil.rmtree(self._spill_dir, ignore_errors=True)
         if self.metrics_server is not None:
             self.metrics_server.close()
+        obs.history.release_sampler()
         obs.RECORDER.record("service.stop", "")
 
     close = shutdown

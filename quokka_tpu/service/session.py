@@ -73,6 +73,9 @@ class QuerySession:
         # operator-statistics snapshot (obs/opstats.py), taken at finish
         # before on_query_gc drops the per-query ledger state
         self.opstats: Optional[Dict] = None
+        # final progress snapshot (obs/progress.py), stamped fraction=1.0
+        # at finish before the tracker drops the query
+        self.progress_snap: Optional[Dict] = None
 
     # -- finish (exactly once) ----------------------------------------------
     def finish(self, error: Optional[BaseException] = None) -> bool:
@@ -108,6 +111,12 @@ class QuerySession:
             from quokka_tpu.obs import opstats
 
             self.opstats = opstats.OPSTATS.snapshot(self.query_id)
+            from quokka_tpu.obs import progress as progress_mod
+
+            # a clean finish pins the bar at 1.0; a failed query keeps its
+            # last honest estimate — it did NOT complete
+            self.progress_snap = progress_mod.TRACKER.on_query_gc(
+                self.query_id, finished=error is None)
             try:
                 # a standing query that FAILED (or was shut down mid-stream)
                 # keeps its durable recovery trio — checkpoints, HBQ spill,
@@ -220,6 +229,18 @@ class QueryHandle:
         from quokka_tpu.obs import memplane
 
         return memplane.LEDGER.query_footprint(self.query_id)
+
+    def progress(self) -> Optional[Dict]:
+        """Live completion estimate ({fraction, eta_s, basis, ...},
+        obs/progress.py): monotone 0→1 fraction blending scanned source
+        bytes against the plan's profiled (or size-hinted) totals with
+        per-operator row completion, plus an EWMA-throughput ETA.  The
+        finish-time snapshot (fraction pinned 1.0 on success) after."""
+        if self._s.progress_snap is not None:
+            return dict(self._s.progress_snap)
+        from quokka_tpu.obs import progress as progress_mod
+
+        return progress_mod.TRACKER.snapshot(self.query_id)
 
     def explain(self, as_dict: bool = False):
         """EXPLAIN ANALYZE: the plan DAG annotated with measured actuals —

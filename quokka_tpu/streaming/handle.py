@@ -27,6 +27,10 @@ class StreamingHandle:
         self._s = session
         self._cursor: Dict[int, int] = {}
         self.resume_info = resume_info
+        # newest pane key seen in delivered deltas (monotone by max): a
+        # standing query has no completion fraction, so its progress is
+        # "how far has finalized output gotten" — the pane frontier
+        self._pane_frontier: Optional[float] = None
 
     # -- identity / status ----------------------------------------------------
     @property
@@ -74,8 +78,53 @@ class StreamingHandle:
         out = []
         for ch, seq, table in ds.items_since(self._cursor):
             self._cursor[ch] = max(self._cursor.get(ch, -1), seq)
+            self._note_frontier(table)
             out.append(table)
         return out
+
+    def _note_frontier(self, table) -> None:
+        """Advance the pane frontier from a delivered delta's pane keys
+        (windowed-agg rows carry ``window_start``; deltas without it —
+        asof probe rows — don't move the frontier)."""
+        try:
+            cols = getattr(table, "column_names", None) or []
+            if "window_start" not in cols:
+                return
+            col = table.column("window_start")
+            if len(col) == 0:
+                return
+            import pyarrow.compute as pc
+
+            newest = pc.max(col).as_py()
+        except Exception:
+            return  # a malformed delta must not break delivery
+        if newest is None:
+            return
+        newest = float(newest)
+        if self._pane_frontier is None or newest > self._pane_frontier:
+            self._pane_frontier = newest
+
+    def progress(self) -> Dict:
+        """The standing-query progress view: not a completion fraction (an
+        unbounded query never completes) but the stream's forward motion —
+        source watermark, the newest finalized pane delivered to THIS
+        handle, pane/late counters, and the current watermark lag.  Counter
+        lookups are snapshot reads: a poll must never resurrect a GC'd
+        per-query instrument."""
+        from quokka_tpu import obs
+
+        qid = self.query_id
+        snap = obs.REGISTRY.snapshot()
+        return {
+            "query_id": qid,
+            "streaming": True,
+            "watermark": self.watermark(),
+            "pane_frontier": self._pane_frontier,
+            "panes": snap.get(f"stream.panes.{qid}", 0),
+            "late_dropped": snap.get(f"stream.late_dropped.{qid}", 0),
+            "watermark_lag_s": snap.get(
+                f"stream.watermark_lag_s.{qid}", 0.0),
+        }
 
     # -- lifecycle ------------------------------------------------------------
     def stop(self, timeout: Optional[float] = 120.0) -> "StreamingHandle":

@@ -1,0 +1,68 @@
+"""Seeded QK025 fixture: blocking I/O while holding an obs ``*_lock``.
+
+Three violations — a direct ``open`` under a class lock, a ``time.sleep``
+under a module lock, and a helper call under a lock whose body opens a
+file — plus the clean shapes the rule must NOT flag: I/O after release,
+a pure helper under the lock, and a nested def (deferred execution).
+"""
+
+import threading
+import time
+
+_flush_lock = threading.Lock()
+
+
+def _persist(payload, path):
+    with open(path, "w") as f:
+        f.write(repr(payload))
+
+
+def _format(payload):
+    return repr(payload)
+
+
+class Ring:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._samples = []
+
+    def record_bad_direct(self, sample, path):
+        with self._lock:
+            self._samples.append(sample)
+            with open(path, "a") as f:  # QK025: file I/O under the lock
+                f.write(repr(sample))
+
+    def record_bad_indirect(self, sample, path):
+        with self._lock:
+            self._samples.append(sample)
+            _persist(sample, path)  # QK025: helper reaches open()
+
+    def record_ok(self, sample, path):
+        with self._lock:
+            self._samples.append(sample)
+            snap = list(self._samples)
+        _persist(snap, path)  # I/O after release: the correct shape
+
+    def format_ok(self, sample):
+        with self._lock:
+            return _format(sample)  # pure helper under the lock: fine
+
+
+def throttle_bad():
+    with _flush_lock:
+        time.sleep(0.01)  # QK025: sleep while holding the lock
+
+
+def throttle_ok():
+    time.sleep(0.01)
+    with _flush_lock:
+        return None
+
+
+def deferred_ok():
+    with _flush_lock:
+        def later(path):
+            with open(path) as f:  # runs after release: not flagged
+                return f.read()
+
+        return later

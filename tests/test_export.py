@@ -174,6 +174,48 @@ class TestHttpSidecar:
         finally:
             server.close()
 
+    def test_status_format_twins(self):
+        """/status?format=json is the explicit machine spelling of the
+        default JSON payload; ?format=text renders the same dict for
+        humans (ISSUE 17 satellite)."""
+        server = export.MetricsServer(port=0)
+        try:
+            _, ctype, body = _scrape(server.url("/status?format=json"))
+            assert ctype == "application/json"
+            explicit = json.loads(body)
+            _, _, default_body = _scrape(server.url("/status"))
+            assert set(explicit) == set(json.loads(default_body))
+            assert "obs" in explicit and "pid" in explicit
+            code, ctype, text = _scrape(server.url("/status?format=text"))
+            assert code == 200 and ctype.startswith("text/plain")
+            assert text.startswith("quokka pid=")
+            assert "health=" in text
+        finally:
+            server.close()
+
+    def test_history_and_health_endpoints(self):
+        from quokka_tpu.obs import alerts, history
+
+        server = export.MetricsServer(port=0)
+        try:
+            history.RING.record()
+            history.RING.record()
+            code, ctype, body = _scrape(server.url("/history"))
+            assert code == 200 and ctype == "application/json"
+            hist = json.loads(body)
+            assert {"interval_s", "depth", "samples", "rates"} <= set(hist)
+            assert len(hist["samples"]) >= 2
+            assert {"t", "counters", "gauges", "histograms"} <= set(
+                hist["samples"][-1])
+            code, ctype, body = _scrape(server.url("/health"))
+            assert code == 200 and ctype == "application/json"
+            health = json.loads(body)
+            assert health["status"] in ("ok", "degraded", "critical")
+            assert isinstance(health["firing"], list)
+            assert health["status"] == alerts.ENGINE.health()["status"]
+        finally:
+            server.close()
+
     def test_start_from_env(self, monkeypatch):
         monkeypatch.delenv("QK_METRICS_PORT", raising=False)
         assert export.start_from_env() is None
