@@ -4,7 +4,7 @@
 PY ?= python
 export JAX_PLATFORMS ?= cpu
 
-.PHONY: lint lint-baseline verify-static plan-fuzz test test-slow sanitize-demo service-smoke chaos-smoke obs-smoke bench-check bench-trend shuffle-smoke fusion-smoke warmup-smoke multichip-smoke stream-smoke mem-smoke explain-smoke health-smoke
+.PHONY: lint lint-baseline verify-static plan-fuzz test test-slow sanitize-demo service-smoke chaos-smoke obs-smoke bench-check bench-trend shuffle-smoke fusion-smoke warmup-smoke multichip-smoke stream-smoke mem-smoke explain-smoke health-smoke adapt-smoke
 
 # engine-invariant static analysis; exits nonzero on findings beyond the
 # checked-in baseline (quokka_tpu/analysis/baseline.json)
@@ -135,6 +135,15 @@ mem-smoke:
 # under the plan fingerprint
 explain-smoke:
 	$(PY) -m quokka_tpu.obs.explain_smoke
+
+# adaptive-planning smoke: a cold plan decides from hints/samples, the warm
+# re-plan must FLIP >= 1 decision from the persisted cardinality profile
+# (measured basis, visible in explain's planner-decision section), a seeded
+# zipfian build must trigger the mid-query skew re-partition, and both the
+# flipped plan and the adapted run must be BIT-EXACT vs their static
+# counterparts (QK_ADAPT=0) with ZERO added host syncs (planner/adapt.py)
+adapt-smoke:
+	$(PY) -m quokka_tpu.planner.adapt_smoke
 
 # chaos plane soak: >= 20 seeded mixed-fault runs (RPC drops/delays, flaky
 # store calls, worker kills, spill + checkpoint corruption) each asserting

@@ -278,6 +278,70 @@ QUERIES = {"q1": run_q1, "q3": run_q3, "q5": run_q5}
 BUILDERS = {"q1": build_q1, "q3": build_q3, "q5": build_q5}
 
 
+# -- skewjoin: adaptive-vs-static on a zipfian-keyed build side -------------
+# One fat key holds SKEWJOIN_FAT of the build rows, so static hash
+# partitioning lands ~90% of the build on ONE channel — past the grace-join
+# spill cliff (SPILL_JOIN_BUILD_ROWS, lowered for the bench so SF doesn't
+# matter) that channel builds on disk.  The adaptive run's skew trigger
+# (planner/adapt.py) salts the fat partition across all channels, keeping
+# every build under the cliff and in memory.  The metric is the wall-clock
+# ratio static/adaptive; `--check` requires >= SKEWJOIN_MIN_SPEEDUP.
+SKEWJOIN_BUILD_ROWS = int(300_000 * max(SF, 0.1))
+SKEWJOIN_KEYS = 1_000
+SKEWJOIN_FAT = 0.9
+SKEWJOIN_SPILL_ROWS = int(SKEWJOIN_BUILD_ROWS * 2 / 3)
+# small row groups: the skew trigger can only fire on a batch boundary, so
+# finer batches mean an earlier re-partition (less pre-trigger residue on
+# the fat channel) and a static run that pays the spill tier per batch
+SKEWJOIN_ROW_GROUP = 1 << 13
+# grace-join fanout for the scenario: the spill tier sized for a genuinely
+# memory-tight box (64 partitions of ~4.5k rows each at SF 1), not the
+# roomy default — this is what the adaptive run gets to skip entirely
+SKEWJOIN_SPILL_FANOUT = 64
+
+
+def _skewjoin_paths():
+    """Seeded zipfian-ish skew pair, cached beside the TPC-H parquet."""
+    probe_p = os.path.join(CACHE, f"skewprobe_sf{SF}.parquet")
+    build_p = os.path.join(
+        CACHE, f"skewbuild_sf{SF}_rg{SKEWJOIN_ROW_GROUP}.parquet")
+    if not (os.path.exists(probe_p) and os.path.exists(build_p)):
+        import numpy as np
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        r = np.random.default_rng(20260807)
+        keys = r.integers(1, SKEWJOIN_KEYS,
+                          SKEWJOIN_BUILD_ROWS).astype(np.int64)
+        keys[r.random(SKEWJOIN_BUILD_ROWS) < SKEWJOIN_FAT] = 0
+        pq.write_table(pa.table({
+            "k": keys,
+            "v": r.integers(0, 1000, SKEWJOIN_BUILD_ROWS).astype(np.int64),
+        }), build_p, row_group_size=SKEWJOIN_ROW_GROUP)
+        pq.write_table(pa.table({
+            "pk": np.arange(SKEWJOIN_KEYS, dtype=np.int64),
+            "g": np.arange(SKEWJOIN_KEYS, dtype=np.int64) % 50,
+        }), probe_p)
+    return {"probe": probe_p, "build": build_p}
+
+
+def build_skewjoin(paths, ctx=None):
+    ctx = ctx or _ctx()
+    probe = ctx.read_parquet(paths["probe"])
+    build = ctx.read_parquet(paths["build"])  # right side = build = skewed
+    return (probe.join(build, left_on="pk", right_on="k")
+            .groupby("g").agg_sql("sum(v) as sv, count(*) as n"))
+
+
+def run_skewjoin(paths):
+    qry = build_skewjoin(paths)
+    t0 = time.time()
+    df = qry.collect()
+    dt = time.time() - t0
+    assert 0 < len(df) <= 50, df
+    return dt
+
+
 def _quantile(xs, q):
     xs = sorted(xs)
     if not xs:
@@ -499,6 +563,85 @@ def _write_obs_summary(obs_per_query):
         sys.stderr.write(f"bench: per-query span/counter summary: {path}\n")
     except OSError as e:
         sys.stderr.write(f"bench: could not write obs summary {path}: {e}\n")
+
+
+# `--check` floor for the skewjoin line: the adaptive run must beat the
+# statically-skewed run by at least this factor (the tentpole's headline)
+SKEWJOIN_MIN_SPEEDUP = 2.0
+
+
+def measure_skewjoin(platform):
+    """The skewjoin_adaptive_speedup line: the same zipfian join timed with
+    runtime adaptation on (default) vs off (``QK_ADAPT=0``).
+
+    Both variants plan COLD with cardprofile persistence OFF
+    (QK_CARDPROFILE_DIR=""), so every run's plan is identical except for
+    the adaptation mark — otherwise the first run's measured figures would
+    shrink the tiny-output join to one channel and erase the very skew the
+    trigger exists to fix.  The grace-join spill cliff is lowered to
+    SKEWJOIN_SPILL_ROWS so the static run's fat channel builds on disk
+    while the adapted run's salted channels all stay in memory.  One
+    warmup run per variant pays the compiles; the value is best-of-2
+    static seconds over best-of-2 adaptive seconds."""
+    from quokka_tpu import config as qk_config
+
+    env_overrides = {
+        "QK_CARDPROFILE_DIR": "",
+        # the skewed side must go through a hash EXCHANGE for the runtime
+        # trigger to have an edge to re-partition: pin broadcast off
+        "QK_BROADCAST_BYTES": "1",
+        "QK_SKEW_RATIO": "1.5",
+        "QK_ADAPT_MIN_ROWS": "20000",
+    }
+    saved_env = {k: os.environ.get(k) for k in (*env_overrides, "QK_ADAPT")}
+    os.environ.update(env_overrides)
+    saved_spill = qk_config.SPILL_JOIN_BUILD_ROWS
+    saved_fanout = qk_config.SPILL_JOIN_FANOUT
+    qk_config.SPILL_JOIN_BUILD_ROWS = SKEWJOIN_SPILL_ROWS
+    qk_config.SPILL_JOIN_FANOUT = SKEWJOIN_SPILL_FANOUT
+    try:
+        paths = _skewjoin_paths()
+        os.environ["QK_ADAPT"] = "0"
+        run_skewjoin(paths)  # compile warm-up (static plan)
+        static = sorted(run_skewjoin(paths) for _ in range(2))
+        os.environ.pop("QK_ADAPT", None)
+        run_skewjoin(paths)  # warm-up (adaptive: same kernels + salt/replicate)
+        adaptive = sorted(run_skewjoin(paths) for _ in range(2))
+        ops_detail = _operators_detail()
+        planner = (ops_detail or {}).get("planner") or []
+        adapted = any(d.get("kind") == "adapt_runtime" for d in planner)
+        speedup = static[0] / adaptive[0]
+        sys.stderr.write(
+            f"bench: skewjoin static {static[0]:.3f}s adaptive "
+            f"{adaptive[0]:.3f}s ({speedup:.2f}x, adapted={adapted})\n")
+        return {
+            "metric": "skewjoin_adaptive_speedup",
+            "value": round(speedup, 4),
+            "unit": "x",
+            # normalized so 1.0 == exactly the required 2x floor
+            "vs_baseline": round(speedup / SKEWJOIN_MIN_SPEEDUP, 4),
+            "detail": {
+                "sf": SF, "platform": platform,
+                "build_rows": SKEWJOIN_BUILD_ROWS,
+                "fat_fraction": SKEWJOIN_FAT,
+                "spill_join_rows": SKEWJOIN_SPILL_ROWS,
+                "spill_join_fanout": SKEWJOIN_SPILL_FANOUT,
+                "seconds_static": [round(x, 4) for x in static],
+                "seconds_adaptive": [round(x, 4) for x in adaptive],
+                # proof the adaptive run actually re-partitioned mid-query
+                # (`--check` fails a fresh line where the trigger slept)
+                "adapted": adapted,
+                "operators": ops_detail,
+            },
+        }
+    finally:
+        qk_config.SPILL_JOIN_BUILD_ROWS = saved_spill
+        qk_config.SPILL_JOIN_FANOUT = saved_fanout
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
 
 
 def measure(paths):
@@ -765,6 +908,21 @@ def measure(paths):
     finally:
         signal.alarm(0)
         signal.signal(signal.SIGALRM, old_handler)
+    # skewjoin: adaptive-vs-static under zipfian build skew.  Own alarm so
+    # a wedge here skips one line, not the already-printed TPC-H lines.
+    def _skew_alarm(sig, frm):
+        raise TimeoutError("skewjoin benchmark section timed out")
+
+    old_handler = signal.signal(signal.SIGALRM, _skew_alarm)
+    signal.alarm(int(os.environ.get("QUOKKA_BENCH_SKEW_TIMEOUT", "600")))
+    try:
+        print(json.dumps(measure_skewjoin(platform)))
+        sys.stdout.flush()
+    except Exception as e:  # noqa: BLE001 — the TPC-H lines must survive
+        sys.stderr.write(f"bench: skewjoin section skipped: {e}\n")
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old_handler)
     _write_obs_summary(obs_per_query)
     geomean = math.exp(
         sum(math.log(v["speedup_vs_ref_per_chip"]) for v in per_query.values())
@@ -1007,6 +1165,47 @@ def check_fused_stages_presence(cur, require):
             bad.append(name)
         else:
             rows.append((name, "ok", f"{n} fused stage(s) dispatched"))
+    return rows, bad
+
+
+def check_skewjoin_gate(cur, require):
+    """Adaptive-planning gate rows: a fresh run must carry the skewjoin
+    line, its adaptive run must actually have re-partitioned mid-query
+    (detail.adapted), and the speedup must clear SKEWJOIN_MIN_SPEEDUP.
+    A missing line, a sleeping trigger, or a sub-floor ratio all mean the
+    adaptive win evaporated — same presence discipline as fused_stages.
+    Returns (rows, violations)."""
+    rows, bad = [], []
+    if not require:
+        return rows, bad
+    metric = "skewjoin_adaptive_speedup"
+    name = f"skewjoin[{metric}]"
+    d = cur.get(metric)
+    if d is None:
+        rows.append((name, "MISSING",
+                     "fresh run emitted no skewjoin line — the adaptive-vs-"
+                     "static benchmark did not run"))
+        bad.append(name)
+        return rows, bad
+    detail = d.get("detail") or {}
+    value = float(d.get("value") or 0.0)
+    if not detail.get("adapted"):
+        rows.append((name, "MISSING",
+                     "the adaptive run never fired the skew trigger (no "
+                     "adapt_runtime decision) — the measured 'adaptive' "
+                     "path was the static one"))
+        bad.append(name)
+    elif value < SKEWJOIN_MIN_SPEEDUP:
+        rows.append((name, "REGRESSED",
+                     f"adaptive speedup {value:.2f}x under the required "
+                     f"{SKEWJOIN_MIN_SPEEDUP:.0f}x floor "
+                     f"(static {detail.get('seconds_static')}, adaptive "
+                     f"{detail.get('seconds_adaptive')})"))
+        bad.append(name)
+    else:
+        rows.append((name, "ok",
+                     f"adaptive {value:.2f}x over static (floor "
+                     f"{SKEWJOIN_MIN_SPEEDUP:.0f}x, adapted mid-query)"))
     return rows, bad
 
 
@@ -1388,7 +1587,12 @@ def check_main(argv):
     f_rows, f_bad = check_fused_stages_presence(
         cur, require=(args.current is None))
     regressed += f_bad
-    s_rows = s_rows + o_rows + f_rows
+    # adaptive-planning gate: the fresh skewjoin line must exist, must have
+    # actually adapted mid-query, and must clear SKEWJOIN_MIN_SPEEDUP
+    k_rows, k_bad = check_skewjoin_gate(
+        cur, require=(args.current is None))
+    regressed += k_bad
+    s_rows = s_rows + o_rows + f_rows + k_rows
     out = sys.stdout
     out.write(f"bench --check: {cur_src} vs {against}\n")
     if base_truncated:

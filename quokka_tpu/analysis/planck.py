@@ -35,6 +35,12 @@ Zero-baseline rules (no suppression file — a violation fails tier-1):
   time key, an UNBOUNDED source keeps the single-channel streaming
   discipline, and no checkpoint-barrier member hides inside a fused stage
   (a fused stage checkpoints as ONE unit).
+- **QK026 adaptive-exchange legality** — ``adapt_salt`` (the mark that lets
+  the runtime re-partition a skewed build exchange mid-query,
+  planner/decide.py) sits only where the salt+replicate rewrite provably
+  keeps every inner match exactly-once: INNER hash joins, non-broadcast,
+  no claimed output order; and the reserved runtime salt column never
+  appears in any node's schema.
 
 Pass-level instrumentation lives in ``optimizer.optimize``: under
 ``QK_PLAN_VERIFY=1`` (default-on in tests and bench.py) every pass's
@@ -69,6 +75,8 @@ RULES = {
     "QK023": "fusion legality: fusible members + exact unfuse round-trip",
     "QK024": "streaming legality: monotone order metadata, 1-channel "
              "unbounded sources, no checkpoint barrier inside a stage",
+    "QK026": "adaptive-exchange legality: adapt_salt only on inner "
+             "non-broadcast unordered joins; salt column reserved",
 }
 
 # plan-time verification cost, surfaced per-query in bench.py detail
@@ -119,6 +127,7 @@ def _node_sig(node: logical.Node) -> tuple:
         tuple(getattr(node, "boundaries", None) or ()),
         tuple(sorted((getattr(node, "rename", None) or {}).items())),
         bool(getattr(node, "folded", False)),
+        bool(getattr(node, "adapt_salt", False)),
     )
     if isinstance(node, logical.FusedStageNode):
         sig += (tuple(_node_sig(m) for m in node.members),)
@@ -157,7 +166,8 @@ def finish_plan() -> None:
 
 
 def collect(sub: Dict[int, logical.Node], sink_id: int) -> List[PlanViolation]:
-    """Run QK021-QK024 over the reachable plan; return all violations."""
+    """Run QK021-QK024 + QK026 over the reachable plan; return all
+    violations."""
     out: List[PlanViolation] = []
     order = _reachable(sub, sink_id)
     consumers: Dict[int, List[int]] = {nid: [] for nid in order}
@@ -172,6 +182,7 @@ def collect(sub: Dict[int, logical.Node], sink_id: int) -> List[PlanViolation]:
         if isinstance(node, logical.FusedStageNode):
             out += _qk023_fusion(sub, nid, node, consumers)
         out += _qk024_streaming(sub, nid, node)
+        out += _qk026_adaptive(nid, node)
     return out
 
 
@@ -349,6 +360,38 @@ def _qk024_streaming(sub, nid, node) -> List[PlanViolation]:
                     isinstance(m, logical.StatefulNode):
                 bad(f"checkpoint barrier (member {i}, {m.describe()}) inside "
                     "a fused stage — the stage checkpoints as one unit")
+    return out
+
+
+def _qk026_adaptive(nid, node) -> List[PlanViolation]:
+    out = []
+
+    def bad(msg):
+        out.append(PlanViolation("QK026", nid, node.describe(), msg))
+
+    # the runtime salting rewrite owns this name on the wire; a plan that
+    # emits it would collide with adapted exchanges (decide.SALT_COLUMN)
+    from quokka_tpu.planner.decide import SALT_COLUMN
+
+    if SALT_COLUMN in set(node.schema):
+        bad(f"reserved salt column {SALT_COLUMN!r} in output schema")
+    marked = [node]
+    if isinstance(node, logical.FusedStageNode):
+        marked += list(node.members)
+    for m in marked:
+        if not getattr(m, "adapt_salt", False):
+            continue
+        if not isinstance(m, logical.JoinNode):
+            bad(f"adapt_salt on non-join {type(m).__name__}")
+            continue
+        if m.how != "inner":
+            bad(f"adapt_salt on {m.how!r} join — only inner joins keep "
+                "exactly-once matching under salt+replicate")
+        if m.broadcast:
+            bad("adapt_salt on a broadcast join (no build exchange to salt)")
+        if m.sorted_by:
+            bad(f"adapt_salt on an order-carrying join (sorted_by="
+                f"{list(m.sorted_by)}) — replicated probe slices interleave")
     return out
 
 

@@ -22,6 +22,10 @@ Fault modes (cycled; ``--runs 20`` covers every mode at least twice):
   service      two concurrent queries on one QueryService under
                corrupt_ckpt + per-query scripted kills — both bit-exact,
                neighbors unaffected
+  adapt-kill   a zipfian build fires the mid-query skew re-partition
+               (planner/adapt.py), then BOTH adapted join channels die
+               with no checkpoint — the replay must re-read the journaled
+               ADT routing and stay bit-exact
   distributed  2 spawned workers; RPC drops/delays + flaky store calls +
                a chaos SIGKILL of a random worker at an input boundary
 
@@ -35,6 +39,7 @@ the soak exits nonzero.
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 import tempfile
 import time
@@ -49,7 +54,7 @@ from quokka_tpu.chaos import publish_env
 _COUNTERS = ("integrity.corrupt", "chaos.corrupt", "chaos.rpc",
              "chaos.delay", "chaos.store", "chaos.kill", "rpc.reconnect",
              "rpc.dedup_hit", "store.retry", "recover.ckpt_fallback",
-             "recover.producer_rewind")
+             "recover.producer_rewind", "adapt.fired")
 
 
 def _snap():
@@ -90,7 +95,22 @@ def _tables():
         "key": np.arange(0, 150, dtype=np.int64),
         "y": r.integers(0, 50, 150).astype(np.float64),
     })
-    return agg, left, right
+    # zipfian build side for the adapt-kill mode: ~90% of the build rows
+    # hash to one join channel, so the planner's mid-query skew trigger
+    # (planner/adapt.py) fires before the scripted kill lands
+    r2 = np.random.default_rng(20260807)
+    n2 = 12_000
+    keys = r2.integers(0, 50, n2)
+    keys[r2.random(n2) < 0.9] = 0
+    skew_build = pa.table({
+        "k": keys.astype(np.int64),
+        "v": r2.integers(0, 100, n2).astype(np.float64),
+    })
+    skew_probe = pa.table({
+        "pk": np.arange(0, 50, dtype=np.int64),
+        "g": (np.arange(0, 50) % 5).astype(np.int64),
+    })
+    return agg, left, right, skew_build, skew_probe
 
 
 def _ctx(opt=True, **cfg):
@@ -121,6 +141,16 @@ def _q_join(ctx, left, right):
     return (ls.join(rs, on="key").groupby("key")
             .agg_sql("sum(x * y) as t, count(*) as n")
             .collect().sort_values("key").reset_index(drop=True))
+
+
+def _q_skew(ctx, probe, build):
+    from quokka_tpu.dataset.readers import InputArrowDataset
+
+    ps = ctx.read_dataset(InputArrowDataset(probe, batch_rows=64))
+    bs = ctx.read_dataset(InputArrowDataset(build, batch_rows=1024))
+    return (ps.join(bs, left_on="pk", right_on="k").groupby("g")
+            .agg_sql("sum(v) as sv, count(*) as n")
+            .collect().sort_values("g").reset_index(drop=True))
 
 
 def _exact(got, want, what):
@@ -211,6 +241,50 @@ def _mode_service(seed, spec, tabs, base):
             _exact(got2, base[1], "service join")
         finally:
             svc.shutdown()
+
+
+def _spec_adapt(seed):
+    return f"seed={seed},corrupt=0.3"
+
+
+def _mode_adapt_kill(seed, spec, tabs, base):
+    """A mid-query skew re-partition (planner/adapt.py) must survive losing
+    BOTH channels of the adapted join with no checkpoint: the ADT routing
+    records are journaled before the first salted push, so the full-tape
+    replay re-reads them (_adapt_refresh) and routes the replayed batches
+    exactly as the adapted run did — bit-exact, no double counting of the
+    replicated probe partition."""
+    from quokka_tpu import obs, optimizer
+
+    # pin the shape the scripted kill assumes: broadcast off (the join
+    # must be a hash exchange for the trigger to have an edge to salt) and
+    # a trigger that fires a few build batches in.  plan probe above shows
+    # actor 2 = the 2-channel join exec under these knobs.
+    knobs = {"QK_BROADCAST_BYTES": "1", "QK_SKEW_RATIO": "1.5",
+             "QK_ADAPT_MIN_ROWS": "4000"}
+    saved = {k: os.environ.get(k) for k in knobs}
+    os.environ.update(knobs)
+    thr, optimizer.BROADCAST_THRESHOLD = optimizer.BROADCAST_THRESHOLD, 0
+    fired0 = obs.REGISTRY.counter("adapt.fired").value
+    try:
+        with _chaos(spec), tempfile.TemporaryDirectory() as d:
+            ctx = _ctx(fault_tolerance=True, hbq_path=d,
+                       checkpoint_interval=None,
+                       inject_failure={"after_tasks": 16 + seed % 6,
+                                       "channels": [(2, 0), (2, 1)]})
+            _exact(_q_skew(ctx, tabs[4], tabs[3]), base[2],
+                   "adapt-kill join")
+        if obs.REGISTRY.counter("adapt.fired").value - fired0 < 1:
+            raise AssertionError(
+                "the zipfian build never fired the skew trigger — the "
+                "run recovered but proved nothing about adapted routing")
+    finally:
+        optimizer.BROADCAST_THRESHOLD = thr
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
 
 
 def _spec_stream(seed):
@@ -325,13 +399,13 @@ MODES = [
     ("spill-storm", _spec_storm, _mode_spill_storm, True),
     ("ckpt-storm", _spec_ckpt_storm, _mode_ckpt_storm, True),
     ("service", _spec_service, _mode_service, False),
-    ("mixed", _spec_mixed, _mode_mixed, False),
+    ("adapt-kill", _spec_adapt, _mode_adapt_kill, False),
     ("spill-storm-join", _spec_storm, _mode_spill_storm_join, True),
     ("ckpt-storm", _spec_ckpt_storm, _mode_ckpt_storm, True),
-    # the stream mode takes one of the three "mixed" slots rather than
-    # growing the cycle: inserting an 11th entry would shift every later
-    # run's (mode, seed) pairing, and the storm modes' detection
-    # assertions are only validated for the seeds they actually get
+    # the stream and adapt-kill modes take two of the three "mixed" slots
+    # rather than growing the cycle: inserting an 11th entry would shift
+    # every later run's (mode, seed) pairing, and the storm modes'
+    # detection assertions are only validated for the seeds they get
     ("stream", _spec_stream, _mode_stream, False),
     ("distributed", _spec_distributed, _mode_distributed, False),
     ("spill-storm", _spec_storm, _mode_spill_storm, True),
@@ -350,10 +424,20 @@ def main(argv=None) -> int:
     from quokka_tpu import obs
     from quokka_tpu.obs import alerts
 
+    # plan-shape isolation: the scripted inject_failure channel ids assume
+    # the pinned cold-plan shapes (see _ctx).  The planner re-sizes
+    # channels from the persisted cardinality profile, so a populated
+    # developer cache — or this soak's OWN baseline runs — would shrink
+    # the tiny aggs to one channel and the scripted kills would target
+    # channels that don't exist.  Same discipline as tests/conftest.py.
+    os.environ["QK_CARDPROFILE_DIR"] = ""
+    os.environ["QK_MEMPROFILE_DIR"] = ""
+
     publish_env(None)  # baselines run undisturbed
     tabs = _tables()
     t0 = time.time()
-    base = (_q_agg(_ctx(), tabs[0]), _q_join(_ctx(), tabs[1], tabs[2]))
+    base = (_q_agg(_ctx(), tabs[0]), _q_join(_ctx(), tabs[1], tabs[2]),
+            _q_skew(_ctx(), tabs[4], tabs[3]))
     print(f"[chaos-smoke] baselines in {time.time() - t0:.1f}s; "
           f"{args.runs} seeded runs, base seed {args.seed}", flush=True)
 

@@ -141,6 +141,54 @@ def stage_fuse_enabled() -> bool:
     return os.environ.get("QK_STAGE_FUSE", "1") not in ("0", "false", "no")
 
 
+def adapt_enabled() -> bool:
+    """Runtime adaptive re-partitioning kill switch (planner/adapt.py):
+    QK_ADAPT=0 disables both the plan-time eligibility pass and the
+    mid-query skew trigger, so a suspect adapted plan can be re-run
+    statically.  Read dynamically (not cached at import) so one process can
+    run both variants — the adapt smoke compares adaptive vs static
+    results in-process."""
+    return os.environ.get("QK_ADAPT", "1") not in ("0", "false", "no")
+
+
+def adapt_min_rows() -> int:
+    """Floor on total rows delivered to a join's build edge before the
+    skew trigger may fire (QK_ADAPT_MIN_ROWS).  Below this, re-partitioning
+    buys nothing — the whole build fits one channel comfortably."""
+    try:
+        return int(os.environ.get("QK_ADAPT_MIN_ROWS", 1 << 15))
+    except ValueError:
+        return 1 << 15
+
+
+def broadcast_bytes_threshold() -> int:
+    """Measured-bytes ceiling for the cost-based broadcast-join choice
+    (planner/decide.py): a build side whose MEASURED cardprofile bytes fit
+    under QK_BROADCAST_BYTES is replicated to every probe channel instead
+    of hash-partitioning both sides.  Only consulted when a measured figure
+    exists; cold plans keep the row-estimate threshold
+    (optimizer.BROADCAST_THRESHOLD)."""
+    try:
+        return int(os.environ.get("QK_BROADCAST_BYTES", 8 << 20))
+    except ValueError:
+        return 8 << 20
+
+
+def replay_retry_deadline_s() -> float:
+    """Upper bound on how long a recovering consumer waits for a lost
+    object's producer replay before declaring the loss irrecoverable
+    (QK_REPLAY_DEADLINE, runtime/engine.py).  The deadline exists so a
+    producer that died holding un-replayable state fails the query loudly
+    instead of wedging it forever; it is env-tunable because the right
+    bound is load-dependent — a 1-core CI box replaying a long exec tape
+    under kill-storm chaos legitimately needs minutes, while a test suite
+    that *expects* irrecoverable losses wants the verdict in seconds."""
+    try:
+        return float(os.environ.get("QK_REPLAY_DEADLINE", 600.0))
+    except ValueError:
+        return 600.0
+
+
 def use_host_asof() -> bool:
     """Whether the as-of match runs as a native sequential merge on host
     (ops/asof._asof_match_host -> native/columnar.cpp).  Thin delegate to

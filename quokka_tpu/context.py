@@ -331,7 +331,11 @@ class QuokkaContext:
             sink_id = sub_sink_id
         if self.optimize_plans:
             from quokka_tpu.optimizer import optimize
+            from quokka_tpu.planner import decide
 
+            # collect the cost-based passes' decision log for this plan
+            # (harvested in _lower_plan, surfaced by explain())
+            decide.begin_decisions()
             sink_id = optimize(sub, sink_id, exec_channels=self.exec_channels)
         return sub, sink_id
 
@@ -347,6 +351,11 @@ class QuokkaContext:
             if pl is not None:
                 graph.actors[aid].placement = pl
         self.latest_graph = graph
+        # planner decision log (begun in _prepare_plan): rides the graph so
+        # opstats.register_plan stores it and explain() renders it
+        from quokka_tpu.planner import decide
+
+        graph.planner_decisions = decide.take_decisions()
         # compile plane: fingerprint the lowered plan and start loading its
         # persisted executables in the background — warmup overlaps the
         # scan/admission work between here and the first dispatch

@@ -87,6 +87,14 @@ class SourceNode(Node):
             predicate=self.predicate,
             projection=self.projection,
         )
+        # plan-independent scan identity: the cardprofile records this
+        # scan's measured rows/bytes under it, and the cost model
+        # (planner/cost.py) looks the figure up at the NEXT plan time —
+        # before any fingerprint for the next plan can exist
+        from quokka_tpu.planner.cost import source_signature
+
+        graph.actors[actor_of[node_id]].src_sig = source_signature(
+            reader, self.predicate, self.projection)
 
     def describe(self):
         d = f"Source({type(self.reader).__name__}"
@@ -377,6 +385,10 @@ class JoinNode(Node):
         # when the optimizer prunes the clashing probe column)
         self.rename = rename
         self.build_parents = [1]
+        # planner/decide.plan_adaptive_exchanges: this join's build edge may
+        # be salted mid-query when the runtime observes partition skew
+        # (inner non-broadcast joins only — see QK026)
+        self.adapt_salt = False
 
     def derive_schema(self, parents):
         _require(self.left_on, parents[0], "join left keys")
@@ -404,13 +416,18 @@ class JoinNode(Node):
                 1: (actor_of[self.parents[1]], TargetInfo(HashPartitioner(right_on))),
             }
         actor_of[node_id] = graph.new_exec_node(
-            functools.partial(BuildProbeJoinExecutor, 
+            functools.partial(BuildProbeJoinExecutor,
                 left_on, right_on, how, suffix, rename, out_schema=out_schema
             ),
             edges,
             self.channels or ctx.exec_channels,
             self.stage,
         )
+        if not self.broadcast and getattr(self, "adapt_salt", False):
+            graph.adapt_edges[(actor_of[self.parents[1]],
+                               actor_of[node_id])] = {
+                "probe_src": actor_of[self.parents[0]],
+            }
 
     def describe(self):
         k = "BroadcastJoin" if self.broadcast else "HashJoin"
@@ -584,6 +601,14 @@ class FusedStageNode(Node):
             self.channels or ctx.exec_channels,
             self.stage,
         )
+        # fuse_stages only admits a non-broadcast hash join at the chain
+        # HEAD; its build is the fused actor's stream-1 source, so the
+        # adaptive-exchange mark survives fusion as a runtime edge
+        if (isinstance(head, JoinNode) and not head.broadcast
+                and getattr(head, "adapt_salt", False) and 1 in sources):
+            graph.adapt_edges[(sources[1][0], fused)] = {
+                "probe_src": sources[0][0],
+            }
         if agg is None:
             actor_of[node_id] = fused
             return

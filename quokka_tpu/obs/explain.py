@@ -64,6 +64,43 @@ def _op_line(o: dict) -> str:
     return "  ".join(bits)
 
 
+def _decision_line(d: dict) -> str:
+    """One planner decision (planner/decide.py record shapes + the
+    engine's runtime adapt_runtime records) as a terminal line."""
+    kind = d.get("kind", "?")
+    if kind == "broadcast":
+        bits = [f"broadcast? {d.get('node')}: {d.get('choice')}",
+                f"basis={d.get('basis')}"]
+        if d.get("build_rows") is not None:
+            bits.append(f"build_rows={_fmt_rows(d['build_rows'])}")
+        if d.get("build_bytes") is not None:
+            bits.append(f"build_bytes={_fmt_bytes(d['build_bytes'])}")
+        if d.get("threshold_bytes") is not None:
+            bits.append(
+                f"QK_BROADCAST_BYTES={_fmt_bytes(d['threshold_bytes'])}")
+        elif d.get("threshold_rows") is not None:
+            bits.append(f"threshold_rows={_fmt_rows(d['threshold_rows'])}")
+        return "  ".join(bits)
+    if kind == "join_order":
+        return (f"join_order [{d.get('basis')}]: "
+                + " | ".join(d.get("after") or []))
+    if kind == "channels":
+        return (f"channels {d.get('node')}: {d.get('default')}"
+                f"->{d.get('channels')}  basis={d.get('basis')}"
+                f" rows={_fmt_rows(d.get('rows', 0))}")
+    if kind == "adapt_mark":
+        joins = ", ".join(d.get("joins") or [])
+        return (f"adaptive exchanges armed (QK_SKEW_RATIO="
+                f"{d.get('skew_ratio')}): {joins}")
+    if kind == "adapt_runtime":
+        return (f"RUNTIME adapt {d.get('edge')}: channel "
+                f"{d.get('fat_channel')} had "
+                f"{_fmt_rows(d.get('fat_rows', 0))} of "
+                f"{_fmt_rows(d.get('total_rows', 0))} rows "
+                f"(ratio={d.get('ratio')}) -> {d.get('action')}")
+    return " ".join(f"{k}={v}" for k, v in d.items())
+
+
 def render(snap: Optional[dict], top_n: int = 5) -> str:
     """The human EXPLAIN ANALYZE report for one query's snapshot (what
     ``QueryHandle.explain()`` and ``bench.py --measure`` print)."""
@@ -89,6 +126,11 @@ def render(snap: Optional[dict], top_n: int = 5) -> str:
                 f"rows={_fmt_rows(e['rows_total'])} "
                 f"max={_fmt_rows(e['rows_max'])} mean={e['rows_mean']:.0f} "
                 f"ratio={e['skew_ratio']:.2f}{flag}")
+    planner = snap.get("planner") or []
+    if planner:
+        lines.append("planner decisions:")
+        for d in planner:
+            lines.append("  " + _decision_line(d))
     hot = (snap.get("top_operators") or [])[:top_n]
     if hot:
         lines.append("top operators by dispatch time:")
@@ -132,6 +174,9 @@ def operators_detail(snap: Optional[dict]) -> Optional[dict]:
              "ratio": e["skew_ratio"], "skewed": e["skewed"]}
             for e in snap["edges"]],
         "rows_unknown": snap.get("rows_unknown", 0),
+        # plan-time choices + runtime adaptations (bench detail.plan's
+        # "planner" section; same records explain() renders)
+        "planner": [dict(d) for d in snap.get("planner") or []],
     }
 
 

@@ -138,13 +138,36 @@ class OpStats:
                         if h > 0:
                             ent["size_hint_bytes"] = h
                             hint_total += h
+                    sig = getattr(info, "src_sig", None)
+                    if sig:
+                        # plan-independent scan identity: cardprofile
+                        # persistence keys this scan's measured figures
+                        # under it (planner/cost.py reads them back)
+                        ent["src_sig"] = sig
                 actors[aid] = ent
             self._plans[qid] = {
                 "actors": actors,
                 "plan_fp": getattr(graph, "plan_fp", None),
                 "size_hint_bytes": hint_total,
                 "t0": time.time(),
+                # plan-time decisions (planner/decide.py), attached to the
+                # graph at lowering; runtime adaptations append here
+                "planner": list(getattr(graph, "planner_decisions", None)
+                                or []),
             }
+
+    def note_adaptation(self, qid: Optional[str], rec: dict) -> None:
+        """Engine-side: append a runtime re-optimization record (skew
+        trigger fired, exchange re-routed) to the query's planner-decision
+        log so explain() shows plan-time choices and runtime adaptations in
+        one section.  No-op for an unregistered query."""
+        if qid is None:
+            return
+        with self._lock:
+            plan = self._plans.get(qid)
+            if plan is None:
+                return
+            plan.setdefault("planner", []).append(dict(rec))
 
     # -- hot-path recording (engine choke points) ----------------------------
     def _rec(self, key: Tuple[str, int, int]) -> Dict[str, int]:
@@ -371,6 +394,8 @@ class OpStats:
                     max(0.0, 1.0 - agg["rows_in"] / agg["padded_in"]), 4)
             if ent.get("size_hint_bytes"):
                 op["size_hint_bytes"] = ent["size_hint_bytes"]
+            if ent.get("src_sig"):
+                op["src_sig"] = ent["src_sig"]
             if aid in notes:
                 op.update(notes[aid])
             operators.append(op)
@@ -416,6 +441,9 @@ class OpStats:
                  "time_share": o["time_share"], "rows_out": o["rows_out"]}
                 for o in hot],
             "rows_unknown": rows_unknown,
+            # plan-time choices + runtime adaptations, with the figures
+            # that drove them (explain's "planner decisions" section)
+            "planner": [dict(d) for d in plan.get("planner") or []],
         }
 
     def _export_gauges(self, qid: str, snap: dict) -> None:
@@ -676,6 +704,26 @@ def record_cardinalities(plan_fp: Optional[str], snap: dict) -> None:
         for o in snap.get("operators", ()):
             k = f"a{o['actor']}:{o['op']}"
             rows[k] = max(int(o["rows_out"]), int(rows.get(k, 0) or 0))
+        # plan-INDEPENDENT scan figures keyed by source signature: any plan
+        # scanning the same (reader, predicate, projection) reuses them
+        # (planner/cost.py's MEASURED basis)
+        sources = prof.get("sources")
+        sources = sources if isinstance(sources, dict) else {}
+        for o in snap.get("operators", ()):
+            sig = o.get("src_sig")
+            if not sig or o.get("kind") != "input" or not o.get("rows_out"):
+                continue
+            cur = sources.get(sig)
+            cur = cur if isinstance(cur, dict) else {}
+            sources[sig] = {
+                "rows_raw": max(int(o["rows_in"]),
+                                int(cur.get("rows_raw", 0) or 0)),
+                "rows": max(int(o["rows_out"]), int(cur.get("rows", 0) or 0)),
+                "bytes": max(int(o["bytes_out"]),
+                             int(cur.get("bytes", 0) or 0)),
+                "runs": int(cur.get("runs", 0) or 0) + 1,
+            }
+        prof["sources"] = sources
         prof["plans"][plan_fp] = {
             "source_rows": max(src_rows, int(ent.get("source_rows", 0) or 0)),
             "source_bytes": max(src_bytes,
@@ -721,6 +769,25 @@ def measured_source_bytes(plan_fp: Optional[str]) -> Optional[int]:
     except (TypeError, ValueError):
         return None
     return b if b > 0 else None
+
+
+def measured_sources() -> Dict[str, dict]:
+    """Plan-independent measured scan figures keyed by source signature:
+    ``{sig: {"rows_raw", "rows", "bytes", "runs"}}`` where ``rows_raw`` is
+    pre-predicate reader output, ``rows``/``bytes`` post-predicate.  The
+    planner's cost model (``planner/cost.py``) treats an exact signature
+    match as MEASURED basis; a bare-scan signature match supplies the
+    measured selectivity of a predicate.  Empty dict when no profile."""
+    path = _profile_path()
+    if path is None:
+        return {}
+    prof = _load_profile(path)
+    if prof is None:
+        return {}
+    src = prof.get("sources")
+    if not isinstance(src, dict):
+        return {}
+    return {sig: ent for sig, ent in src.items() if isinstance(ent, dict)}
 
 
 def measured_calib_rows() -> Optional[int]:

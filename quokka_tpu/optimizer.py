@@ -44,13 +44,20 @@ def pass_pipeline(exec_channels: int = 2):
 
         return run
 
+    # cost-fed passes live in planner/decide.py; imported lazily because
+    # decide consumes this module's chain-walk and catalog helpers
+    from quokka_tpu.planner import decide
+
     return [
         (name, wrap(fn))
         for name, fn in [
             ("push_filters", push_filters),
             ("early_projection", early_projection),
-            ("reorder_joins", reorder_joins),
-            ("choose_broadcast", choose_broadcast),
+            ("reorder_joins", decide.reorder_joins_cost),
+            ("choose_broadcast", decide.choose_broadcast_cost),
+            ("size_channels",
+             lambda sub, sid: decide.size_channels(sub, sid, exec_channels)),
+            ("plan_adaptive_exchanges", decide.plan_adaptive_exchanges),
             ("plan_parallel_sorts",
              lambda sub, sid: plan_parallel_sorts(sub, sid, exec_channels)),
             ("push_ann", push_ann),
@@ -533,15 +540,23 @@ def unfuse_stages(sub: Dict[int, logical.Node]) -> Dict[int, logical.Node]:
     return out
 
 
-def reorder_joins(sub: Dict[int, logical.Node], sink_id: int) -> None:
+def reorder_joins(sub: Dict[int, logical.Node], sink_id: int,
+                  estimate=None, on_reorder=None, basis_of=None) -> None:
     """Greedy cardinality ordering of left-deep inner-join chains
     (df.py:1401-1513 merged multi-joins + 1570-1594 ordering): collect the
     chain's build subtrees, estimate each, and re-attach them smallest-first
     subject to key availability (snowflake joins whose keys come from an
     earlier dimension keep their dependency order).  Only applies when no
     column renames are involved and payload names are globally unique, so
-    output schemas are order-independent."""
+    output schemas are order-independent.
+
+    ``estimate(nid) -> Optional[float]`` overrides the catalog sampler
+    (planner/decide.py feeds cost-model figures through here);
+    ``on_reorder(chain_ids, before, after, basis)`` observes each applied
+    reorder, with ``basis_of(nid)`` labelling the estimates' provenance."""
     cat = _get_catalog()
+    if estimate is None:
+        estimate = lambda nid: _estimate_subtree(sub, nid, cat)  # noqa: E731
     cons = _consumers(sub, sink_id)
 
     def chain_join(nid) -> bool:
@@ -586,7 +601,7 @@ def reorder_joins(sub: Dict[int, logical.Node], sink_id: int) -> None:
                 ok = False
                 break
             names |= set(payload)
-            est = _estimate_subtree(sub, j.parents[1], cat)
+            est = estimate(j.parents[1])
             if est is None:
                 ok = False
                 break
@@ -611,6 +626,15 @@ def reorder_joins(sub: Dict[int, logical.Node], sink_id: int) -> None:
             avail |= set(pick["payload"])
         if order is None or order == levels:
             continue
+        if on_reorder is not None:
+            basis = "sampled"
+            if basis_of is not None:
+                ranks = {"hint": 0, "sampled": 1, "measured": 2}
+                basis = min((basis_of(lv["build"]) for lv in levels),
+                            key=lambda b: ranks.get(b, 0))
+            on_reorder(
+                chain, [lv["build"] for lv in levels],
+                [lv["build"] for lv in order], basis)
         # reuse the chain's node ids positionally (bottom-up) so the top node
         # keeps its id and consumers stay untouched
         prev_id, prev_schema = base_id, base_schema

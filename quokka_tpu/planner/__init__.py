@@ -1,0 +1,22 @@
+"""Cost-based adaptive planning (ISSUE 18 / ROADMAP item 2).
+
+Three layers, each consuming the telemetry planes built in PRs 13-17:
+
+- ``cost``   — a calibrated cost model over the logical DAG.  Per-node
+  cardinality estimates prefer MEASURED figures (the opstats cardprofile's
+  per-source rows/bytes, keyed by a plan-independent source signature)
+  over catalog samples over reader ``size_hint()`` guesses.
+- ``decide`` — optimizer passes that consume the model: broadcast-vs-
+  partition join choice by measured build-side bytes (QK_BROADCAST_BYTES),
+  greedy join-order selection for >=3-way chains, per-node channel-count
+  sizing from observed row volumes, and plan-time marking of exchange
+  edges eligible for runtime adaptation.  Every choice is recorded with
+  the figures that drove it (the "planner decisions" explain section).
+- ``adapt``  — runtime re-optimization: when the engine observes a build
+  exchange edge skewed past QK_SKEW_RATIO mid-query, it salts the fat
+  partition across all build channels and replicates the fat probe slice,
+  durably recorded in the ADT control-store table so lineage replay and
+  chaos recovery route every batch exactly as the adapted run did.
+"""
+
+from quokka_tpu.planner import adapt, cost, decide  # noqa: F401

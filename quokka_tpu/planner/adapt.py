@@ -1,0 +1,137 @@
+"""Runtime re-optimization: mid-query skew re-partitioning.
+
+When the engine observes one channel of an eligible join-build exchange
+receiving ``QK_SKEW_RATIO`` times the mean row volume (the same threshold
+the explain skew report uses), it rewrites the exchange's ROUTING — no
+executor state moves:
+
+- **build edge ("salt" mode)** — batches from sequence ``from_seq`` on have
+  the fat channel's partition ids re-dealt round-robin across ALL build
+  channels (``salt_pids``).  Earlier sequences already shipped under plain
+  hashing and keep their placement; together every build row lands on
+  exactly one channel.
+- **probe edge ("replicate" mode)** — each probe channel receives its own
+  hash partition PLUS a copy of the fat partition (``replicate_parts``).
+  Stage gating means the probe stream has not started when the trigger
+  fires (the build side must finish first), so replication applies from
+  sequence 0.
+
+Inner-join correctness: a build row of a non-fat key sits on its hash
+channel, met there by that key's (unreplicated) probe partition — matched
+once.  A fat-key build row sits on exactly one (salted) channel, and the
+fat probe partition visits every channel — matched once, on whichever
+channel holds the build row.  Non-inner joins are ineligible
+(decide.plan_adaptive_exchanges): replication breaks the per-channel
+completeness their unmatched-row tracking needs.
+
+Determinism under recovery: the adaptation record is written to the ADT
+control-store table BEFORE the first salted batch ships (runtime/tables.py
+write-order discipline), and replay paths re-read it — a recovering
+channel or adopted worker routes every historical sequence exactly as the
+adapted run did.  ``QK_ADAPT=0`` disables eligibility and trigger both.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from quokka_tpu import config
+from quokka_tpu.ops.batch import DeviceBatch
+
+
+def _aot(kind, jit_fn, args, statics=()):
+    from quokka_tpu.runtime import compileplane
+
+    return compileplane.aot_kernel_call(kind, jit_fn, args, statics)
+
+
+# ---------------------------------------------------------------------------
+# routing kernels (one fused dispatch each; no host syncs on the push path)
+# ---------------------------------------------------------------------------
+
+
+def _salt_pids(pids, fat, n):
+    # re-deal the fat partition round-robin by row position: deterministic
+    # in batch content, independent of any runtime state
+    deal = jnp.arange(pids.shape[0], dtype=pids.dtype) % n
+    return jnp.where(pids == fat, deal, pids)
+
+
+@functools.lru_cache(maxsize=None)
+def _salt_jit():
+    # jit built on first dispatch, not at import (lint QK001): adaptation
+    # is rare, and a module-level jit object races across engine threads
+    return functools.partial(jax.jit, static_argnames=("fat", "n"))(_salt_pids)
+
+
+def salt_pids(pids: jax.Array, fat: int, n_parts: int) -> jax.Array:
+    """Partition ids with the fat partition's rows re-dealt across all
+    ``n_parts`` channels."""
+    return _aot("adapt_salt", _salt_jit(), (pids,), (int(fat), int(n_parts)))
+
+
+def _replicate_masks(pids, valid, fat, n):
+    masks = tuple(((pids == c) | (pids == fat)) & valid for c in range(n))
+    counts = tuple(jnp.sum(m.astype(jnp.int32)) for m in masks)
+    return masks, counts
+
+
+@functools.lru_cache(maxsize=None)
+def _replicate_jit():
+    return functools.partial(jax.jit,
+                             static_argnames=("fat", "n"))(_replicate_masks)
+
+
+def replicate_parts(batch: DeviceBatch, pids: jax.Array, fat: int,
+                    n_parts: int) -> List[DeviceBatch]:
+    """Per-channel probe parts: channel c's hash partition plus a copy of
+    the fat partition.  Masked views over the source batch (the
+    split_by_partition masked idiom): one dispatch, async counts, zero
+    blocking readbacks."""
+    masks, counts = _aot("adapt_replicate", _replicate_jit(),
+                         (pids, batch.valid), (int(fat), int(n_parts)))
+    return [
+        DeviceBatch(batch.columns, m, None, batch.sorted_by).note_count(c)
+        for m, c in zip(masks, counts)
+    ]
+
+
+# ---------------------------------------------------------------------------
+# trigger predicate (engine-local, plan-time-proven edges only)
+# ---------------------------------------------------------------------------
+
+
+def skewed_channel(hist: Dict[int, int], n_channels: int,
+                   ratio: float) -> Optional[int]:
+    """The channel whose delivered rows exceed ``ratio`` x the mean across
+    all ``n_channels`` (absent channels count zero), or None.  Mirrors the
+    opstats edge-skew report so the trigger and the explain section agree
+    on what "skewed" means."""
+    if n_channels < 2 or not hist:
+        return None
+    total = sum(hist.values())
+    if total < config.adapt_min_rows():
+        return None
+    mean = total / n_channels
+    if mean <= 0:
+        return None
+    fat, rows = max(hist.items(), key=lambda kv: kv[1])
+    if rows / mean >= ratio:
+        return int(fat)
+    return None
+
+
+def build_records(fat: int, build_channels: Dict[int, int],
+                  ) -> Tuple[dict, dict]:
+    """The (build, probe) ADT records for one fired adaptation.
+    ``build_channels`` maps the build source's channel -> the next sequence
+    it will push (already-shipped sequences keep their original routing)."""
+    return (
+        {"mode": "salt", "fat": int(fat),
+         "from_seq": {int(c): int(s) for c, s in build_channels.items()}},
+        {"mode": "replicate", "fat": int(fat), "from_seq": {}},
+    )

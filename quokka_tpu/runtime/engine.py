@@ -47,6 +47,7 @@ from quokka_tpu.runtime.task import (
 from quokka_tpu import obs
 from quokka_tpu.obs import memplane, opstats
 from quokka_tpu.obs import spans as tracing
+from quokka_tpu.planner import adapt as adapt_mod
 from quokka_tpu.target_info import (
     BroadcastPartitioner,
     FunctionPartitioner,
@@ -96,6 +97,10 @@ class ActorInfo:
         # runtime/placement.py strategy pinning channels to workers (None ->
         # round-robin spread, the reference default)
         self.placement = None
+        # plan-independent scan identity (planner/cost.source_signature),
+        # stamped by SourceNode.lower on input actors: keys this scan's
+        # measured rows/bytes in the persisted cardprofile
+        self.src_sig: Optional[str] = None
 
 
 class TaskGraph:
@@ -125,6 +130,11 @@ class TaskGraph:
             self.exec_config.update(exec_config)
         self.actors: Dict[int, ActorInfo] = {}
         self._next_actor = 0
+        # adaptive-exchange eligibility (planner/decide.py, registered by
+        # JoinNode.lower / FusedStageNode.lower): (build_src_actor,
+        # join_actor) -> {"probe_src": actor}.  The engine's skew trigger
+        # only ever fires on edges listed here.
+        self.adapt_edges: Dict[Tuple[int, int], dict] = {}
         # folded maps (optimizer.fold_maps): batch_funcs to prepend on every
         # edge whose source is this actor
         self._pending_batch_fns: Dict[int, List[Callable]] = {}
@@ -536,6 +546,13 @@ class Engine:
         self.max_batches = graph.exec_config.get("max_pipeline_batches", 8)
         self.execs: Dict[Tuple[int, int], object] = {}
         self._partition_fns: Dict[Tuple[int, int], Callable] = {}
+        # adaptive-exchange state (planner/adapt.py): the edge->record map
+        # mirrors the durable ADT table (re-read on every recovery path);
+        # the row histograms and last-pushed sequences feed the trigger
+        self._adapt: Dict[Tuple[int, int], dict] = dict(
+            self.store.titems("ADT"))
+        self._adapt_rows: Dict[Tuple[int, int], Dict[int, int]] = {}
+        self._push_seqs: Dict[Tuple[int, int], int] = {}
         for info in graph.actors.values():
             if info.kind == "exec":
                 for ch in range(info.channels):
@@ -583,7 +600,8 @@ class Engine:
             range_state = {"host": [int(b) for b in part.boundaries],
                            "dev": None}
 
-        def fn(batch: DeviceBatch, src_ch: int) -> Dict[int, DeviceBatch]:
+        def fn(batch: DeviceBatch, src_ch: int,
+               seq: int = 0) -> Dict[int, DeviceBatch]:
             if fused_pred is not None:
                 batch = fused_pred(batch)
             for f in tinfo.batch_funcs:
@@ -598,8 +616,23 @@ class Engine:
                 if n_tgt == 1:
                     out = {0: batch}
                 else:
+                    # mid-query adaptation (planner/adapt.py): an ADT
+                    # record rewrites this edge's routing — salt the fat
+                    # build partition from its recorded sequence on, or
+                    # replicate the fat probe partition to every channel.
+                    # Looked up per call: the record can appear mid-run.
+                    ad = self._adapt_map().get(key)
                     pids = kernels.partition_ids(batch, part.keys, n_tgt)
-                    out = dict(enumerate(kernels.split_by_partition(batch, pids, n_tgt)))
+                    if ad is not None and ad["mode"] == "replicate":
+                        out = dict(enumerate(adapt_mod.replicate_parts(
+                            batch, pids, ad["fat"], n_tgt)))
+                    else:
+                        if (ad is not None and ad["mode"] == "salt"
+                                and seq >= ad["from_seq"].get(src_ch, 0)):
+                            pids = adapt_mod.salt_pids(pids, ad["fat"],
+                                                       n_tgt)
+                        out = dict(enumerate(kernels.split_by_partition(
+                            batch, pids, n_tgt)))
             elif isinstance(part, RangePartitioner):
                 out = self._range_split(batch, part, n_tgt, range_state)
             elif isinstance(part, FunctionPartitioner):
@@ -634,6 +667,76 @@ class Engine:
             pids = (n_tgt - 1) - pids  # channel 0 owns the highest range
         return dict(enumerate(kernels.split_by_partition(batch, pids, n_tgt)))
 
+    # -- adaptive exchanges (planner/adapt.py) -------------------------------
+    def _adapt_map(self) -> Dict[Tuple[int, int], dict]:
+        """Edge -> adaptation record.  Lazy because the distributed Worker
+        bypasses Engine.__init__ (it never TRIGGERS adaptations, but its
+        partition fns must honor records a coordinator run persisted)."""
+        m = getattr(self, "_adapt", None)
+        if m is None:
+            m = self._adapt = {}
+            self._adapt_refresh()
+        return m
+
+    def _adapt_refresh(self) -> None:
+        """Re-read the durable ADT table into the local map — recovery
+        paths call this so replayed pushes route exactly as the adapted
+        run did (an engine-local map alone would forget records written
+        before a simulated kill)."""
+        m = self._adapt_map()
+        try:
+            m.update(dict(self.store.titems("ADT")))
+        except Exception as e:  # a served store mid-failover: keep local
+            # view; the next recovery path re-reads, so note, don't wedge
+            obs.RECORDER.record("adapt", "refresh-deferred", err=repr(e))
+
+    def _adapt_consider(self, edge: Tuple[int, int], src_channels: int,
+                        n_tgt: int) -> None:
+        """Evaluate the skew trigger for one eligible build edge; on fire,
+        persist the (build, probe) ADT records BEFORE any batch ships under
+        the new routing, then install them locally."""
+        hist = self._adapt_rows.get(edge, {})
+        fat = adapt_mod.skewed_channel(hist, n_tgt,
+                                       opstats.skew_ratio_threshold())
+        if fat is None:
+            return
+        src, tgt = edge
+        probe = self.g.adapt_edges[edge]["probe_src"]
+        probe_edge = (probe, tgt)
+        # safety net on top of build-before-probe stage gating: replicating
+        # the fat probe partition is only exactly-once if NO probe batch
+        # shipped under the old routing
+        if any(a == probe for (a, _ch) in self._push_seqs):
+            del self.g.adapt_edges[edge]  # too late for this run
+            return
+        tinfo = self.store.tget("PFT", probe_edge)
+        if tinfo is None or not isinstance(tinfo.partitioner,
+                                           HashPartitioner):
+            del self.g.adapt_edges[edge]
+            return
+        from_seq = {ch: self._push_seqs.get((src, ch), -1) + 1
+                    for ch in range(src_channels)}
+        build_rec, probe_rec = adapt_mod.build_records(fat, from_seq)
+        with self.store.transaction():
+            self.store.tset("ADT", edge, build_rec)
+            self.store.tset("ADT", probe_edge, probe_rec)
+        m = self._adapt_map()
+        m[edge] = build_rec
+        m[probe_edge] = probe_rec
+        total = sum(hist.values())
+        mean = total / max(n_tgt, 1)
+        opstats.OPSTATS.note_adaptation(
+            getattr(self.g, "query_id", None),
+            {"kind": "adapt_runtime", "edge": f"a{src}->a{tgt}",
+             "fat_channel": int(fat), "fat_rows": int(hist.get(fat, 0)),
+             "mean_rows": round(mean), "total_rows": int(total),
+             "ratio": round(hist.get(fat, 0) / mean, 2) if mean else None,
+             "action": f"salt build partition {fat} across {n_tgt} "
+                       f"channels, replicate probe partition {fat}"})
+        obs.RECORDER.record("adapt", f"a{src}->a{tgt}", fat=int(fat),
+                            total_rows=int(total))
+        obs.REGISTRY.counter("adapt.fired").inc()
+
     # -- push (core.py:276-376) ---------------------------------------------
     def push(self, actor: int, channel: int, seq: int, batch: DeviceBatch) -> None:
         _note_out(seq)  # producer side of a critical-path data edge
@@ -649,10 +752,11 @@ class Engine:
         # the sync scope carries this engine's once-resolved per-query
         # counter, so a split blocking inside the partition fn attributes to
         # THIS query even when neighbors dispatch concurrently
+        adapt_edges = getattr(self.g, "adapt_edges", None) or {}
         with kernels.shuffle_sync_scope(self._shuffle_syncs_q):
             for tgt_actor in info.targets:
                 fn = self._partition_fn(actor, tgt_actor)
-                parts = fn(batch, channel)
+                parts = fn(batch, channel, seq)
                 if stream_wm is not None:
                     for part in parts.values():
                         part._stream_wm = stream_wm
@@ -664,6 +768,16 @@ class Engine:
                     self._shuffle_bytes.inc(nb)
                     if self._shuffle_bytes_q is not None:
                         self._shuffle_bytes_q.inc(nb)
+                # skew-trigger accounting, only while an eligible build
+                # edge is still unadapted (and only on the embedded engine
+                # — the distributed Worker lacks the serial-order guarantee
+                # the trigger's determinism rides on)
+                edge = (actor, tgt_actor)
+                track = None
+                if (edge in adapt_edges and config.adapt_enabled()
+                        and hasattr(self, "_adapt_rows")
+                        and edge not in self._adapt_map()):
+                    track = self._adapt_rows.setdefault(edge, {})
                 qid = getattr(self.g, "query_id", None)
                 for tgt_ch, part in parts.items():
                     # delivered rows per (edge, target channel): the skew
@@ -674,6 +788,14 @@ class Engine:
                         qid, actor, tgt_actor, tgt_ch,
                         part.nrows if part.nrows is not None
                         else part.nrows_dev)
+                    if track is not None:
+                        # the trigger's histogram may block on the tiny
+                        # count scalar — a kernel-queue wait on an already-
+                        # dispatched reduction, not a shuffle host sync
+                        n = (part.nrows if part.nrows is not None
+                             else int(part.nrows_dev)
+                             if part.nrows_dev is not None else 0)
+                        track[tgt_ch] = track.get(tgt_ch, 0) + int(n)
                     name = (actor, channel, seq, tgt_actor, actor, tgt_ch)
                     if self.g.hbq is not None:
                         # spill post-partition (core.py:311-313): replayable
@@ -683,6 +805,13 @@ class Engine:
                         # boundaries flush it (_flush_spills).
                         self._spill_submit(name, part)
                     self._cache_put(name, part)
+                if track is not None:
+                    self._push_seqs[(actor, channel)] = seq
+                    self._adapt_consider(
+                        edge, info.channels,
+                        self.g.actors[tgt_actor].channels)
+        if hasattr(self, "_push_seqs"):
+            self._push_seqs[(actor, channel)] = seq
 
     # -- async HBQ spill ------------------------------------------------------
     # The HBQ write used to sit synchronously inside push: a full d2h sync +
@@ -1339,6 +1468,8 @@ class Engine:
         `choice` = (state_seq, out_seq, tape_pos) from the rewind planner;
         None restores the latest checkpoint."""
         info = self.g.actors[a]
+        # replayed pushes must honor adaptations recorded before the loss
+        self._adapt_refresh()
         self.store.tdel("DST", (a, ch))
         self.store.ntt_remove_channel(a, ch)
         if info.kind == "input":
@@ -1421,7 +1552,10 @@ class Engine:
             # exactly the live input path: source predicate BEFORE push
             # (handle_input_task), else the recomputed object gains rows
             batch = info.predicate(batch)
-        parts = self._partition_fn(src_a, tgt_a)(batch, src_ch)
+        # seq-aware: an adapted edge (ADT) routes this historical sequence
+        # exactly as the original push did
+        self._adapt_refresh()
+        parts = self._partition_fn(src_a, tgt_a)(batch, src_ch, seq)
         return parts.get(tgt_ch)
 
     def _resolve_lost_object(self, name: Tuple):
@@ -1478,6 +1612,7 @@ class Engine:
         partial output rather than duplicating it."""
         a, ch = task.actor, task.channel
         self._flush_spills()  # tape inputs probe the HBQ listing below
+        self._adapt_refresh()  # replay emissions route per recorded ADT
         reqs = {s: dict(c) for s, c in task.input_reqs.items()}
         tape = self.store.tape_slice(a, ch, task.tape_pos)
 
@@ -1490,10 +1625,14 @@ class Engine:
             rewound = self._maybe_force_producer_rewind(name)
             # time-based, not attempt-based: the co-dead producer's own
             # replay (possibly from state 0 with a long tape) can
-            # legitimately take minutes to regenerate this object
+            # legitimately take minutes to regenerate this object.  The
+            # bound is QK_REPLAY_DEADLINE: a genuinely irrecoverable loss
+            # used to wedge the full 600s under load (the ROADMAP
+            # test_distributed note) with no way to shorten the verdict
             deadline = getattr(task, "retry_deadline", None)
             if deadline is None:
-                deadline = task.retry_deadline = time.time() + 600.0
+                deadline = task.retry_deadline = (
+                    time.time() + config.replay_retry_deadline_s())
             if os.environ.get("QUOKKA_DEBUG_REPLAY"):
                 now = time.time()
                 if now - getattr(task, "_dbg_at", 0) > 3.0:
@@ -1504,8 +1643,10 @@ class Engine:
             if time.time() > deadline:
                 raise RuntimeError(
                     f"tape input {name} for channel ({a},{ch}) is in "
-                    "no live HBQ and its producer never regenerated "
-                    "it within 600s — irrecoverable loss"
+                    "no live HBQ and its producer never regenerated it "
+                    f"within QK_REPLAY_DEADLINE="
+                    f"{config.replay_retry_deadline_s():g}s — "
+                    "irrecoverable loss"
                 )
             self.store.ntt_push(a, task)
             time.sleep(0.05)
@@ -1798,13 +1939,15 @@ class Engine:
             rewound |= self._maybe_force_producer_rewind(name)
         deadline = getattr(task, "retry_deadline", None)
         if deadline is None:
-            deadline = task.retry_deadline = time.time() + 600.0
+            deadline = task.retry_deadline = (
+                time.time() + config.replay_retry_deadline_s())
         if time.time() > deadline:
             raise RuntimeError(
                 f"replay objects {missing[:3]}{'...' if len(missing) > 3 else ''} "
                 f"for channel ({task.actor},{task.channel}) survive in no "
-                "cache or HBQ and were never regenerated within 600s — "
-                "irrecoverable loss"
+                "cache or HBQ and were never regenerated within "
+                f"QK_REPLAY_DEADLINE={config.replay_retry_deadline_s():g}s "
+                "— irrecoverable loss"
             )
         task.replay_specs = missing
         self.store.ntt_push(task.actor, task)

@@ -61,6 +61,11 @@ trips protocol rule QK014 (dead write).
        [W] exec consume  [R] producer throttle (overwrite, bounded)
   SWM/SWMC/SST stream watermarks + stop flags: SWM is per-seq
        [W] push  [R] replay  [GC] manifest.gc; SWMC/SST overwrite, bounded
+  ADT  adaptive-exchange records (planner/adapt.py): (src actor, tgt
+       actor) -> {mode, fat, from_seq} routing rewrite, written BEFORE the
+       first rerouted batch ships so replay is deterministic
+       [W] engine skew trigger  [R] partition fns + recovery refresh
+       (overwrite, bounded by graph edge count)
 """
 
 from __future__ import annotations
@@ -78,6 +83,8 @@ TABLE_NAMES = (
     # per-(actor, ch) watermark high-water mark; SST = stop flags of
     # standing-query source actors (StreamingHandle.stop)
     "SWM", "SWMC", "SST",
+    # adaptive exchanges (planner/adapt.py): durable routing rewrites
+    "ADT",
 )
 
 

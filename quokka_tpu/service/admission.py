@@ -72,18 +72,31 @@ def estimate_working_set(graph) -> int:
     preference: measured source cardinalities (obs/opstats.py cardprofile)
     — actual bytes the plan's scans produced last run, scaled for pipeline
     overhead but NOT floored to MIN_ESTIMATE_BYTES (measured-small stays
-    small).  Fresh plans fall back to reader size hints (readers.py
-    ``size_hint``), floored and scaled for decode/pipeline overhead."""
+    small).  A NEW plan over already-profiled scans still gets measured
+    treatment via per-source signatures (planner/cost.py identity) when
+    every one of its sources has been measured under some prior plan.
+    Only then do reader size hints (readers.py ``size_hint``) apply,
+    floored and scaled for decode/pipeline overhead."""
+    from quokka_tpu.obs import memplane, opstats
+
     fp = getattr(graph, "plan_fp", None)
     if fp:
-        from quokka_tpu.obs import memplane, opstats
-
         measured = memplane.measured_footprint(fp)
         if measured:
             return max(int(measured), 1 << 20)
         src_bytes = opstats.measured_source_bytes(fp)
         if src_bytes:
             return max(int(src_bytes * PIPELINE_OVERHEAD), 1 << 20)
+    sigs = [getattr(info, "src_sig", None)
+            for info in graph.actors.values() if info.kind == "input"]
+    if sigs and all(sigs):
+        by_sig = opstats.measured_sources()
+        vals = [by_sig.get(s, {}).get("bytes") for s in sigs]
+        if all(isinstance(v, (int, float)) and v > 0 for v in vals):
+            # all sources measured (under whatever plan): charge actuals;
+            # partial coverage falls through — mixing measured and hinted
+            # sources would understate the unmeasured ones
+            return max(int(sum(vals) * PIPELINE_OVERHEAD), 1 << 20)
     total = 0
     for info in graph.actors.values():
         if info.kind != "input" or info.reader is None:
