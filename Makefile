@@ -4,7 +4,7 @@
 PY ?= python
 export JAX_PLATFORMS ?= cpu
 
-.PHONY: lint lint-baseline verify-static plan-fuzz test test-slow sanitize-demo service-smoke chaos-smoke obs-smoke bench-check bench-trend shuffle-smoke fusion-smoke warmup-smoke multichip-smoke stream-smoke mem-smoke explain-smoke health-smoke adapt-smoke
+.PHONY: lint lint-baseline verify-static plan-fuzz test test-slow sanitize-demo service-smoke chaos-smoke obs-smoke bench-check bench-trend shuffle-smoke fusion-smoke warmup-smoke multichip-smoke stream-smoke mem-smoke explain-smoke health-smoke adapt-smoke resume-smoke durability-smoke
 
 # engine-invariant static analysis; exits nonzero on findings beyond the
 # checked-in baseline (quokka_tpu/analysis/baseline.json)
@@ -152,6 +152,20 @@ adapt-smoke:
 # and an exact replay command.  Bounded for the 1-core CI box (~1 min).
 chaos-smoke:
 	QK_COORD_TIMEOUT=240 $(PY) -m quokka_tpu.chaos.soak --runs 20
+
+# durable-batch smoke: two TPC-H-shaped durable queries SIGKILLed mid-run
+# in a child service process; a fresh supervisor must re-admit both from
+# their crash-consistent resume manifests and finish BIT-EXACT vs the
+# undisturbed run with BOUNDED replay (checkpointed frontiers honored,
+# skipped input segments > 0), zero added host syncs, zero admission-byte
+# or manifest residue
+resume-smoke:
+	QK_COORD_TIMEOUT=240 $(PY) -m quokka_tpu.service.resume_smoke
+
+# the durability aggregate: every process-death story in one command —
+# batch resume, streaming resume, and the full chaos soak (whose cycle
+# includes the batch-resume-under-corruption mode)
+durability-smoke: resume-smoke stream-smoke chaos-smoke
 
 # health-plane smoke: two service queries polled live — progress must run
 # monotone 0->1 (cold on the size_hint basis, warm on the measured

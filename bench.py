@@ -450,6 +450,78 @@ def measure_service(paths, smoke=False):
                 "per_query": lat_detail,
             },
         })
+    # mixed-load line: the same 2-way workload with one CANCELLED and one
+    # DEADLINE-EXCEEDED query in the mix.  Both casualties carry oversized
+    # working-set declarations so they wait QUEUED behind the running
+    # normals — the cancel and the deadline land deterministically at the
+    # admission queue, never racing a finish — and first-class cancellation
+    # must cost the surviving queries nothing: the line of record is the
+    # mixed-run aggregate qps over the plain 2-way run's.
+    from quokka_tpu.service import DeadlineExceeded, QueryCancelled
+
+    ways = ways_list[0]
+    # the byte budget is what pins the casualties: 1 PiB declarations can
+    # never admit under 4 GiB, no matter how fast the normals drain
+    svc = QueryService(pool_size=ways, max_concurrent=ways,
+                       inflight_per_query=2, mem_budget=4 << 30,
+                       admit_timeout=float(MEASURE_TIMEOUT),
+                       query_timeout=float(MEASURE_TIMEOUT))
+    try:
+        t0 = time.time()
+        handles = []
+        for _stream in range(ways):
+            for name in qnames:
+                handles.append((name, svc.submit(BUILDERS[name](paths))))
+        victim = svc.submit(BUILDERS[qnames[0]](paths),
+                            working_set_bytes=1 << 50)
+        # the deadline must expire while the normals still hold the pool
+        # (the queued-reaper path) — generous values race a warm cache's
+        # fast drain, after which an oversized query may legally run alone
+        expired = svc.submit(BUILDERS[qnames[0]](paths),
+                             working_set_bytes=1 << 50, deadline_s=0.02)
+        victim.cancel(wait=False)
+        for name, h in handles:
+            h.result(timeout=MEASURE_TIMEOUT)
+        wall = time.time() - t0
+        try:
+            victim.result(timeout=60)
+            raise RuntimeError("bench --service mixed load: the cancelled "
+                               "query returned a result")
+        except QueryCancelled:
+            pass
+        try:
+            expired.result(timeout=60)
+            raise RuntimeError("bench --service mixed load: the deadline "
+                               "query returned a result")
+        except DeadlineExceeded:
+            pass
+        leaked = svc.admission.stats()["used_bytes"]
+        if leaked:
+            raise RuntimeError(
+                f"bench --service mixed load: {leaked} admission bytes "
+                "still held after cancel/deadline/finish")
+    finally:
+        svc.shutdown()
+    n_queries = ways * len(qnames)
+    mixed_qps = n_queries / wall if wall > 0 else 0.0
+    plain_qps = lines[0]["detail"]["aggregate_qps"]
+    lines.append({
+        "metric": "service_mixed_load_throughput_ratio",
+        "value": round(mixed_qps / plain_qps if plain_qps else 0.0, 4),
+        "unit": "x",
+        "vs_baseline": round(mixed_qps / plain_qps if plain_qps else 0.0, 4),
+        "detail": {
+            "sf": SF,
+            "ways": ways,
+            "queries": n_queries,
+            "wall_s": round(wall, 4),
+            "mixed_qps": round(mixed_qps, 4),
+            "plain_qps": plain_qps,
+            "cancelled": 1,
+            "deadline_exceeded": 1,
+            "admission_bytes_leaked": 0,
+        },
+    })
     for ln in lines:
         print(json.dumps(ln))
     geomean = math.exp(sum(math.log(max(s, 1e-9)) for s in speedups)
@@ -1025,6 +1097,7 @@ CHECK_THRESHOLDS = {
     "tpch_q1_scan_gbps_per_chip": 0.30,
     "tick_asof_rows_per_s_per_chip": 0.30,
     "service_aggregate_speedup_geomean": 0.30,
+    "service_mixed_load_throughput_ratio": 0.30,
     # multichip scaling efficiency: forced-host runs share one core pool,
     # so the ratio is noisier than the single-device walls
     "multichip_scaling_efficiency_geomean": 0.40,

@@ -35,6 +35,14 @@ Zero-baseline rules (no suppression file — a violation fails tier-1):
   time key, an UNBOUNDED source keeps the single-channel streaming
   discipline, and no checkpoint-barrier member hides inside a fused stage
   (a fused stage checkpoints as ONE unit).
+- **QK025 resume-fingerprint restart-stability** — the structural
+  fingerprint ``runtime/resume.py`` verifies at batch resume must be
+  IDENTICAL when the same prepared plan is pickled (the manifest's plan
+  payload) and re-lowered into a fresh context and control store — the
+  exact round trip ``QueryService.recover_orphans`` performs after a crash
+  — and its preimage must be free of object addresses and size-dependent
+  buckets (a source file may grow between restarts).  Checked over live
+  lowerings in the CLI corpus run, not statically.
 - **QK026 adaptive-exchange legality** — ``adapt_salt`` (the mark that lets
   the runtime re-partition a skewed build exchange mid-query,
   planner/decide.py) sits only where the salt+replicate rewrite provably
@@ -75,6 +83,9 @@ RULES = {
     "QK023": "fusion legality: fusible members + exact unfuse round-trip",
     "QK024": "streaming legality: monotone order metadata, 1-channel "
              "unbounded sources, no checkpoint barrier inside a stage",
+    "QK025": "resume-fingerprint restart-stability: a durable batch "
+             "plan's structural fingerprint survives pickle + fresh-"
+             "process re-lowering, address- and size-hint-free",
     "QK026": "adaptive-exchange legality: adapt_salt only on inner "
              "non-broadcast unordered joins; salt column reserved",
 }
@@ -607,6 +618,77 @@ def check_corpus(progress=None) -> List[Tuple[str, PlanInvariantError]]:
     return failures
 
 
+def check_resume_fingerprints(progress=None) -> List[Tuple[str, str]]:
+    """QK025, run over live lowerings: for each shape, prepare the plan,
+    pickle it exactly like ``QueryService.submit(durable=True)`` does,
+    then unpickle + lower TWICE into fresh contexts/stores (two simulated
+    process restarts).  Both fingerprints must equal each other AND the
+    original submit-side lowering's, and every preimage part must be free
+    of memory addresses.  Returns (name, problem) failures."""
+    import pickle
+
+    import numpy as np
+    import pyarrow as pa
+
+    from quokka_tpu.context import QuokkaContext
+    from quokka_tpu.runtime import resume as bresume
+    from quokka_tpu.runtime.engine import TaskGraph
+    from quokka_tpu.runtime.tables import ControlStore
+
+    r = np.random.default_rng(7)
+    n = 256
+    fact = pa.table({
+        "k": r.integers(0, 6, n).astype(np.int64),
+        "v": r.integers(0, 100, n).astype(np.float64),
+    })
+    dim = pa.table({
+        "k": np.arange(6, dtype=np.int64),
+        "w": r.integers(0, 10, 6).astype(np.int64),
+    })
+    shapes = [
+        ("agg", lambda qc: qc.from_arrow(fact)
+            .groupby("k").agg_sql("sum(v) as s, count(*) as n")),
+        ("join_agg", lambda qc: qc.from_arrow(fact)
+            .join(qc.from_arrow(dim), on="k")
+            .groupby("w").agg_sql("sum(v) as s")),
+        ("filter_proj", lambda qc: qc.from_arrow(fact)
+            .filter_sql("v > 10").select(["k"])),
+    ]
+    failures: List[Tuple[str, str]] = []
+    for name, build in shapes:
+        qc = QuokkaContext()
+        ds = build(qc)
+        sub, sink_id = qc._prepare_plan(ds.node_id)
+        blob = pickle.dumps({"sub": sub, "sink_id": sink_id,
+                             "exec_channels": qc.exec_channels})
+        g0 = TaskGraph(qc.exec_config, store=ControlStore())
+        qc._lower_plan(sub, sink_id, g0)
+        fps, parts = [], []
+        for _restart in range(2):
+            payload = pickle.loads(blob)
+            ctx = QuokkaContext()
+            ctx.exec_channels = payload.get("exec_channels",
+                                            ctx.exec_channels)
+            g = TaskGraph(ctx.exec_config, store=ControlStore())
+            ctx._lower_plan(payload["sub"], payload["sink_id"], g)
+            fps.append(bresume.structural_fingerprint(g))
+            parts.append(bresume.structural_parts(g))
+        if len({bresume.structural_fingerprint(g0), *fps}) != 1:
+            failures.append((name, f"fingerprint drifted across simulated "
+                                   f"restarts: submit="
+                                   f"{bresume.structural_fingerprint(g0)} "
+                                   f"relowered={fps}"))
+        addressed = [p for p in parts[0] if "0x" in p]
+        if addressed:
+            failures.append((name, "fingerprint preimage contains object "
+                                   f"addresses: {addressed}"))
+        if progress is not None:
+            status = ("FAIL" if failures and failures[-1][0] == name
+                      else "ok")
+            progress(f"  resume-fp {name:<12} {status}")
+    return failures
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m quokka_tpu.analysis.planck",
@@ -627,6 +709,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
           f"last plan {VERIFY_STATS['ms_last_plan']:.2f} ms)")
     for name, e in failures:
         print(f"FAIL {name}:\n{e}")
+
+    fp_failures = check_resume_fingerprints(
+        progress=print if args.verbose else None)
+    print(f"planck: resume fingerprints (QK025) "
+          f"{3 - len({n for n, _ in fp_failures})}/3 shapes restart-stable")
+    for name, problem in fp_failures:
+        print(f"FAIL resume-fp {name}: {problem}")
+    failures = failures + fp_failures
 
     if args.seeds:
         from quokka_tpu.analysis import planfuzz

@@ -28,6 +28,12 @@ Fault modes (cycled; ``--runs 20`` covers every mode at least twice):
                ADT routing and stay bit-exact
   distributed  2 spawned workers; RPC drops/delays + flaky store calls +
                a chaos SIGKILL of a random worker at an input boundary
+  batch-resume a child service running two durable batch queries is
+               SIGKILLed mid-query under corrupt_ckpt=1.0 +
+               corrupt_spill=0.3; the restarted supervisor resumes both
+               from their manifests — every checkpoint restore must
+               detect the corruption and fall back (ultimately to input
+               lineage re-reads), and both results stay bit-exact
 
 Every injected fault and every recovery action is a flight-recorder event
 (``chaos.*``, ``integrity.corrupt``, ``recover.*``, ``rpc.retry``,
@@ -374,6 +380,27 @@ def _mode_stream(seed, spec, tabs, base):
             svc.shutdown()
 
 
+def _spec_batch_resume(seed):
+    # EVERY checkpoint write corrupt (restore MUST detect, quarantine and
+    # fall back regardless of seed) + 30% of spills corrupt (the resume's
+    # spill verification and the replay's lineage-recompute fallback both
+    # get exercised); the spec reaches the child service via QK_CHAOS
+    return f"seed={seed},corrupt_ckpt=1.0,corrupt_spill=0.3"
+
+
+def _mode_batch_resume(seed, spec, tabs, base):
+    """The resume-smoke harness under a corruption storm: the child service
+    (inheriting QK_CHAOS) corrupts every checkpoint and 30% of spills it
+    writes before the SIGKILL lands; the parent-side supervisor resume then
+    has to detect all of it — quarantined snapshots fall back toward state
+    0, broken spills recompute from frozen input lineage — and still
+    deliver both queries bit-exact vs the undisturbed one-shot runs."""
+    from quokka_tpu.service import resume_smoke
+
+    with _chaos(spec), tempfile.TemporaryDirectory() as d:
+        resume_smoke.run(d, seed, log=lambda *a, **k: None)
+
+
 def _spec_distributed(seed):
     return (f"seed={seed},rpc=0.03,delay=0.05,store=0.05,"
             f"kill=1,kill_after={6 + seed % 6}")
@@ -402,13 +429,13 @@ MODES = [
     ("adapt-kill", _spec_adapt, _mode_adapt_kill, False),
     ("spill-storm-join", _spec_storm, _mode_spill_storm_join, True),
     ("ckpt-storm", _spec_ckpt_storm, _mode_ckpt_storm, True),
-    # the stream and adapt-kill modes take two of the three "mixed" slots
+    # the stream, adapt-kill and batch-resume modes REPLACE existing slots
     # rather than growing the cycle: inserting an 11th entry would shift
     # every later run's (mode, seed) pairing, and the storm modes'
     # detection assertions are only validated for the seeds they get
     ("stream", _spec_stream, _mode_stream, False),
     ("distributed", _spec_distributed, _mode_distributed, False),
-    ("spill-storm", _spec_storm, _mode_spill_storm, True),
+    ("batch-resume", _spec_batch_resume, _mode_batch_resume, True),
 ]
 
 

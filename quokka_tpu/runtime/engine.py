@@ -196,12 +196,16 @@ class TaskGraph:
 
                 CheckpointStore(ckpt_root,
                                 namespace=self.query_id).wipe_namespace()
-            manifest = getattr(self, "stream_manifest", None)
-            if manifest:  # a cleanly stopped stream is complete: no resume
-                import contextlib
+            # a cleanly finished query is complete: no resume.  Both
+            # manifest kinds (standing-query stream manifest, durable-batch
+            # resume manifest) only survive via preserve_durable above.
+            import contextlib
 
-                with contextlib.suppress(OSError):
-                    os.remove(manifest)
+            for attr in ("stream_manifest", "resume_manifest"):
+                manifest = getattr(self, attr, None)
+                if manifest:
+                    with contextlib.suppress(OSError):
+                        os.remove(manifest)
         if self.query_id is not None:
             # the one-shot path and the service both land here: a finished
             # query's tables, queues, metrics and cache accounting all GC
@@ -1435,6 +1439,13 @@ class Engine:
             from quokka_tpu.streaming import manifest as _smanifest
 
             _smanifest.update(self.g)
+        # Durable BATCH queries persist the analogous batch resume manifest
+        # at the same cadence (quokka_tpu/runtime/resume.py): the service
+        # supervisor re-admits orphans from it after a process death.
+        elif getattr(self.g, "resume_manifest", None):
+            from quokka_tpu.runtime import resume as _bresume
+
+            _bresume.update(self.g)
 
     def simulate_failure_and_recover(self, failed: List[Tuple[int, int]]) -> None:
         """Kill the given exec (actor, channel) workers — losing executor
@@ -2065,6 +2076,13 @@ class Engine:
         ship result tables to the coordinator.  seq-keyed so fault-tolerant
         replay overwrites, never duplicates."""
         info.blocking_dataset.append(channel, table, seq=seq)
+        if getattr(self.g, "resume_manifest", None):
+            # durable-batch sink floor (monotone: replay re-appends must not
+            # rewind it) — the resume manifest records how far the
+            # client-visible result had materialized
+            cur = self.store.tget("RMT", ("sink", info.id, channel), 0)
+            if seq + 1 > cur:
+                self.store.tset("RMT", ("sink", info.id, channel), seq + 1)
 
     # -- coordinator loop (coordinator.py:106-165) ----------------------------
     # Stage discipline follows the reference exactly: INPUT tasks only run when

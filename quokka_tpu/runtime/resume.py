@@ -1,0 +1,563 @@
+"""Shared resume-manifest layer: what survives a full process death.
+
+The in-process recovery protocol (chaos kills) replays from the control
+store's tapes — but the control store is memory.  Queries survive a
+PROCESS death through the durable trio:
+
+- executor snapshots (CheckpointStore — durable, checksummed, atomic),
+- the HBQ spill (durable when the service runs on a stable ``spill_dir``),
+- and a resume manifest: the plan's structural fingerprint, every
+  checkpointed exec channel's recovery point ``(state_seq, out_seq)`` +
+  checkpoint history + IRT frontier rows, and the sink's emitted floor.
+
+This module is the layer both manifest kinds share (structural
+fingerprinting, integrity-framed load, the exec-channel collect/seed
+surgery) plus the BATCH manifest itself: ``streaming/manifest.py``
+delegates here and adds the stream-only parts (source segment log,
+watermark trail, delivered-floor rewind, lineage GC).
+
+Batch semantics differ from streams in two load-bearing ways:
+
+- the HBQ spill is NOT wiped at resume: batch seq assignment is
+  deterministic (re-lowering re-seeds identical frozen lineages), so the
+  dead incarnation's spill files replay byte-identically — they are the
+  bounded-replay substrate that lets sinks rebuild without recomputing
+  upstream operators;
+- every needed spill is read-VERIFIED at resume time: service-mode
+  engines never force live producer rewinds, so a corrupt/missing
+  exec-produced spill discovered mid-run would wedge the consumer.
+  ``apply_resume`` instead probes the needed ranges up front and rewinds
+  each damaged producer's recovery point to the newest checkpoint that
+  COVERS the first broken output (ultimately ``(0, 0, 0)``), so its live
+  re-execution re-emits the gap.  Corrupt artifacts are loss, never data.
+
+The engine rewrites the manifest atomically (tmp + integrity frame +
+rename) after EVERY successful checkpoint; clean finishes (success,
+cancel, deadline, failure) delete it — only a process death leaves an
+orphan for ``QueryService.recover_orphans()`` to re-admit.
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+import pickle
+import time
+from typing import Dict, List, Optional, Tuple
+
+from quokka_tpu import obs
+from quokka_tpu.runtime import integrity
+from quokka_tpu.runtime.task import ReplayTask, TapedExecutorTask, TapedInputTask
+
+MANIFEST_VERSION = 1
+# manifest-generation journal entries retained in the RMT store table
+# (trimmed drop-and-reappend at the cap: the QK015 GC site for the class)
+_JOURNAL_KEEP = 64
+
+
+class ManifestMismatch(RuntimeError):
+    """The manifest cannot resume this plan (fingerprint mismatch, missing
+    actors, version drift, or an unreadable/incomplete manifest) — loud,
+    never a silent fresh start."""
+
+
+def _exec_desc(factory) -> str:
+    """Stable description of an executor factory: streaming executors expose
+    ``plan_signature()`` (operator config, no object addresses); everything
+    else describes by type."""
+    import functools
+
+    fn = factory
+    parts = []
+    while isinstance(fn, functools.partial):
+        parts.extend(type(a).__name__ for a in fn.args
+                     if not callable(a) or hasattr(a, "plan_signature"))
+        for a in fn.args:
+            sig = getattr(a, "plan_signature", None)
+            if sig is not None:
+                return repr(sig())
+        fn = fn.func
+    name = getattr(fn, "__name__", type(fn).__name__)
+    return "/".join([name] + parts)
+
+
+def structural_parts(graph) -> List[str]:
+    """The fingerprint preimage, one part per actor: topology + operator
+    configuration only — no reader size buckets (a source file may grow
+    between restarts), no object reprs or addresses.  Exposed separately so
+    the plan-invariant verifier (analysis/planck.py QK025) can assert the
+    preimage stays restart-stable and address-free."""
+    parts = []
+    for aid in sorted(graph.actors):
+        info = graph.actors[aid]
+        desc = [str(aid), info.kind, str(info.channels), str(info.stage)]
+        if info.reader is not None:
+            desc.append(type(info.reader).__name__)
+        if info.executor_factory is not None:
+            desc.append(_exec_desc(info.executor_factory))
+        desc.append(",".join(
+            f"{stream}:{src}"
+            for src, stream in sorted(info.source_streams.items())))
+        parts.append("|".join(desc))
+    return parts
+
+
+def structural_fingerprint(graph) -> str:
+    """Structural fingerprint for resume verification.  Unlike the compile
+    plane's ``plan_fingerprint`` it must be stable across process restarts
+    of the SAME query — just topology + operator configuration."""
+    import hashlib
+
+    return hashlib.sha256(
+        ";".join(structural_parts(graph)).encode()).hexdigest()[:16]
+
+
+def manifest_root(graph) -> str:
+    """Where this graph's manifest lives: the checkpoint root, falling back
+    to the spill-side checkpoint dir for remote (``://``) stores."""
+    root = graph.exec_config.get("checkpoint_store") or graph.ckpt_dir
+    if root is None or "://" in str(root):
+        root = graph.ckpt_dir or "."
+    return root
+
+
+def default_path(graph) -> str:
+    return os.path.join(manifest_root(graph),
+                        f"batch-{graph.query_id}.manifest")
+
+
+def load_framed(path: str, err=None) -> Dict:
+    """Read and verify an integrity-framed manifest; loud on corruption or
+    version drift — resume is an explicit operator request, never a
+    best-effort guess."""
+    err = err or ManifestMismatch
+    try:
+        m = pickle.loads(integrity.read_framed(path))
+    except (OSError, pickle.UnpicklingError,
+            integrity.CorruptArtifactError) as e:
+        raise err(f"resume manifest {path} unreadable: {e!r}") from e
+    if m.get("version") != MANIFEST_VERSION:
+        raise err(
+            f"resume manifest {path} has version {m.get('version')}, "
+            f"this build expects {MANIFEST_VERSION}")
+    return m
+
+
+def load(path: str) -> Dict:
+    m = load_framed(path)
+    if m.get("kind", "stream") != "batch":
+        raise ManifestMismatch(
+            f"{path} is a {m.get('kind', 'stream')!r} manifest — batch "
+            "resume needs a batch manifest (streams resume through "
+            "submit_continuous)")
+    return m
+
+
+def collect_exec_channels(graph, with_tape: bool = False
+                          ) -> Dict[Tuple[int, int], Dict]:
+    """Every checkpointed exec channel's durable recovery state: the LCT
+    recovery point, the full checkpoint history, and the IRT frontier rows
+    for each recorded state (plus state 0, the full-replay fallback).
+    ``with_tape`` additionally captures the channel's event tape (small
+    host tuples) so a BATCH resume can fall back from a corrupt snapshot
+    to an older checkpoint + tape replay, exactly like in-process
+    recovery; streams skip it (their manifest carries source segments and
+    re-bases instead).  Shared by the stream and batch manifest writers —
+    call inside the caller's store transaction."""
+    store = graph.store
+    execs: Dict[Tuple[int, int], Dict] = {}
+    for info in graph.actors.values():
+        if info.kind != "exec":
+            continue
+        for ch in range(info.channels):
+            lct = store.tget("LCT", (info.id, ch))
+            if lct is None:
+                continue
+            irts = {}
+            for hist in [(0, 0, 0)] + [
+                    tuple(h) for h in
+                    (store.tget("LT", ("ckpts", info.id, ch)) or [])]:
+                reqs = store.tget("IRT", (info.id, ch, hist[0]))
+                if reqs is not None:
+                    irts[hist[0]] = {a: dict(c) for a, c in reqs.items()}
+            execs[(info.id, ch)] = {
+                "lct": tuple(lct),
+                "ckpts": [tuple(h) for h in
+                          (store.tget("LT", ("ckpts", info.id, ch))
+                           or [])],
+                "irts": irts,
+            }
+            if with_tape:
+                execs[(info.id, ch)]["tape"] = list(
+                    store.tget("LT", ("tape", info.id, ch)) or [])
+                execs[(info.id, ch)]["tape_base"] = store.tget(
+                    "LT", ("tape_base", info.id, ch), 0)
+    return execs
+
+
+def seed_exec_channel(store, a: int, ch: int, e: Dict,
+                      ckpts: Optional[List[Tuple]] = None) -> Tuple[int, int]:
+    """Restart surgery for one checkpointed exec channel on a fresh store:
+    re-base the recovery point and checkpoint history to tape position 0
+    (the dead process's tape is gone), restore the IRT frontier rows, seed
+    the producer-throttle watermarks (EWT = consumed-1: a fresh store's -1
+    would deadlock any source whose checkpointed frontier is past the
+    pipeline cap), and queue the empty-tape replay task that restores the
+    snapshot then goes live.  Returns the restored (state_seq, out_seq)."""
+    state_seq, out_seq, _old_tape = e["lct"]
+    reqs = {s: dict(c)
+            for s, c in e["irts"].get(state_seq, {}).items()}
+    hist = e["ckpts"] if ckpts is None else ckpts
+    with store.transaction():
+        store.tset("LCT", (a, ch), (state_seq, out_seq, 0))
+        for h in hist:
+            store.tappend("LT", ("ckpts", a, ch), (h[0], h[1], 0))
+        for s, r in e["irts"].items():
+            store.tset("IRT", (a, ch, s),
+                       {src: dict(c) for src, c in r.items()})
+        for src, chans in reqs.items():
+            for sch, nxt in chans.items():
+                store.tset("EWT", (src, sch, a, ch), nxt - 1)
+    store.ntt_push(a, TapedExecutorTask(
+        a, ch, state_seq, out_seq, state_seq, copy.deepcopy(reqs), 0))
+    return state_seq, out_seq
+
+
+def seed_exec_channel_taped(store, a: int, ch: int, e: Dict,
+                            lct: Optional[Tuple] = None,
+                            ckpts: Optional[List[Tuple]] = None
+                            ) -> Tuple[int, int]:
+    """Batch restart surgery for one checkpointed exec channel: the batch
+    manifest carries the channel's event tape, so everything keeps its
+    ORIGINAL tape coordinates — a corrupt snapshot discovered at restore
+    time can then fall back through the seeded checkpoint history
+    (``_ckpt_fallback``, ultimately state 0 + full tape replay) exactly
+    like in-process recovery.  The queued replay targets the END of the
+    recorded tape: events past the chosen checkpoint re-run from replayed
+    inputs, recovering progress made between the checkpoint and the
+    manifest write.  Returns the chosen (state_seq, out_seq)."""
+    state_seq, out_seq, tape_pos = tuple(lct if lct is not None
+                                         else e["lct"])
+    reqs = {s: dict(c)
+            for s, c in e["irts"].get(state_seq, {}).items()}
+    hist = e["ckpts"] if ckpts is None else ckpts
+    with store.transaction():
+        store.tset("LCT", (a, ch), (state_seq, out_seq, tape_pos))
+        store.tset("LT", ("tape", a, ch), list(e.get("tape") or []))
+        store.tset("LT", ("tape_base", a, ch), e.get("tape_base", 0))
+        for h in hist:
+            store.tappend("LT", ("ckpts", a, ch), tuple(h))
+        for s, r in e["irts"].items():
+            store.tset("IRT", (a, ch, s),
+                       {src: dict(c) for src, c in r.items()})
+        for src, chans in reqs.items():
+            for sch, nxt in chans.items():
+                store.tset("EWT", (src, sch, a, ch), nxt - 1)
+    n_exec = sum(1 for ev in store.tape_slice(a, ch, tape_pos)
+                 if ev[0] == "exec")
+    store.ntt_push(a, TapedExecutorTask(
+        a, ch, state_seq, out_seq, state_seq + n_exec,
+        copy.deepcopy(reqs), tape_pos))
+    return state_seq, out_seq
+
+
+# -- batch manifest writer -----------------------------------------------------
+
+def update(graph) -> None:
+    """Write the current batch resume point; called by the engine after each
+    successful checkpoint (and once at durable submit, so a crash before the
+    first checkpoint still re-admits as a fresh run).  A failed write is a
+    SKIPPED manifest (the previous one stays valid), never a dead query."""
+    path = getattr(graph, "resume_manifest", None)
+    if not path:
+        return
+    store = graph.store
+    m: Dict = {
+        "version": MANIFEST_VERSION,
+        "kind": "batch",
+        "query_id": graph.query_id,
+        "plan_fp": structural_fingerprint(graph),
+        "written_at": time.time(),
+        "execs": {},
+        "sinks": {},
+        "est_bytes": getattr(graph, "resume_est_bytes", None),
+        "plan_blob": getattr(graph, "resume_plan_blob", None),
+    }
+    with store.transaction():
+        m["execs"] = collect_exec_channels(graph, with_tape=True)
+        for info in graph.actors.values():
+            if info.blocking_dataset is None:
+                continue
+            for ch in range(info.channels):
+                floor = store.tget("RMT", ("sink", info.id, ch))
+                if floor is not None:
+                    m["sinks"][(info.id, ch)] = floor
+    # manifest-generation journal (RMT("hist",)): /status surfaces the write
+    # count per durable query; trimmed drop-and-reappend at the cap so the
+    # row class has its in-run GC site (protocol rule QK015)
+    top = max((e["lct"][0] for e in m["execs"].values()), default=0)
+    journal = list(store.tget("RMT", ("hist",)) or [])
+    if len(journal) >= _JOURNAL_KEEP:
+        with store.transaction():
+            store.tdel("RMT", ("hist",))
+            for entry in journal[-(_JOURNAL_KEEP // 2):]:
+                store.tappend("RMT", ("hist",), entry)
+            store.tappend("RMT", ("hist",), (top, m["written_at"]))
+    else:
+        store.tappend("RMT", ("hist",), (top, m["written_at"]))
+    try:
+        # the manifest is the recovery ROOT, not a checkpoint artifact: it
+        # gets its own chaos site so ckpt-corruption storms prove restore
+        # fallback rather than trivially erasing the thing being resumed
+        # (a corrupted/unreadable manifest is the startup janitor's case)
+        integrity.write_framed_atomic(path, pickle.dumps(m), site="manifest")
+    except OSError as e:
+        obs.REGISTRY.counter("resume.manifest_skipped").inc()
+        obs.diag(f"[resume] manifest write to {path} skipped: {e!r}")
+    # NO lineage GC here (unlike the stream manifest): the batch recovery
+    # contract keeps full lineage because it includes the (0,0,0)
+    # full-replay fallback — and a batch query's store dies with the query
+
+
+# -- supervisor-side directory scan + janitor ----------------------------------
+
+def scan(manifest_dir: str) -> List[str]:
+    """Batch manifests in a directory, oldest-written first (recovery
+    re-admits in that order: FIFO through normal admission, no barging)."""
+    try:
+        names = sorted(n for n in os.listdir(manifest_dir)
+                       if n.startswith("batch-") and n.endswith(".manifest"))
+    except OSError:
+        return []
+    paths = [os.path.join(manifest_dir, n) for n in names]
+    paths.sort(key=lambda p: (os.path.getmtime(p) if os.path.exists(p)
+                              else 0.0, p))
+    return paths
+
+
+def quarantine_manifest(path: str, reason: str) -> None:
+    """Startup-janitor action: an unreadable or foreign-fingerprint manifest
+    is moved aside (``.corrupt``) and counted, never allowed to wedge
+    ``recover_orphans()`` for the healthy orphans behind it."""
+    obs.REGISTRY.counter("resume.quarantined").inc()
+    obs.diag(f"[resume] quarantining manifest {path}: {reason}")
+    integrity.quarantine(path, reason)
+
+
+def load_or_quarantine(path: str) -> Optional[Dict]:
+    try:
+        return load(path)
+    except ManifestMismatch as e:
+        quarantine_manifest(path, repr(e))
+        return None
+
+
+# -- batch restart surgery -----------------------------------------------------
+
+def apply_resume(graph, m: Dict) -> Dict:
+    """Rewire a freshly lowered batch graph to continue from the manifest.
+
+    The graph must have been built with the manifest's query_id on the same
+    spill dir (checkpoint/spill namespaces must line up) and lowered from
+    the manifest's OWN plan payload or an identical plan — verified via the
+    structural fingerprint, loud ``ManifestMismatch`` on drift.
+
+    Surgery, in order:
+
+    1. **Spill verification fixpoint** — every exec-produced spill the
+       resumed run will replay (consumer frontier up to producer recovery
+       floor, per edge) is read-verified; a missing/corrupt output rewinds
+       its producer to the newest checkpoint covering the first broken seq
+       (ultimately ``(0, 0, 0)``) so live re-execution re-emits the gap.
+       Input-produced spills are never rewound for: their frozen lineages
+       recompute them (``_recompute_object``).
+    2. **Inputs** — the initial full-tape task is replaced with one
+       starting at the min checkpointed-consumer frontier; the GC floor row
+       clamps later in-process recovery to the same start.  Everything
+       below the frontier that a state-0 consumer still needs replays from
+       the HBQ (or recomputes from lineage) — never from a re-read.
+    3. **Checkpointed exec channels** — taped seeding in ORIGINAL
+       coordinates (recovery point + history + IRT + EWT + the manifest's
+       event-tape copy), with history entries NEWER than the chosen
+       recovery point dropped.  Because the tape is durable, a corrupt
+       snapshot discovered at restore time falls back through older
+       checkpoints — ultimately state 0 + full tape replay — exactly like
+       in-process recovery.  Sink channels that already EMITTED output
+       before their checkpoint restart at state 0 instead: the fresh
+       process's result set is empty, and only a from-scratch run
+       re-emits the batches below the checkpointed out frontier.
+    4. **Non-checkpointed channels (sinks, relays)** — keep their fresh
+       state-0 task and queue a ReplayTask covering everything below each
+       producer's floor, so sinks rebuild the full seq-keyed result set
+       (replay + live re-emission) and an attached client's cursor drains
+       exactly the undelivered tail — no duplicate, no missing batch.
+
+    Returns the resume report ({"execs", "inputs", "replay_specs",
+    "verified_spills", "corrupt_spills", "sinks"})."""
+    if graph.query_id != m["query_id"]:
+        raise ManifestMismatch(
+            f"graph namespace {graph.query_id!r} != manifest namespace "
+            f"{m['query_id']!r}")
+    fp = structural_fingerprint(graph)
+    if m.get("plan_fp") is not None and fp != m["plan_fp"]:
+        raise ManifestMismatch(
+            "the resubmitted plan's structural fingerprint differs from "
+            "the manifest's — resuming a DIFFERENT query from this "
+            f"checkpoint state would corrupt it (manifest {m['plan_fp']!r},"
+            f" plan {fp!r})")
+    store = graph.store
+    missing = [a for (a, _ch) in m["execs"] if a not in graph.actors]
+    if missing:
+        raise ManifestMismatch(
+            f"manifest actors {sorted(set(missing))} are not in the "
+            "lowered plan — actor ids diverged")
+    input_actors = {info.id for info in graph.actors.values()
+                    if info.kind == "input"}
+    exec_channels = [(info.id, ch) for info in graph.actors.values()
+                     if info.kind == "exec" for ch in range(info.channels)]
+    # recovery-point choice per manifest channel, refined by the fixpoint.
+    # A sink that already emitted output restarts at state 0 (fresh task
+    # from lowering): restoring it mid-stream would leave the batches
+    # below its checkpointed out frontier missing from the empty fresh
+    # result set forever.
+    choice: Dict[Tuple[int, int], Dict] = {}
+    for (a, ch), e in m["execs"].items():
+        if (graph.actors[a].blocking_dataset is not None
+                and tuple(e["lct"])[1] > 0):
+            continue
+        choice[(a, ch)] = {
+            "lct": tuple(e["lct"]),
+            "cands": [(0, 0, 0)] + [tuple(h) for h in e["ckpts"]],
+            "rewound": False,
+        }
+
+    def consumer_reqs(a: int, ch: int) -> Dict:
+        c = choice.get((a, ch))
+        if c is not None:
+            return m["execs"][(a, ch)]["irts"].get(c["lct"][0], {})
+        return store.tget("IRT", (a, ch, 0)) or {}
+
+    # spill listings per consumer channel, keyed by (src, sch, seq); taken
+    # once up front — probe results below are what decide coverage
+    listing: Dict[Tuple[int, int], Dict] = {}
+    if graph.hbq is not None:
+        for (a, ch) in exec_channels:
+            listing[(a, ch)] = {
+                (nm[0], nm[1], nm[2]): nm
+                for nm in graph.hbq.names_for_target(a, ch)}
+    probe: Dict[Tuple, bool] = {}
+
+    def intact(nm) -> bool:
+        if nm not in probe:
+            # a corrupt file is quarantined (and counted) right here — the
+            # resumed run's replay reads only verified names
+            probe[nm] = (graph.hbq is not None
+                         and graph.hbq.get(nm) is not None)
+        return probe[nm]
+
+    changed = True
+    while changed:
+        changed = False
+        for (a, ch) in exec_channels:
+            for src, chans in consumer_reqs(a, ch).items():
+                if src in input_actors:
+                    continue
+                for sch, nxt in chans.items():
+                    prod = choice.get((src, sch))
+                    if prod is None:
+                        continue  # producer restarts at 0: re-emits live
+                    for s in range(nxt, prod["lct"][1]):
+                        nm = listing.get((a, ch), {}).get((src, sch, s))
+                        if nm is not None and intact(nm):
+                            continue
+                        best = max((h for h in prod["cands"] if h[1] <= s),
+                                   key=lambda h: h[0])
+                        prod["lct"] = tuple(best)
+                        prod["rewound"] = True
+                        changed = True
+                        break
+    corrupt = sum(1 for ok in probe.values() if not ok)
+    # min checkpointed-consumer frontier per input channel: where the live
+    # input tape restarts (state-0 consumers take the older tail from the
+    # HBQ replay below, never from a re-read)
+    frontier: Dict[Tuple[int, int], int] = {}
+    for (a, ch) in choice:
+        for src, chans in consumer_reqs(a, ch).items():
+            if src not in input_actors:
+                continue
+            for sch, nxt in chans.items():
+                key = (src, sch)
+                frontier[key] = min(frontier.get(key, nxt), nxt)
+    report: Dict = {"execs": {}, "inputs": {}, "replay_specs": 0,
+                    "verified_spills": len(probe),
+                    "corrupt_spills": corrupt,
+                    "sinks": dict(m.get("sinks") or {})}
+    replayed = 0
+    # -- inputs: replace the full tape with the post-frontier tail ----------
+    for (src, sch), start in sorted(frontier.items()):
+        if start <= 0:
+            continue
+        last = store.tget("LIT", (src, sch), -1)
+        store.ntt_remove_channel(src, sch)
+        tape = list(range(start, last + 1))
+        store.ntt_push(src, TapedInputTask(src, sch, tape))
+        # clamp later in-process recovery rebuilds to the same start
+        # (engine._recover_channel reads this floor)
+        store.tset("LT", ("gc_floor", src, sch), start)
+        replayed += len(tape)
+        report["inputs"][(src, sch)] = {
+            "replayed_segments": len(tape),
+            "skipped_segments": max(0, start),
+        }
+    # -- checkpointed exec channels: taped replay restores the snapshot
+    # (falling back through the seeded history if it reads corrupt) and
+    # re-runs any tape tail past it
+    for (a, ch), c in sorted(choice.items()):
+        e = m["execs"][(a, ch)]
+        # history newer than the chosen point would restore PAST the
+        # verified-coverage rewind — keep only covered entries
+        kept = [h for h in c["cands"] if h != (0, 0, 0)
+                and h[0] <= c["lct"][0]]
+        store.ntt_remove_channel(a, ch)
+        state_seq, out_seq = seed_exec_channel_taped(
+            store, a, ch, e, lct=c["lct"], ckpts=kept)
+        replayed += 1
+        report["execs"][(a, ch)] = {"state_seq": state_seq,
+                                    "out_seq": out_seq,
+                                    "rewound": c["rewound"]}
+    # -- state-0 channels: HBQ replay of everything below each producer's
+    # floor (intact exec spill, or input spill with lineage-recompute
+    # fallback); seqs at/after the floor arrive from live re-execution
+    for (a, ch) in exec_channels:
+        if (a, ch) in choice:
+            continue
+        specs = set()
+        for src, chans in (store.tget("IRT", (a, ch, 0)) or {}).items():
+            for sch, nxt in chans.items():
+                if src in input_actors:
+                    floor = frontier.get((src, sch), 0)
+                    for s in range(nxt, floor):
+                        nm = listing.get((a, ch), {}).get((src, sch, s))
+                        # an unlisted (async-spill-lost) input output still
+                        # recomputes from its frozen lineage
+                        specs.add(nm if nm is not None
+                                  else (src, sch, s, a, src, ch))
+                else:
+                    prod = choice.get((src, sch))
+                    if prod is None:
+                        continue
+                    for s in range(nxt, prod["lct"][1]):
+                        nm = listing.get((a, ch), {}).get((src, sch, s))
+                        if nm is not None and intact(nm):
+                            specs.add(nm)
+        if specs:
+            store.ntt_push(a, ReplayTask(a, ch, sorted(specs)))
+            replayed += len(specs)
+            report["replay_specs"] += len(specs)
+    obs.REGISTRY.counter("resume.replayed_tasks").inc(replayed)
+    obs.RECORDER.record(
+        "resume.batch", graph.query_id, q=graph.query_id,
+        execs=len(report["execs"]), replayed=replayed,
+        verified=len(probe), corrupt=corrupt)
+    report["replayed_tasks"] = replayed
+    return report

@@ -66,6 +66,13 @@ trips protocol rule QK014 (dead write).
        first rerouted batch ships so replay is deterministic
        [W] engine skew trigger  [R] partition fns + recovery refresh
        (overwrite, bounded by graph edge count)
+  RMT  resume-manifest bookkeeping (runtime/resume.py, durable batch):
+       ("sink", actor, ch) -> emitted result floor and ("hist",) ->
+       manifest-generation journal
+       [W] engine result append + resume.update  [R] resume.update
+       manifest build + service /status manifest_writes column
+       [GC] resume.update journal trim (drop-and-reappend at the cap);
+       sink rows are overwrite-per-channel, bounded by sink width
 """
 
 from __future__ import annotations
@@ -85,6 +92,9 @@ TABLE_NAMES = (
     "SWM", "SWMC", "SST",
     # adaptive exchanges (planner/adapt.py): durable routing rewrites
     "ADT",
+    # batch resume manifests (runtime/resume.py): sink emitted floors +
+    # manifest-generation journal
+    "RMT",
 )
 
 

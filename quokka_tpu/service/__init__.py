@@ -4,6 +4,12 @@
 process-global device scan cache and the jit compile caches alive across
 queries; ``submit(stream)`` runs many queries concurrently against them
 with byte-budgeted admission control and fair round-robin scheduling.
+``submit(durable=True)`` adds crash consistency: the engine rewrites a
+batch resume manifest at every checkpoint, and a restarted service's
+``recover_orphans()`` re-admits every orphaned in-flight query from its
+last durable frontier.  ``QueryHandle.cancel()`` and
+``submit(deadline_s=...)`` stop dispatch cooperatively at the next task
+boundary with full GC (``QueryCancelled`` / ``DeadlineExceeded``).
 """
 
 from quokka_tpu.service.admission import (
@@ -13,6 +19,8 @@ from quokka_tpu.service.admission import (
     estimate_working_set,
 )
 from quokka_tpu.service.server import (
+    DeadlineExceeded,
+    QueryCancelled,
     QueryService,
     QueryStallTimeout,
     ServiceShutdown,
@@ -23,6 +31,8 @@ __all__ = [
     "AdmissionController",
     "AdmissionQueueFull",
     "AdmissionTimeout",
+    "DeadlineExceeded",
+    "QueryCancelled",
     "QueryHandle",
     "QueryService",
     "QueryStallTimeout",

@@ -1,0 +1,279 @@
+"""resume-smoke: durable batch queries survive a service SIGKILL, end to end.
+
+    python -m quokka_tpu.service.resume_smoke [--seed N] [--dir D]
+
+Two durable batch queries — a grouped aggregate and a TPC-H-shaped
+scan-join-aggregate — are killed mid-flight with the service that runs
+them, then resumed by a fresh service's supervisor:
+
+1. ground truth: both queries run one-shot through the batch engine
+   (integer-valued f64 workloads: sums are order-exact under ANY
+   accumulation order, so "bit-exact" is a real claim — and the runs warm
+   the process-wide jit caches for the host-sync gate below);
+2. a CHILD process hosts a QueryService (stable spill dir) and submits
+   both queries with ``durable=True``; once both resume manifests record
+   checkpointed progress (state_seq >= 2) the parent SIGKILLs the child —
+   a real crash, not a graceful shutdown;
+3. the parent starts a fresh service on the same spill dir and calls
+   ``recover_orphans()``: both queries re-admit through normal admission
+   and resume from their last durable frontier;
+4. asserts: both results BIT-EXACT vs the one-shot runs, replay bounded
+   (input segments below the frontier are skipped, never re-read — gated
+   off under injected corruption, where lineage recompute is the point),
+   ``shuffle.host_syncs`` delta ZERO across the resumed run, zero orphan
+   manifests left after the clean finishes, and admission bytes back to
+   baseline.
+
+``run(d, seed)`` raises AssertionError on any violation — the chaos soak
+calls it in-process as its ``batch-resume`` mode (spill/checkpoint
+corruption layered on top of the SIGKILL).  Exit nonzero from the CLI.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+import pandas as pd
+
+N_ROWS = 600_000
+N_KEYS = 50
+ROW_GROUP = 3_000
+CKPT_INTERVAL = 2
+KILL_AFTER_STATE = 4  # SIGKILL once every query checkpointed this deep
+
+
+def _datasets(d: str, seed: int) -> None:
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    r = np.random.default_rng(seed)
+    li = pd.DataFrame({
+        "k": r.integers(0, N_KEYS, N_ROWS).astype(np.int64),
+        "v": r.integers(0, 100, N_ROWS).astype(np.float64),
+        "w": r.integers(1, 10, N_ROWS).astype(np.float64),
+    })
+    dim = pd.DataFrame({
+        "k": np.arange(N_KEYS, dtype=np.int64),
+        "g": (np.arange(N_KEYS, dtype=np.int64) % 5),
+    })
+    pq.write_table(pa.Table.from_pandas(li, preserve_index=False),
+                   os.path.join(d, "li.parquet"), row_group_size=ROW_GROUP)
+    pq.write_table(pa.Table.from_pandas(dim, preserve_index=False),
+                   os.path.join(d, "dim.parquet"))
+
+
+def _build_queries(d: str):
+    """The two batch queries — ONE shared definition so the child, the
+    one-shot baselines and any debugging rerun lower identical plans
+    (identical structural fingerprints are what lets the supervisor
+    verify an orphan manifest belongs to this plan)."""
+    from quokka_tpu import QuokkaContext
+
+    ctx = QuokkaContext()
+    agg = (ctx.read_parquet(os.path.join(d, "li.parquet"))
+           .groupby("k").agg_sql("sum(v) as sv, sum(w) as sw, count(*) as n"))
+    ctx2 = QuokkaContext()
+    join = (ctx2.read_parquet(os.path.join(d, "li.parquet"))
+            .join(ctx2.read_parquet(os.path.join(d, "dim.parquet")), on="k")
+            .groupby("g").agg_sql("sum(v) as sv, count(*) as n"))
+    return agg, join
+
+
+def _service(d: str):
+    from quokka_tpu.service import QueryService
+
+    return QueryService(
+        pool_size=2, spill_dir=os.path.join(d, "spill"),
+        exec_config={"fault_tolerance": True,
+                     "checkpoint_interval": CKPT_INTERVAL})
+
+
+_SORTS = {"agg": ["k"], "join": ["g"]}
+
+
+def _truth(d: str):
+    agg, join = _build_queries(d)
+    return {"agg": agg.collect().sort_values("k").reset_index(drop=True),
+            "join": join.collect().sort_values("g").reset_index(drop=True)}
+
+
+# -- child: killed with SIGKILL mid-query -------------------------------------
+
+def run_child(d: str) -> None:
+    agg, join = _build_queries(d)
+    svc = _service(d)
+    handles = {"agg": svc.submit(agg, durable=True),
+               "join": svc.submit(join, durable=True)}
+    with open(os.path.join(d, "child_manifests"), "w") as f:
+        json.dump({k: h.manifest_path for k, h in handles.items()}, f)
+    os.replace(os.path.join(d, "child_manifests"),
+               os.path.join(d, "childready"))
+    for h in handles.values():
+        h.wait(timeout=600)
+    # finishing before the SIGKILL means the parent raced too slowly — it
+    # checks for this marker and fails loudly instead of "passing" a resume
+    # that never resumed anything
+    open(os.path.join(d, "childdone"), "w").close()
+    while True:  # hold the process for the (now pointless) SIGKILL
+        time.sleep(1.0)
+
+
+def _checkpointed(path: str) -> bool:
+    """True once the manifest at ``path`` records a checkpointed exec
+    channel at least ``KILL_AFTER_STATE`` deep.  Mid-rewrite manifests
+    read as not-yet."""
+    from quokka_tpu.runtime import resume as bresume
+
+    try:
+        m = bresume.load(path)
+    except Exception:
+        return False
+    return any(e["lct"][0] >= KILL_AFTER_STATE for e in m["execs"].values())
+
+
+def _exact(got: pd.DataFrame, want: pd.DataFrame, sort_by, what: str) -> None:
+    got = got.sort_values(sort_by).reset_index(drop=True)[
+        want.columns.tolist()]
+    for c in want.columns:
+        got[c] = got[c].astype(want[c].dtype)
+    pd.testing.assert_frame_equal(got, want, check_exact=True, obj=what)
+
+
+def run(d: str, seed: int, log=print) -> dict:
+    """Full parent flow; raises AssertionError on any violation.  Returns
+    a summary dict (replayed/skipped/corrupt counts) for the caller."""
+    from quokka_tpu import obs
+
+    os.makedirs(d, exist_ok=True)
+    _datasets(d, seed)
+    t0 = time.time()
+    truth = _truth(d)
+    log(f"[resume-smoke] one-shot baselines in {time.time() - t0:.1f}s "
+        f"({len(truth['agg'])} keys, {len(truth['join'])} groups)")
+
+    env = dict(os.environ)  # QK_CHAOS passes through when the soak set it
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    child = subprocess.Popen(
+        [sys.executable, "-m", "quokka_tpu.service.resume_smoke",
+         "--child", "--dir", d],
+        env=env, cwd=os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))))
+    try:
+        ready = os.path.join(d, "childready")
+        deadline = time.time() + 180
+        while not os.path.exists(ready):
+            assert child.poll() is None, \
+                f"child died before submitting (rc={child.returncode})"
+            assert time.time() < deadline, "child never became ready"
+            time.sleep(0.1)
+        manifests = json.load(open(ready))
+        # kill once BOTH manifests record checkpointed progress — mid-query
+        while not all(_checkpointed(p) for p in manifests.values()):
+            assert not os.path.exists(os.path.join(d, "childdone")), \
+                "child finished before the SIGKILL landed (nothing resumed)"
+            assert child.poll() is None, \
+                f"child exited early (rc={child.returncode})"
+            assert time.time() < deadline, \
+                "no checkpointed progress before deadline"
+            time.sleep(0.02)
+    except BaseException:
+        child.kill()
+        child.wait()
+        raise
+    os.kill(child.pid, signal.SIGKILL)
+    child.wait()
+    log("[resume-smoke] child SIGKILLed with both queries mid-flight")
+
+    snap0 = obs.REGISTRY.snapshot()
+    svc = _service(d)
+    try:
+        handles = {h.manifest_path: h for h in svc.recover_orphans()}
+        assert len(handles) == 2, \
+            f"expected 2 orphans, recovered {len(handles)}"
+        summary: dict = {}
+        for name, path in manifests.items():
+            h = handles[path]
+            rep = h.resume_info
+            got = h.to_df(timeout=300)
+            _exact(got, truth[name], _SORTS[name],
+                   f"resumed {name} vs one-shot batch")
+            replayed = sum(r["replayed_segments"]
+                           for r in rep["inputs"].values())
+            skipped = sum(r["skipped_segments"]
+                          for r in rep["inputs"].values())
+            summary[name] = {
+                "replayed_segments": replayed, "skipped_segments": skipped,
+                "corrupt_spills": rep["corrupt_spills"],
+                "execs": {k: v["state_seq"]
+                          for k, v in rep["execs"].items()}}
+            log(f"[resume-smoke] resume[{name}]: replayed {replayed} "
+                f"segments, skipped {skipped}, corrupt spills "
+                f"{rep['corrupt_spills']}, restored {summary[name]['execs']}")
+            assert rep["execs"], \
+                f"{name}: no exec channel restored from its checkpoint"
+            clean = (rep["corrupt_spills"] == 0
+                     and not any(v["rewound"]
+                                 for v in rep["execs"].values()))
+            if clean:
+                # bounded replay: the pre-frontier input segments must be
+                # SKIPPED (served from durable spill / restored state), not
+                # re-read — skipping zero means full recomputation
+                assert skipped > 0, \
+                    f"{name}: resume replayed from segment zero " \
+                    f"(full recomputation)"
+        snap1 = obs.REGISTRY.snapshot()
+        syncs = (snap1.get("shuffle.host_syncs", 0)
+                 - snap0.get("shuffle.host_syncs", 0))
+        assert syncs == 0, \
+            f"resumed run forced {syncs} blocking host syncs (warm path)"
+        assert snap1.get("resume.replayed_tasks", 0) > 0
+        leftovers = glob.glob(os.path.join(
+            svc._spill_dir, "ckpt", "batch-*.manifest"))
+        assert not leftovers, \
+            f"orphan manifests left after clean finish: {leftovers}"
+        used = svc.admission.stats()["used_bytes"]
+        assert used == 0, f"admission bytes not released: {used}"
+        summary["host_syncs"] = syncs
+        summary["corrupt_detected"] = (
+            snap1.get("integrity.corrupt", 0)
+            - snap0.get("integrity.corrupt", 0))
+    finally:
+        svc.shutdown()
+    log("[resume-smoke] OK: both durable queries resumed bit-exact through "
+        "SIGKILL, bounded replay, 0 host syncs, 0 orphan manifests")
+    return summary
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--seed", type=int, default=20260807)
+    ap.add_argument("--dir", default=None,
+                    help="stable working dir (default: a fresh tempdir)")
+    ap.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+    if args.child:
+        run_child(args.dir)
+        return 0
+    d = args.dir or tempfile.mkdtemp(prefix="resume-smoke-")
+    print(f"[resume-smoke] dir={d} seed={args.seed}", flush=True)
+    try:
+        run(d, args.seed)
+    except AssertionError as e:
+        print(f"[resume-smoke] FAIL: {e}", flush=True)
+        print(f"[resume-smoke] replay: python -m quokka_tpu.service."
+              f"resume_smoke --seed {args.seed}", flush=True)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

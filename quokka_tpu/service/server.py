@@ -31,10 +31,12 @@ Usage::
 from __future__ import annotations
 
 import os
+import pickle
 import shutil
 import tempfile
 import threading
 import time
+import weakref
 from typing import Dict, List, Optional
 
 from quokka_tpu import obs
@@ -63,6 +65,20 @@ class ServiceShutdown(RuntimeError):
 
 class QueryStallTimeout(TimeoutError):
     """A running query made no progress within QK_SERVICE_QUERY_TIMEOUT."""
+
+
+class QueryCancelled(RuntimeError):
+    """The query was cancelled via QueryHandle.cancel(): dispatch stopped at
+    the next task boundary, admission bytes released, namespace/spill/
+    checkpoints/manifest GC'd.  Distinct from the stall timeout — this is a
+    client decision, not a health judgment."""
+
+
+class DeadlineExceeded(TimeoutError):
+    """The query outlived its submit(..., deadline_s=...) budget and was
+    cooperatively cancelled at the next task boundary.  Distinct from
+    QueryStallTimeout (a PROGRESSING query past its deadline still dies;
+    a stalled one dies even without a deadline)."""
 
 
 class QueryService:
@@ -112,6 +128,10 @@ class QueryService:
         self._lock = threading.Lock()
         self._wake = threading.Condition(self._lock)
         self._sessions: Dict[str, QuerySession] = {}  # LIVE queries only
+        # every session ever enqueued, weakly: attach(query_id) keeps
+        # working after the service drops its strong reference at finish,
+        # for exactly as long as any client handle keeps the session alive
+        self._by_id = weakref.WeakValueDictionary()
         self._queued: Dict[str, QuerySession] = {}
         self._running: List[str] = []  # round-robin order
         self._rr = 0
@@ -193,33 +213,105 @@ class QueryService:
 
     # -- client surface ------------------------------------------------------
     def submit(self, stream, *, working_set_bytes: Optional[int] = None,
-               exec_config: Optional[dict] = None) -> QueryHandle:
+               exec_config: Optional[dict] = None,
+               durable: Optional[bool] = None,
+               resume_from: Optional[str] = None,
+               deadline_s: Optional[float] = None) -> QueryHandle:
         """Lower a DataStream's plan into this service's shared runtime and
         queue it for admission.  Returns immediately with a QueryHandle;
-        raises AdmissionQueueFull when the wait queue is at capacity."""
+        raises AdmissionQueueFull when the wait queue is at capacity.
+
+        ``durable=True`` (default from ``QK_DURABLE_BATCH``; requires
+        ``fault_tolerance``) makes the query survive a full service process
+        death: the engine rewrites a batch resume manifest (plan payload +
+        fingerprint, per-channel checkpoint frontiers, sink floor) at every
+        checkpoint cadence, and a restarted service re-admits it via
+        ``recover_orphans()`` — or explicitly via
+        ``submit(stream, resume_from=<manifest>)``, which verifies the
+        resubmitted plan's structural fingerprint against the manifest and
+        fails loudly (``ManifestMismatch``) on drift.
+
+        ``deadline_s`` is a per-query wall-clock budget measured from
+        submit: a query still unfinished past it is cooperatively cancelled
+        at the next task boundary and fails with ``DeadlineExceeded``
+        (default from ``QK_QUERY_DEADLINE_S``; distinct from the global
+        stall timeout, which only fires on NO progress)."""
         with self._lock:
             if self._shutdown:
                 raise ServiceShutdown("QueryService is shut down")
         ctx = stream.ctx
         cfg = self._merged_config(ctx, exec_config)
+        if deadline_s is None:
+            env_deadline = _env_float("QK_QUERY_DEADLINE_S", 0.0)
+            deadline_s = env_deadline if env_deadline > 0 else None
+        if resume_from is not None:
+            from quokka_tpu.runtime import resume as bresume
+
+            if not cfg.get("fault_tolerance"):
+                raise ValueError(
+                    "resume_from needs fault_tolerance=True: the resumed "
+                    "query restores executor checkpoints and replays "
+                    "spilled batches, neither of which exists without it")
+            m = bresume.load(resume_from)
+            return self._resume_orphan(m, resume_from, stream=stream,
+                                       exec_config=exec_config,
+                                       deadline_s=deadline_s)
+        if durable is None:
+            durable = bool(_env_int("QK_DURABLE_BATCH", 0))
+        if durable and not cfg.get("fault_tolerance"):
+            raise ValueError(
+                "durable=True needs fault_tolerance=True: the resume "
+                "manifest records checkpoint frontiers and replays spilled "
+                "batches, neither of which exists without it")
         qid = new_query_id()
         graph = TaskGraph(cfg, store=self.store,
                           cache=BatchCache(owner=qid), query_id=qid,
                           spill_dir=self._spill_dir)
         try:
-            sink_actor = ctx.lower_into(stream.node_id, graph)
+            sub, sink_id = ctx._prepare_plan(stream.node_id)
+            blob = None
+            if durable:
+                # capture the PREPARED (pre-lowering) plan: recovery
+                # re-lowers it in a fresh context, and the structural
+                # fingerprint check proves the re-lowering is the same plan
+                try:
+                    blob = pickle.dumps({
+                        "sub": sub, "sink_id": sink_id,
+                        "exec_channels": ctx.exec_channels,
+                        "exec_config": cfg,
+                    })
+                except Exception as e:
+                    raise ValueError(
+                        "durable=True needs a picklable plan (no lambdas/"
+                        f"closures in map/filter payloads): {e!r}") from e
+            sink_actor = ctx._lower_plan(sub, sink_id, graph)
             est = (int(working_set_bytes) if working_set_bytes is not None
                    else estimate_working_set(graph))
+            if durable:
+                from quokka_tpu.runtime import resume as bresume
+
+                graph.resume_manifest = bresume.default_path(graph)
+                graph.resume_plan_blob = blob
+                graph.resume_est_bytes = est
             session = QuerySession(qid, graph, sink_actor, est,
                                    self.inflight_per_query)
+            session.durable = durable
+            if deadline_s is not None:
+                session.deadline_at = session.submitted_at + float(deadline_s)
             self._enqueue_session(session)
+            if durable:
+                # initial manifest at submit: a crash before the first
+                # checkpoint still re-admits (as a fresh run — no frontier
+                # to resume, but no silently vanished query either)
+                bresume.update(graph)
         except BaseException:
             graph.cleanup()
             raise
         # admit synchronously when it fits: the caller's next submit must
         # see this query CHARGED against the budget, not still in the queue
         self._admit_pending()
-        obs.RECORDER.record("service.submit", qid, q=qid, est_bytes=est)
+        obs.RECORDER.record("service.submit", qid, q=qid, est_bytes=est,
+                            durable=durable)
         return session.handle
 
     def _enqueue_session(self, session: QuerySession) -> None:
@@ -230,7 +322,9 @@ class QueryService:
             if self._shutdown:
                 raise ServiceShutdown("QueryService is shut down")
             self.admission.offer(session.query_id, session.est_bytes)
+            session._service = self
             self._sessions[session.query_id] = session
+            self._by_id[session.query_id] = session
             self._queued[session.query_id] = session
             self._wake.notify_all()
 
@@ -352,6 +446,159 @@ class QueryService:
                             est_bytes=est, resumed=resume is not None)
         return StreamingHandle(session, resume_info=resume_info)
 
+    # -- supervisor: durable-batch orphan recovery ---------------------------
+    def recover_orphans(self, manifest_dir: Optional[str] = None
+                        ) -> List[QueryHandle]:
+        """Scan the manifest directory for orphaned durable batch queries (a
+        previous service incarnation died with them in flight) and re-admit
+        each through NORMAL admission — FIFO behind anything already queued,
+        no barging — resuming from its last durable frontier.  Unreadable or
+        foreign manifests are quarantined (``.corrupt``, counted on
+        ``resume.quarantined``), never allowed to wedge the healthy orphans
+        behind them.  Returns one QueryHandle per re-admitted query; call it
+        right after constructing the restarted service (same ``spill_dir``)."""
+        from quokka_tpu.runtime import resume as bresume
+
+        if manifest_dir is None:
+            manifest_dir = os.path.join(self._spill_dir, "ckpt")
+        handles: List[QueryHandle] = []
+        for path in bresume.scan(manifest_dir):
+            m = bresume.load_or_quarantine(path)
+            if m is None:
+                continue
+            with self._lock:
+                if m["query_id"] in self._sessions:
+                    continue  # live in THIS incarnation: not an orphan
+            try:
+                handles.append(self._resume_orphan(m, path))
+            except bresume.ManifestMismatch as e:
+                # foreign fingerprint / missing plan payload: same janitor
+                # treatment as an unreadable manifest
+                bresume.quarantine_manifest(path, repr(e))
+        obs.REGISTRY.counter("resume.orphans").inc(len(handles))
+        return handles
+
+    def _resume_orphan(self, m: Dict, path: str, *, stream=None,
+                       exec_config: Optional[dict] = None,
+                       deadline_s: Optional[float] = None) -> QueryHandle:
+        """Re-admit one manifest: re-lower its plan (from the manifest's own
+        pickled plan payload, or from ``stream`` when the client resubmits
+        explicitly), verify the structural fingerprint, apply the restart
+        surgery, and enqueue through normal admission."""
+        from quokka_tpu.runtime import resume as bresume
+
+        qid = m["query_id"]
+        with self._lock:
+            if qid in self._sessions:
+                # mirror of the streaming guard: a duplicate resume of a
+                # LIVE query would run two engines against one store/spill/
+                # checkpoint namespace — interleaved seq assignments and
+                # conflicting results, silently
+                raise ValueError(
+                    f"query {qid} is already running in this service — "
+                    "it cannot be resumed from its manifest again")
+        blob = m.get("plan_blob")
+        if stream is not None:
+            ctx = stream.ctx
+            cfg = self._merged_config(ctx, exec_config)
+            sub, sink_id = ctx._prepare_plan(stream.node_id)
+        else:
+            if not blob:
+                raise bresume.ManifestMismatch(
+                    f"manifest {path} carries no plan payload — it cannot "
+                    "be resumed without the original stream")
+            from quokka_tpu.context import QuokkaContext
+
+            payload = pickle.loads(blob)
+            ctx = QuokkaContext()
+            ctx.exec_channels = payload.get("exec_channels",
+                                            ctx.exec_channels)
+            sub, sink_id = payload["sub"], payload["sink_id"]
+            cfg = dict(payload.get("exec_config") or self.exec_config)
+        graph = TaskGraph(cfg, store=self.store,
+                          cache=BatchCache(owner=qid), query_id=qid,
+                          spill_dir=self._spill_dir)
+        try:
+            sink_actor = ctx._lower_plan(sub, sink_id, graph)
+            graph.resume_manifest = path
+            graph.resume_plan_blob = blob
+            info = bresume.apply_resume(graph, m)
+            est = int(m.get("est_bytes") or estimate_working_set(graph))
+            graph.resume_est_bytes = est
+            session = QuerySession(qid, graph, sink_actor, est,
+                                   self.inflight_per_query)
+            session.durable = True
+            session.resume_info = info
+            if deadline_s is not None:
+                session.deadline_at = (session.submitted_at
+                                       + float(deadline_s))
+            self._enqueue_session(session)
+        except BaseException:
+            # an aborted resume never ran: the durable recovery trio must
+            # survive for the next attempt
+            graph.cleanup(preserve_durable=True)
+            raise
+        self._admit_pending()
+        obs.RECORDER.record(
+            "service.resume", qid, q=qid, est_bytes=est,
+            execs=len(info["execs"]), replay_specs=info["replay_specs"],
+            corrupt_spills=info["corrupt_spills"])
+        return session.handle
+
+    def attach(self, query_id: str,
+               cursor: Optional[Dict[int, int]] = None) -> QueryHandle:
+        """A fresh handle for a query by id — including one re-admitted by
+        ``recover_orphans()`` or already finished (for as long as any handle
+        keeps its session alive).  ``cursor`` ({channel: last seq the client
+        durably captured}) seeds the handle's delivery cursor so its first
+        ``poll_batches()`` drains exactly the undelivered tail — a resumed
+        sink rebuilds the full seq-keyed result set, so replayed batches
+        below the cursor never re-surface and nothing above it is skipped."""
+        with self._lock:
+            session = self._sessions.get(query_id)
+        if session is None:
+            session = self._by_id.get(query_id)
+        if session is None:
+            raise KeyError(
+                f"query {query_id!r} is unknown to this service (never "
+                "submitted here, or finished with every handle released)")
+        handle = QueryHandle(session)
+        if cursor:
+            handle._cursor.update(cursor)
+        return handle
+
+    # -- cancellation + deadlines --------------------------------------------
+    def _cancel_ping(self, session: QuerySession) -> None:
+        """QueryHandle.cancel() entry: a QUEUED query cancels synchronously
+        (it holds no slot to drain); a RUNNING one is flagged and the worker
+        loop honors it at the next task boundary."""
+        obs.REGISTRY.counter("cancel.requested").inc()
+        with self._lock:
+            queued = self._queued.pop(session.query_id, None) is not None
+            if queued:
+                self.admission.cancel(session.query_id)
+            self._wake.notify_all()
+        if queued:
+            self._finish(session, QueryCancelled(
+                f"query {session.query_id} cancelled while queued"))
+
+    def _reap_deadlines(self) -> None:
+        """Fail QUEUED sessions whose deadline expired before admission
+        (RUNNING ones are checked at every slot grant)."""
+        now = time.time()
+        expired: List[QuerySession] = []
+        with self._lock:
+            for qid, s in list(self._queued.items()):
+                if s.deadline_at is not None and now > s.deadline_at:
+                    self._queued.pop(qid, None)
+                    self.admission.cancel(qid)
+                    expired.append(s)
+        for s in expired:
+            obs.REGISTRY.counter("cancel.deadline").inc()
+            self._finish(s, DeadlineExceeded(
+                f"query {s.query_id} exceeded its deadline while queued "
+                f"({now - s.submitted_at:.1f}s since submit)"))
+
     def stats(self) -> Dict:
         from quokka_tpu.runtime import scancache
 
@@ -390,6 +637,20 @@ class QueryService:
                     # (non-creating ledger lookup; None before first stats)
                     "top_operator": obs.OPSTATS.top_operator(qid),
                 }
+                if s.durable:
+                    # durable-batch columns: manifest cadence (the RMT
+                    # journal length), resume provenance, cancel/deadline
+                    # state — the /status surface for the supervisor plane
+                    sessions[qid].update({
+                        "durable": True,
+                        "manifest_writes": len(
+                            s.graph.store.tget("RMT", ("hist",)) or []),
+                        "resumed": s.resume_info is not None,
+                        "cancel_requested": s.cancel_requested,
+                        "deadline_in_s": (
+                            round(s.deadline_at - now, 3)
+                            if s.deadline_at is not None else None),
+                    })
                 if not s.streaming:
                     # health plane: completion estimate + ETA (a standing
                     # query has no completion fraction — its row carries
@@ -526,11 +787,28 @@ class QueryService:
                     return
                 n_running = len(self._running)
             self._admit_pending()
+            self._reap_deadlines()
             session = self._next_slot()
             if session is None:
                 with self._wake:
                     if not self._shutdown:
                         self._wake.wait(0.005)
+                continue
+            # cooperative cancellation/deadline: honored at the task
+            # boundary, before dispatching another quantum for this query
+            if session.cancel_requested or (
+                    session.deadline_at is not None
+                    and time.time() > session.deadline_at):
+                self._release_slot(session)
+                if session.cancel_requested:
+                    self._finish(session, QueryCancelled(
+                        f"query {session.query_id} cancelled"))
+                else:
+                    obs.REGISTRY.counter("cancel.deadline").inc()
+                    self._finish(session, DeadlineExceeded(
+                        f"query {session.query_id} exceeded its deadline "
+                        f"({time.time() - session.submitted_at:.1f}s since "
+                        "submit)"))
                 continue
             err: Optional[BaseException] = None
             outcome = None

@@ -65,6 +65,20 @@ class QuerySession:
         # (an idle standing query is healthy), torn down with its durable
         # recovery state preserved, surfaced as a standing row in /status
         self.streaming = False
+        # submit(durable=True) / recover_orphans set True: the engine
+        # rewrites a batch resume manifest at each checkpoint, and a
+        # service-shutdown teardown preserves the durable recovery trio
+        self.durable = False
+        # cooperative cancellation + per-query deadline: the worker loop
+        # honors both at the next task boundary (server._worker_loop);
+        # deadline_at is an absolute time.time() cutoff
+        self.cancel_requested = False
+        self.deadline_at: Optional[float] = None
+        # the resume report from runtime/resume.apply_resume, when this
+        # session was re-admitted from an orphaned manifest
+        self.resume_info: Optional[Dict] = None
+        # backref set by QueryService._enqueue_session (cancel plumbing)
+        self._service = None
         # snapshotted at finish, before the namespace GC
         self.scan_stats: Optional[Dict] = None
         # memory-plane footprint ({live, peak, spill_resident} bytes),
@@ -121,9 +135,17 @@ class QuerySession:
                 # a standing query that FAILED (or was shut down mid-stream)
                 # keeps its durable recovery trio — checkpoints, HBQ spill,
                 # resume manifest — so a restarted replica resumes it; a
-                # cleanly stopped stream is complete and GCs everything
-                self.graph.cleanup(preserve_durable=(
-                    self.streaming and error is not None))
+                # cleanly stopped stream is complete and GCs everything.
+                # A DURABLE BATCH query keeps its trio only on service
+                # shutdown (the restart/recover_orphans path); success,
+                # cancel, deadline and plain failure all GC fully —
+                # manifests never accumulate from completed queries
+                preserve = self.streaming and error is not None
+                if not preserve and self.durable and error is not None:
+                    from quokka_tpu.service.server import ServiceShutdown
+
+                    preserve = isinstance(error, ServiceShutdown)
+                self.graph.cleanup(preserve_durable=preserve)
             except Exception as e:  # noqa: BLE001 — teardown must not kill
                 from quokka_tpu import obs  # the pool thread running it
 
@@ -149,6 +171,10 @@ class QueryHandle:
 
     def __init__(self, session: QuerySession):
         self._s = session
+        # per-handle delivery cursor ({channel: last seen seq}) for
+        # poll_batches(): a re-attached client seeds it with its own capture
+        # frontier and drains exactly the undelivered tail
+        self._cursor: Dict[int, int] = {}
 
     @property
     def query_id(self) -> str:
@@ -171,6 +197,56 @@ class QueryHandle:
         """The LIVE ResultDataset — partial while the query streams, the
         full result once ``done``."""
         return self._s.graph.result(self._s.sink_actor)
+
+    @property
+    def resume_info(self) -> Optional[Dict]:
+        """The resume report ({execs, inputs, replay_specs, ...}) when this
+        query was re-admitted from an orphaned manifest; None otherwise."""
+        return self._s.resume_info
+
+    @property
+    def manifest_path(self) -> Optional[str]:
+        """The durable resume-manifest path for a ``durable=True`` query
+        (None otherwise) — what ``QueryService.recover_orphans`` scans for
+        after a crash."""
+        return getattr(self._s.graph, "resume_manifest", None)
+
+    def poll_batches(self):
+        """Drain result batches this handle has not seen yet: a list of
+        ``(channel, seq, table)`` strictly after the handle's cursor, which
+        advances past everything returned.  Seq-keyed, so a resumed query's
+        replayed batches never surface twice through one handle."""
+        ds = self.dataset
+        if ds is None:
+            return []
+        items = ds.items_since(self._cursor)
+        for ch, s, _t in items:
+            self._cursor[ch] = s
+        return items
+
+    def cancel(self, wait: bool = True,
+               timeout: Optional[float] = 60.0) -> "QueryHandle":
+        """Cooperatively cancel this query: dispatch stops at the next task
+        boundary, admission bytes release, and the namespace/spill/
+        checkpoints/manifest GC.  The handle then reports a
+        ``QueryCancelled`` error.  Idempotent; a no-op once finished."""
+        s = self._s
+        s.cancel_requested = True
+        svc = s._service
+        if svc is not None:
+            svc._cancel_ping(s)
+        if wait:
+            s.wait(timeout)
+        return self
+
+    @staticmethod
+    def attach(service, query_id: str,
+               cursor: Optional[Dict[int, int]] = None) -> "QueryHandle":
+        """Re-attach to a query by id (``QueryService.attach``) — a fresh
+        handle whose delivery cursor starts at ``cursor`` ({channel: last
+        seq the client durably captured}), so the first ``poll_batches``
+        returns exactly the undelivered tail."""
+        return service.attach(query_id, cursor=cursor)
 
     def wait(self, timeout: Optional[float] = None) -> "QueryHandle":
         if not self._s.wait(timeout):

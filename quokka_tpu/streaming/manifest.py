@@ -36,66 +36,33 @@ import time
 from typing import Dict, Optional
 
 from quokka_tpu import obs
-from quokka_tpu.runtime import integrity
-from quokka_tpu.runtime.task import ExecutorTask, TapedExecutorTask, TapedInputTask
+from quokka_tpu.runtime import integrity, resume as _resume
+from quokka_tpu.runtime.task import ExecutorTask, TapedInputTask
 
-MANIFEST_VERSION = 1
+MANIFEST_VERSION = _resume.MANIFEST_VERSION
 
 
-class StreamResumeError(RuntimeError):
+class StreamResumeError(_resume.ManifestMismatch):
     """The manifest cannot resume this plan (fingerprint mismatch, missing
     actors, or an unreadable manifest) — loud, never a silent fresh start."""
 
 
-def _exec_desc(factory) -> str:
-    """Stable description of an executor factory: streaming executors expose
-    ``plan_signature()`` (operator config, no object addresses); everything
-    else describes by type."""
-    import functools
-
-    fn = factory
-    parts = []
-    while isinstance(fn, functools.partial):
-        parts.extend(type(a).__name__ for a in fn.args
-                     if not callable(a) or hasattr(a, "plan_signature"))
-        for a in fn.args:
-            sig = getattr(a, "plan_signature", None)
-            if sig is not None:
-                return repr(sig())
-        fn = fn.func
-    name = getattr(fn, "__name__", type(fn).__name__)
-    return "/".join([name] + parts)
+# the structural-fingerprint machinery is shared with batch resume
+# (runtime/resume.py) — kept as module names here for existing callers
+_exec_desc = _resume._exec_desc
 
 
 def stream_plan_fingerprint(graph) -> str:
-    """Structural fingerprint for resume verification.  Unlike the compile
-    plane's ``plan_fingerprint`` it must be stable across process restarts
-    of the SAME standing query — so no reader size buckets (a tailed file
-    grows between restarts) and no object reprs, just topology + operator
-    configuration."""
-    import hashlib
-
-    parts = []
-    for aid in sorted(graph.actors):
-        info = graph.actors[aid]
-        desc = [str(aid), info.kind, str(info.channels), str(info.stage)]
-        if info.reader is not None:
-            desc.append(type(info.reader).__name__)
-        if info.executor_factory is not None:
-            desc.append(_exec_desc(info.executor_factory))
-        desc.append(",".join(
-            f"{stream}:{src}"
-            for src, stream in sorted(info.source_streams.items())))
-        parts.append("|".join(desc))
-    return hashlib.sha256(";".join(parts).encode()).hexdigest()[:16]
+    """Structural fingerprint for resume verification (shared with batch
+    resume): stable across process restarts of the SAME standing query — no
+    reader size buckets (a tailed file grows between restarts) and no object
+    reprs, just topology + operator configuration."""
+    return _resume.structural_fingerprint(graph)
 
 
 def default_path(graph) -> str:
-    root = graph.exec_config.get("checkpoint_store") or graph.ckpt_dir
-    if root is None or "://" in str(root):
-        # remote checkpoint roots keep their manifest next to the spill
-        root = graph.ckpt_dir or "."
-    return os.path.join(root, f"stream-{graph.query_id}.manifest")
+    return os.path.join(_resume.manifest_root(graph),
+                        f"stream-{graph.query_id}.manifest")
 
 
 def _stream_inputs(graph):
@@ -114,6 +81,7 @@ def update(graph) -> None:
     store = graph.store
     m: Dict = {
         "version": MANIFEST_VERSION,
+        "kind": "stream",
         "query_id": graph.query_id,
         "plan_fp": stream_plan_fingerprint(graph),
         "written_at": time.time(),
@@ -121,27 +89,7 @@ def update(graph) -> None:
         "execs": {},
     }
     with store.transaction():
-        for info in graph.actors.values():
-            if info.kind != "exec":
-                continue
-            for ch in range(info.channels):
-                lct = store.tget("LCT", (info.id, ch))
-                if lct is None:
-                    continue
-                irts = {}
-                for hist in [(0, 0, 0)] + [
-                        tuple(h) for h in
-                        (store.tget("LT", ("ckpts", info.id, ch)) or [])]:
-                    reqs = store.tget("IRT", (info.id, ch, hist[0]))
-                    if reqs is not None:
-                        irts[hist[0]] = {a: dict(c) for a, c in reqs.items()}
-                m["execs"][(info.id, ch)] = {
-                    "lct": tuple(lct),
-                    "ckpts": [tuple(h) for h in
-                              (store.tget("LT", ("ckpts", info.id, ch))
-                               or [])],
-                    "irts": irts,
-                }
+        m["execs"] = _resume.collect_exec_channels(graph)
         # retained-history floor per input channel: the oldest segment any
         # RECORDED checkpoint's frontier can still ask for.  Serializing
         # only from there keeps the per-checkpoint manifest work (and its
@@ -178,7 +126,9 @@ def update(graph) -> None:
                              "wm": store.tget("SWMC", (info.id, ch))}
             m["inputs"][info.id] = chans
     try:
-        integrity.write_framed_atomic(path, pickle.dumps(m), site="ckpt")
+        # own chaos site (see runtime/resume.py): manifest corruption is a
+        # distinct failure domain from checkpoint corruption
+        integrity.write_framed_atomic(path, pickle.dumps(m), site="manifest")
     except OSError as e:
         obs.REGISTRY.counter("stream.manifest_skipped").inc()
         obs.diag(f"[stream] manifest write to {path} skipped: {e!r}")
@@ -306,15 +256,12 @@ def gc(graph) -> Dict[str, int]:
 def load(path: str) -> Dict:
     """Read and verify a manifest; loud on corruption or version drift —
     resume is an explicit operator request, never a best-effort guess."""
-    try:
-        m = pickle.loads(integrity.read_framed(path))
-    except (OSError, pickle.UnpicklingError) as e:
+    m = _resume.load_framed(path, err=StreamResumeError)
+    if m.get("kind", "stream") != "stream":
         raise StreamResumeError(
-            f"stream manifest {path} unreadable: {e!r}") from e
-    if m.get("version") != MANIFEST_VERSION:
-        raise StreamResumeError(
-            f"stream manifest {path} has version {m.get('version')}, "
-            f"this build expects {MANIFEST_VERSION}")
+            f"{path} is a {m.get('kind')!r} manifest — standing-query "
+            "resume needs a stream manifest (batch queries resume through "
+            "QueryService.recover_orphans / submit(resume_from=...))")
     return m
 
 
@@ -417,30 +364,11 @@ def apply_resume(graph, m: Dict, delivered_floor: Optional[int] = None) -> Dict:
         if hasattr(info.reader, "seed"):
             info.reader.seed(all_segments)
     # -- checkpointed exec channels: empty-tape replay restores the snapshot
+    # (shared surgery: re-based recovery point + history, IRT rows, EWT
+    # consumption watermarks, TapedExecutorTask — runtime/resume.py)
     for (a, ch), e in m["execs"].items():
         store.ntt_remove_channel(a, ch)
-        state_seq, out_seq, _old_tape = e["lct"]
-        reqs = {s: dict(c)
-                for s, c in e["irts"].get(state_seq, {}).items()}
-        with store.transaction():
-            # tape positions from the dead process are meaningless against
-            # the fresh (empty) tape: every recovery point re-bases to 0
-            store.tset("LCT", (a, ch), (state_seq, out_seq, 0))
-            for hist in e["ckpts"]:
-                store.tappend("LT", ("ckpts", a, ch),
-                              (hist[0], hist[1], 0))
-            for s, r in e["irts"].items():
-                store.tset("IRT", (a, ch, s),
-                           {src: dict(c) for src, c in r.items()})
-            # restore the consumption watermarks (EWT = consumed-1): the
-            # producer throttle compares ABSOLUTE seqs against EWT +
-            # max_pipeline, so a fresh store's -1 would deadlock any
-            # source whose checkpointed frontier is past the pipeline cap
-            for src, chans in reqs.items():
-                for sch, nxt in chans.items():
-                    store.tset("EWT", (src, sch, a, ch), nxt - 1)
-        store.ntt_push(a, TapedExecutorTask(
-            a, ch, state_seq, out_seq, state_seq, copy.deepcopy(reqs), 0))
+        state_seq, out_seq = _resume.seed_exec_channel(store, a, ch, e)
         report["execs"][(a, ch)] = {"state_seq": state_seq,
                                     "out_seq": out_seq}
     # -- unmanifested exec channels (sinks / stateless passthroughs): their

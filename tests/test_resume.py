@@ -1,0 +1,352 @@
+"""Durable batch queries (ISSUE 19): crash-consistent resume manifests,
+supervisor re-admission, and first-class cancellation/deadlines.
+
+Acceptance pins: manifest roundtrip known-answers + loud ``ManifestMismatch``
+on tamper/drift; orphan re-admission goes through NORMAL admission (FIFO, no
+barging) and a duplicate resume of a LIVE query is refused; ``attach()``
+drains exactly the undelivered tail past a client cursor; cancel and deadline
+leave ZERO residue (namespace rows, spill/checkpoint/manifest files,
+admission bytes); the resume fingerprint is restart-stable; the startup
+janitor quarantines unreadable/foreign manifests instead of wedging.  The
+actual SIGKILL-the-process path is exercised by
+``quokka_tpu/service/resume_smoke.py`` (``make resume-smoke``) and the chaos
+soak's ``batch-resume`` mode — these tests pin the in-process contracts.
+"""
+
+import os
+import pickle
+import shutil
+import time
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+from quokka_tpu import QuokkaContext, obs
+from quokka_tpu.dataset.readers import InputArrowDataset
+from quokka_tpu.runtime import integrity, scancache
+from quokka_tpu.runtime import resume as bresume
+from quokka_tpu.runtime.engine import TaskGraph
+from quokka_tpu.runtime.tables import ControlStore
+from quokka_tpu.service import (
+    DeadlineExceeded,
+    QueryCancelled,
+    QueryService,
+)
+
+
+@pytest.fixture(autouse=True)
+def fresh_scan_cache():
+    scancache.clear()
+    yield
+    scancache.clear()
+
+
+FT_CFG = {"fault_tolerance": True, "checkpoint_interval": 2}
+
+
+def _small_table(n=8192, seed=0):
+    r = np.random.default_rng(seed)
+    # integer-valued floats: sums are order-exact, so a resumed/re-run query
+    # must match the serial answer byte-for-byte
+    return pa.table({"k": r.integers(0, 16, n).astype(np.int64),
+                     "v": r.integers(0, 1000, n).astype(np.float64)})
+
+
+class _SlowDS(InputArrowDataset):
+    """Arrow reader with a per-lineage delay — a deterministic long-running
+    query that stays in flight long enough to cancel/expire/queue behind."""
+
+    def __init__(self, table, batch_rows=512, delay_s=0.05):
+        super().__init__(table, batch_rows=batch_rows)
+        self.delay_s = delay_s
+
+    def execute(self, channel, lineage):
+        time.sleep(self.delay_s)
+        return super().execute(channel, lineage)
+
+
+def _q(ctx, table, delay_s=None):
+    ds = (InputArrowDataset(table, batch_rows=512) if delay_s is None
+          else _SlowDS(table, delay_s=delay_s))
+    return ctx.read_dataset(ds).groupby("k").agg_sql(
+        "sum(v) as sv, count(*) as n")
+
+
+def _ft_ctx():
+    ctx = QuokkaContext()
+    for k, v in FT_CFG.items():
+        ctx.set_config(k, v)
+    return ctx
+
+
+def _sorted(df, by=("k",)):
+    return df.sort_values(list(by)).reset_index(drop=True)
+
+
+def _truth(table):
+    return (table.to_pandas().groupby("k")
+            .agg(sv=("v", "sum"), n=("v", "count")).reset_index())
+
+
+def _exact(got, table):
+    want = _truth(table)
+    got = _sorted(got)[list(want.columns)]
+    got = got.astype({c: want[c].dtype for c in want.columns})
+    pd.testing.assert_frame_equal(got, want, check_exact=True)
+
+
+def _no_namespace_rows(store: ControlStore, query_id: str) -> bool:
+    for t in store.tables.values():
+        if isinstance(t, set):
+            if any(isinstance(m, tuple) and len(m) == 2 and m[0] == query_id
+                   for m in t):
+                return False
+        elif any(isinstance(k, tuple) and len(k) == 2 and k[0] == query_id
+                 for k in t):
+            return False
+    return all(not (isinstance(k, tuple) and query_id in k)
+               for k in store.kv)
+
+
+def _files_mentioning(root: str, query_id: str):
+    hits = []
+    for dirpath, _dirs, names in os.walk(root):
+        hits += [os.path.join(dirpath, n) for n in names if query_id in n]
+    return hits
+
+
+class TestManifestRoundtrip:
+    def test_known_answer_roundtrip_and_drift_is_loud(self, tmp_path):
+        """The framed manifest is a stable known-answer format: what update
+        writes, load returns field-for-field — and every drift axis (frame
+        bytes, version, kind) fails loudly as ManifestMismatch."""
+        m = {
+            "version": bresume.MANIFEST_VERSION,
+            "kind": "batch",
+            "query_id": "q-known",
+            "plan_fp": "ab12cd34ef56ab78",
+            "written_at": 1234.5,
+            "execs": {(1, 0): {"lct": (4, 7, 9), "ckpts": [(2, 3, 5)],
+                               "irts": {4: {0: {0: 7}}},
+                               "tape": [("exec", 0, [], True)],
+                               "tape_base": 0}},
+            "sinks": {(2, 0): 3},
+            "est_bytes": 1 << 20,
+            "plan_blob": b"opaque",
+        }
+        path = str(tmp_path / "batch-q-known.manifest")
+        integrity.write_framed_atomic(path, pickle.dumps(m), site="manifest")
+        assert bresume.load(path) == m
+
+        # frame tamper: flip bytes in the middle of the payload
+        raw = bytearray(open(path, "rb").read())
+        raw[len(raw) // 2] ^= 0xFF
+        bad = str(tmp_path / "batch-q-tamper.manifest")
+        with open(bad, "wb") as f:
+            f.write(bytes(raw))
+        with pytest.raises(bresume.ManifestMismatch):
+            bresume.load(bad)
+
+        # version drift
+        vdrift = str(tmp_path / "batch-q-vdrift.manifest")
+        integrity.write_framed_atomic(
+            vdrift, pickle.dumps({**m, "version": 999}), site="manifest")
+        with pytest.raises(bresume.ManifestMismatch):
+            bresume.load(vdrift)
+
+        # a STREAM manifest is not resumable as a batch query
+        sdrift = str(tmp_path / "batch-q-sdrift.manifest")
+        integrity.write_framed_atomic(
+            sdrift, pickle.dumps({**m, "kind": "stream"}), site="manifest")
+        with pytest.raises(bresume.ManifestMismatch):
+            bresume.load(sdrift)
+
+    def test_durable_submit_writes_manifest_and_clean_finish_deletes(self):
+        """Lifecycle hygiene: the manifest exists from submit (a crash
+        before the first checkpoint still re-admits), tracks the real
+        graph's fingerprint, and a clean finish deletes it — only process
+        death leaves an orphan."""
+        table = _small_table(seed=1)
+        with QueryService(pool_size=2, exec_config=FT_CFG) as svc:
+            h = svc.submit(_q(QuokkaContext(), table, delay_s=0.03),
+                           durable=True)
+            path = h.manifest_path
+            assert path and os.path.exists(path)
+            m = bresume.load(path)
+            assert m["kind"] == "batch" and m["query_id"] == h.query_id
+            assert m["plan_fp"] == bresume.structural_fingerprint(
+                h._s.graph)
+            # no checkpoint yet: an empty frontier re-admits as a fresh
+            # run, but the plan payload must be there from the start
+            assert m["plan_blob"]
+            _exact(h.to_df(timeout=300), table)
+            assert not os.path.exists(path), "clean finish must delete it"
+            assert _no_namespace_rows(svc.store, h.query_id)
+
+
+class TestSupervisor:
+    def test_orphan_readmits_fifo_and_live_duplicate_refused(self, tmp_path):
+        """An orphaned manifest re-admits through NORMAL admission — FIFO
+        behind anything already queued, no barging — and resuming a query
+        that is already LIVE in the service is refused loudly."""
+        table = _small_table(seed=2)
+        mb = 1 << 20
+        # incarnation A: durable submit, snapshot the manifest as a crashed
+        # process would have left it, then let A finish cleanly
+        a_dir, b_dir = str(tmp_path / "a"), str(tmp_path / "b")
+        orphan = str(tmp_path / "orphan.manifest")
+        with QueryService(pool_size=2, exec_config=FT_CFG,
+                          spill_dir=a_dir) as svc_a:
+            h = svc_a.submit(_q(QuokkaContext(), table, delay_s=0.03),
+                             durable=True, working_set_bytes=40 * mb)
+            shutil.copy(h.manifest_path, orphan)
+            orphan_qid = h.query_id
+            _exact(h.to_df(timeout=300), table)
+        # incarnation B: budget fits two 40 MiB queries; q3 queues FIRST,
+        # then the orphan must line up BEHIND it
+        with QueryService(pool_size=2, mem_budget=100 * mb,
+                          admit_timeout=120, exec_config=FT_CFG,
+                          spill_dir=b_dir) as svc:
+            ckpt = os.path.join(svc._spill_dir, "ckpt")
+            os.makedirs(ckpt, exist_ok=True)
+            shutil.copy(orphan,
+                        os.path.join(ckpt,
+                                     f"batch-{orphan_qid}.manifest"))
+            running = [svc.submit(_q(QuokkaContext(), table, delay_s=0.05),
+                                  working_set_bytes=40 * mb)
+                       for _ in range(2)]
+            queued = svc.submit(_q(QuokkaContext(), table, delay_s=0.05),
+                                working_set_bytes=40 * mb)
+            st = svc.stats()["admission"]
+            assert len(st["waiting"]) == 1, st
+            before = obs.REGISTRY.counter("resume.orphans").value
+            handles = svc.recover_orphans()
+            assert [h.query_id for h in handles] == [orphan_qid]
+            assert obs.REGISTRY.counter("resume.orphans").value \
+                == before + 1
+            waiting = [w[0] for w in svc.stats()["admission"]["waiting"]]
+            assert waiting == [queued.query_id, orphan_qid], \
+                "the orphan must not barge past already-queued work"
+            assert handles[0].status == "queued"
+            # duplicate resume of the LIVE orphan is refused loudly
+            with pytest.raises(ValueError, match="already running"):
+                svc.submit(_q(_ft_ctx(), table),
+                           resume_from=handles[0].manifest_path)
+            for h in running + [queued] + handles:
+                _exact(h.to_df(timeout=300), table)
+            assert svc.stats()["admission"]["used_bytes"] == 0
+            assert _no_namespace_rows(svc.store, orphan_qid)
+
+    def test_attach_cursor_drains_exactly_the_tail(self):
+        """attach(query_id, cursor=...) seeds the delivery cursor: the
+        first poll_batches drains exactly the batches the client has not
+        durably captured — nothing re-surfaces, nothing is skipped."""
+        table = _small_table(seed=3)
+        with QueryService(pool_size=2, exec_config=FT_CFG) as svc:
+            h = svc.submit(_q(QuokkaContext(), table), durable=True)
+            h.wait(300)
+            full = svc.attach(h.query_id).poll_batches()
+            assert full, "a finished query must expose its batches"
+            ch0, seq0, _t = full[0]
+            tail = svc.attach(h.query_id,
+                              cursor={ch0: seq0}).poll_batches()
+            assert all(s > seq0 for c, s, _t in tail if c == ch0)
+            assert ({(c, s) for c, s, _t in tail}
+                    == {(c, s) for c, s, _t in full} - {(ch0, seq0)})
+            # a fully caught-up cursor drains nothing
+            done = {c: max(s for cc, s, _t in full if cc == c)
+                    for c, _s, _t in full}
+            assert svc.attach(h.query_id, cursor=done).poll_batches() == []
+
+
+class TestCancelAndDeadline:
+    def test_cancel_releases_bytes_and_leaves_zero_residue(self):
+        table = _small_table(seed=4)
+        with QueryService(pool_size=2, exec_config=FT_CFG) as svc:
+            before = obs.REGISTRY.counter("cancel.requested").value
+            h = svc.submit(_q(QuokkaContext(), table, delay_s=0.05),
+                           durable=True, working_set_bytes=8 << 20)
+            manifest = h.manifest_path
+            deadline = time.time() + 30
+            while h.status != "running" and time.time() < deadline:
+                time.sleep(0.01)
+            h.cancel(wait=True, timeout=60)
+            with pytest.raises(QueryCancelled):
+                h.result(timeout=60)
+            assert obs.REGISTRY.counter("cancel.requested").value \
+                > before
+            assert svc.stats()["admission"]["used_bytes"] == 0
+            assert _no_namespace_rows(svc.store, h.query_id)
+            assert not os.path.exists(manifest)
+            assert _files_mentioning(svc._spill_dir, h.query_id) == []
+
+    def test_deadline_is_named_and_leaves_zero_residue(self):
+        table = _small_table(seed=5)
+        with QueryService(pool_size=2, exec_config=FT_CFG) as svc:
+            before = obs.REGISTRY.counter("cancel.deadline").value
+            h = svc.submit(_q(QuokkaContext(), table, delay_s=0.05),
+                           durable=True, working_set_bytes=8 << 20,
+                           deadline_s=0.4)
+            manifest = h.manifest_path
+            with pytest.raises(DeadlineExceeded):
+                h.result(timeout=120)
+            assert obs.REGISTRY.counter("cancel.deadline").value > before
+            assert svc.stats()["admission"]["used_bytes"] == 0
+            assert _no_namespace_rows(svc.store, h.query_id)
+            assert not os.path.exists(manifest)
+            assert _files_mentioning(svc._spill_dir, h.query_id) == []
+
+
+class TestFingerprintStability:
+    def test_structural_fingerprint_survives_pickled_relowering(self):
+        """The QK025 pin: pickling the prepared plan and re-lowering it in
+        a FRESH context/graph/store (what recover_orphans does after a
+        restart) reproduces the submit-time structural fingerprint, and no
+        part smuggles a memory address in."""
+        table = _small_table(seed=6)
+        qc = _ft_ctx()
+        ds = _q(qc, table)
+        sub, sink_id = qc._prepare_plan(ds.node_id)
+        blob = pickle.dumps({"sub": sub, "sink_id": sink_id,
+                             "exec_channels": qc.exec_channels})
+        g0 = TaskGraph(qc.exec_config, store=ControlStore())
+        qc._lower_plan(sub, sink_id, g0)
+        fps = {bresume.structural_fingerprint(g0)}
+        assert not any("0x" in p for p in bresume.structural_parts(g0))
+        for _ in range(2):
+            payload = pickle.loads(blob)
+            ctx = QuokkaContext()
+            ctx.exec_channels = payload["exec_channels"]
+            g = TaskGraph(ctx.exec_config, store=ControlStore())
+            ctx._lower_plan(payload["sub"], payload["sink_id"], g)
+            fps.add(bresume.structural_fingerprint(g))
+        assert len(fps) == 1, fps
+
+
+class TestStartupJanitor:
+    def test_unreadable_and_foreign_manifests_are_quarantined(self,
+                                                              tmp_path):
+        """recover_orphans never wedges on a bad manifest: unreadable bytes
+        and a well-framed manifest with no plan payload are both moved to
+        ``.corrupt`` and counted on resume.quarantined."""
+        d = str(tmp_path / "ckpt")
+        os.makedirs(d)
+        junk = os.path.join(d, "batch-junk.manifest")
+        with open(junk, "wb") as f:
+            f.write(b"not a framed manifest at all")
+        feed = os.path.join(d, "batch-feed.manifest")
+        integrity.write_framed_atomic(feed, pickle.dumps({
+            "version": bresume.MANIFEST_VERSION, "kind": "batch",
+            "query_id": "q-feed", "plan_fp": "ab12cd34ef56ab78",
+            "execs": {}, "sinks": {}, "est_bytes": None,
+            "plan_blob": None,
+        }), site="manifest")
+        before = obs.REGISTRY.counter("resume.quarantined").value
+        with QueryService(pool_size=1, exec_config=FT_CFG) as svc:
+            assert svc.recover_orphans(manifest_dir=d) == []
+        assert obs.REGISTRY.counter("resume.quarantined").value \
+            == before + 2
+        for p in (junk, feed):
+            assert not os.path.exists(p) and os.path.exists(p + ".corrupt")
