@@ -4,7 +4,7 @@
 PY ?= python
 export JAX_PLATFORMS ?= cpu
 
-.PHONY: lint lint-baseline verify-static plan-fuzz test test-slow sanitize-demo service-smoke chaos-smoke obs-smoke bench-check bench-trend shuffle-smoke fusion-smoke warmup-smoke multichip-smoke stream-smoke mem-smoke explain-smoke health-smoke adapt-smoke resume-smoke durability-smoke
+.PHONY: lint lint-baseline verify-static plan-fuzz test test-slow sanitize-demo service-smoke chaos-smoke obs-smoke bench-check bench-trend shuffle-smoke fusion-smoke warmup-smoke multichip-smoke stream-smoke mem-smoke explain-smoke health-smoke adapt-smoke resume-smoke durability-smoke devprof-smoke verify
 
 # engine-invariant static analysis; exits nonzero on findings beyond the
 # checked-in baseline (quokka_tpu/analysis/baseline.json)
@@ -136,6 +136,15 @@ mem-smoke:
 explain-smoke:
 	$(PY) -m quokka_tpu.obs.explain_smoke
 
+# device-profiling smoke: a Q3-shaped service query under an isolated
+# devprof dir — calibrated peaks persisted per backend fingerprint (foreign
+# fingerprints rejected wholesale), static flops/bytes figures for EVERY
+# compiled program (fused stages included), finite roofline efficiency for
+# every attributed operator, ZERO added host syncs, and a warm re-plan
+# whose broadcast decision quotes a seconds(roofline)-basis estimate
+devprof-smoke:
+	$(PY) -m quokka_tpu.obs.devprof_smoke
+
 # adaptive-planning smoke: a cold plan decides from hints/samples, the warm
 # re-plan must FLIP >= 1 decision from the persisted cardinality profile
 # (measured basis, visible in explain's planner-decision section), a seeded
@@ -181,3 +190,8 @@ health-smoke:
 # slow leak each individual bench-check stayed inside its threshold on
 bench-trend:
 	$(PY) bench.py --trend $(TREND_ARGS)
+
+# the pre-merge aggregate: static analysis, tier-1 tests, and the
+# observability smokes a PR most often touches.  Heavier planes (chaos,
+# resume, streaming, multichip) keep their own entry points above.
+verify: verify-static test explain-smoke devprof-smoke

@@ -589,6 +589,22 @@ def _operators_detail():
         return None
 
 
+def _efficiency_detail():
+    """Device-efficiency digest of the most recently finished query
+    (obs/devprof.py figures attached to the opstats snapshot at query GC):
+    calibrated peaks + per-operator achieved FLOP/s, bandwidth and
+    roofline %%.  None when the plane saw nothing."""
+    try:
+        from quokka_tpu.obs import explain as obs_explain
+        from quokka_tpu.obs import opstats as obs_opstats
+
+        return obs_explain.efficiency_detail(
+            obs_opstats.OPSTATS.last_finished())
+    except Exception as e:  # noqa: BLE001 — stats must not kill the bench
+        sys.stderr.write(f"bench: efficiency detail unavailable: {e!r}\n")
+        return None
+
+
 def _progress_detail():
     """Final progress snapshot of the most recently finished query (the
     health plane stashes it at query GC, same discipline as the opstats
@@ -737,6 +753,13 @@ def measure(paths):
     # bench platform — the permanent fix for measuring a path the target
     # backend never runs (VERDICT r5 #2).
     kstrategy.ensure_calibrated()
+    # device-profile peaks (obs/devprof.py): calibrate alongside the kernel
+    # strategy matrix — same fingerprint discipline, same pre-query timing
+    # so the microbench compiles never count as query warmup.  Each benched
+    # line then carries detail.efficiency (achieved vs roofline).
+    from quokka_tpu.obs import devprof as qk_devprof
+
+    qk_devprof.ensure_calibrated()
     strategy_meta = {"choices": kstrategy.choices(),
                      "sources": kstrategy.sources()}
     sys.stderr.write(f"bench: kernel strategies {strategy_meta['choices']} "
@@ -891,6 +914,10 @@ def measure(paths):
             # of FusedStage operators that dispatched (`--check` gates the
             # join lines on this being >= 1)
             "fused_stages": _fused_stages(ops_detail),
+            # device-efficiency digest of the last timed run
+            # (obs/devprof.py): peaks + per-operator roofline %.  `--check`
+            # treats a missing block on join/asof lines as a regression.
+            "efficiency": _efficiency_detail(),
             # health plane: the progress estimator's final snapshot for the
             # last timed run (obs/progress.py, stashed at query GC)
             "progress": _progress_detail(),
@@ -962,6 +989,7 @@ def measure(paths):
                 "strategy": kstrategy.used_snapshot(),
                 "operators": asof_ops,
                 "fused_stages": _fused_stages(asof_ops),
+                "efficiency": _efficiency_detail(),
             },
         }))
         sys.stdout.flush()
@@ -1014,6 +1042,27 @@ def measure(paths):
             "strategy_matrix": strategy_meta,
         },
     }))
+    # roofline-efficiency geomean across every attributed operator of the
+    # benched queries (obs/devprof.py): the one number `--trend` tracks for
+    # "is the engine getting more or less out of the device per round"
+    effs = [r["efficiency"] for q in per_query.values()
+            for r in ((q.get("efficiency") or {}).get("operators") or ())
+            if r.get("efficiency")]
+    if effs:
+        eff_geo = math.exp(sum(math.log(e) for e in effs) / len(effs))
+        print(json.dumps({
+            "metric": "devprof_efficiency_geomean",
+            "value": round(eff_geo, 6),
+            "unit": "frac",
+            "vs_baseline": round(eff_geo, 6),
+            "detail": {
+                "operators": len(effs),
+                "platform": platform,
+                "peaks": next((q["efficiency"]["peaks"]
+                               for q in per_query.values()
+                               if q.get("efficiency")), None),
+            },
+        }))
 
 
 def probe_tpu(attempts: int = 2, timeout: int = 150, backoff: int = 20) -> bool:
@@ -1194,6 +1243,46 @@ def check_operators_presence(cur, require):
                          "benched line records no detail.operators — the "
                          "EXPLAIN ANALYZE ledger saw nothing for this "
                          "query (opstats regression)"))
+            bad.append(name)
+    return rows, bad
+
+
+def check_efficiency_presence(cur, require):
+    """Device-efficiency honesty rows: fresh join/asof lines must carry the
+    ``detail.efficiency`` block (obs/devprof.py peaks + per-operator
+    roofline figures) when ``require`` (fresh runs, whose emitter we
+    control — bench --measure calibrates the peaks itself).  A missing
+    block means the device-profile plane went blind on that query — same
+    presence discipline as strategy/operators.  Returns (rows,
+    violations)."""
+    rows, bad = [], []
+    if not require:
+        return rows, bad
+
+    def _efficiency(d):
+        detail = d.get("detail") or {}
+        if detail.get("efficiency"):
+            return detail["efficiency"]
+        for qd in (detail.get("queries") or {}).values():
+            if isinstance(qd, dict) and qd.get("efficiency"):
+                return qd["efficiency"]
+        return None
+
+    for metric in STRATEGY_REQUIRED_METRICS:
+        if metric not in cur:
+            continue
+        name = f"efficiency[{metric}]"
+        eff = _efficiency(cur[metric])
+        if eff:
+            n = len(eff.get("operators") or []) if isinstance(eff, dict) \
+                else 0
+            rows.append((name, "ok",
+                         f"devprof present ({n} operator(s))"))
+        else:
+            rows.append((name, "MISSING",
+                         "benched line records no detail.efficiency — the "
+                         "device-profile plane saw nothing for this query "
+                         "(devprof regression)"))
             bad.append(name)
     return rows, bad
 
@@ -1655,6 +1744,11 @@ def check_main(argv):
     o_rows, o_bad = check_operators_presence(
         cur, require=(args.current is None))
     regressed += o_bad
+    # device-efficiency honesty: fresh join/asof lines must carry the
+    # devprof digest (detail.efficiency) — same presence discipline
+    e_rows, e_bad = check_efficiency_presence(
+        cur, require=(args.current is None))
+    regressed += e_bad
     # whole-stage-fusion honesty: fresh join lines must show the fused
     # plan actually dispatched (detail.fused_stages >= 1)
     f_rows, f_bad = check_fused_stages_presence(
@@ -1665,7 +1759,7 @@ def check_main(argv):
     k_rows, k_bad = check_skewjoin_gate(
         cur, require=(args.current is None))
     regressed += k_bad
-    s_rows = s_rows + o_rows + f_rows + k_rows
+    s_rows = s_rows + o_rows + e_rows + f_rows + k_rows
     out = sys.stdout
     out.write(f"bench --check: {cur_src} vs {against}\n")
     if base_truncated:

@@ -103,6 +103,14 @@ Rule ids:
                                 at once.  Snapshot under the lock, do the
                                 I/O outside (obs/progress.py
                                 ``_profile_for`` is the pattern)
+  QK027 adhoc-wall-timing       bare ``time.time()``/``time.perf_counter()``
+                                deltas used for timing outside ``obs/`` and
+                                bench.py — a hand-rolled timer is invisible
+                                to the span aggregator (obs/spans.py), the
+                                flight recorder and the bench breakdown;
+                                durations route through obs.span()/
+                                spans.add(), deliberate low-level sites
+                                baseline with a rationale
 
 Finding keys (``Finding.key``) are line-number-free — ``rule::relpath::
 scope::snippet[::n]`` — so a baseline survives unrelated edits above the
@@ -1777,6 +1785,90 @@ def check_obs_lock_blocking_io(tree: ast.Module, path: str, rel: str,
 check_obs_lock_blocking_io._needs_flow = True
 
 
+# ---------------------------------------------------------------------------
+# QK027 — ad-hoc wall timing outside the obs plane
+# ---------------------------------------------------------------------------
+
+# the clock calls whose subtraction means "someone hand-rolled a timer"
+_QK027_TIMER_CALLS = ("time.time", "time.perf_counter", "perf_counter")
+# the obs plane OWNS timing (spans, opstats, critpath, history, devprof);
+# bench.py is the other sanctioned owner but lives outside quokka_tpu/ and
+# is never scanned
+_QK027_EXEMPT_DIRS = ("quokka_tpu/obs/",)
+
+
+def _qk027_is_timer_call(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and _dotted(node.func) in _QK027_TIMER_CALLS)
+
+
+def _qk027_own_nodes(scope: ast.AST):
+    """The scope's own statements/expressions, not descending into nested
+    function bodies (their clock names are a different scope)."""
+    stack = list(scope.body)
+    while stack:
+        n = stack.pop()
+        yield n
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def check_adhoc_wall_timing(tree: ast.Module, path: str, rel: str,
+                            src_lines: Sequence[str]) -> List[Finding]:
+    """Flags bare wall-clock deltas used for timing outside the obs plane:
+    a name assigned from ``time.time()``/``time.perf_counter()`` and later
+    subtracted (``t1 - t0``, ``time.perf_counter() - t0``).  A hand-rolled
+    timer is invisible to the span aggregator (``obs/spans.py``), the
+    flight recorder and the bench breakdown — the measurement exists only
+    in whatever local variable it landed in, which is exactly how the
+    engine accumulated three private timing idioms before PR 13.  Route
+    durations through ``obs.span()``/``obs.spans.add()`` (they also land
+    in the merged timeline) or baseline deliberate low-level sites with a
+    rationale.  Deadline arithmetic (``deadline - time.monotonic()``) is
+    not flagged: both operands must be clock readings."""
+    r = rel.replace("\\", "/")
+    base = r.rsplit("/", 1)[-1]
+    if not base.startswith("qk027"):
+        if ("quokka_tpu/" not in r
+                or any(d in r for d in _QK027_EXEMPT_DIRS)):
+            return []
+    out: List[Finding] = []
+    scopes = [tree] + [n for n in ast.walk(tree)
+                       if isinstance(n, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))]
+    for scope in scopes:
+        own = list(_qk027_own_nodes(scope))
+        clock_names: Set[str] = set()
+        for n in own:
+            if isinstance(n, ast.Assign) and _qk027_is_timer_call(n.value):
+                for t in n.targets:
+                    if isinstance(t, ast.Name):
+                        clock_names.add(t.id)
+        if not clock_names and not any(_qk027_is_timer_call(n)
+                                       for n in own):
+            continue
+
+        def _clockish(x: ast.AST) -> bool:
+            return (_qk027_is_timer_call(x)
+                    or (isinstance(x, ast.Name) and x.id in clock_names))
+
+        for n in own:
+            if (isinstance(n, ast.BinOp) and isinstance(n.op, ast.Sub)
+                    and _clockish(n.left) and _clockish(n.right)):
+                out.append(_mk(
+                    "QK027", "adhoc-wall-timing", path, rel, n,
+                    _scope_of(tree, n),
+                    "bare wall-clock delta — a hand-rolled timer is "
+                    "invisible to the span aggregator, the flight "
+                    "recorder and the bench breakdown; route the "
+                    "duration through obs.span()/obs.spans.add() "
+                    "(obs/spans.py), or baseline with a rationale",
+                    src_lines))
+    return out
+
+
 RULES = (
     check_module_level_jit,
     check_import_time_side_effects,
@@ -1795,6 +1887,7 @@ RULES = (
     check_adhoc_operator_tally,
     check_multi_program_chain,
     check_obs_lock_blocking_io,
+    check_adhoc_wall_timing,
 )
 
 

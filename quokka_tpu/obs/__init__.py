@@ -42,6 +42,7 @@ import sys
 from quokka_tpu.obs import (
     alerts,
     critpath,
+    devprof,
     explain,
     export,
     history,

@@ -1,0 +1,247 @@
+"""Device-profiling smoke: the roofline plane closes end-to-end.
+
+    python -m quokka_tpu.obs.devprof_smoke      (or: make devprof-smoke)
+
+One process, five proofs over a seeded Q3-shaped join+aggregate submitted
+through the QueryService:
+
+1. **calibrated peaks per fingerprint** — ``devprof.calibrate()``
+   persists ``{peak_flops_s, peak_bw_bytes_s}`` under this backend's
+   fingerprint and reloads it after a process-state reset; a profile
+   carrying a FOREIGN fingerprint is rejected wholesale;
+2. **every program costed** — every AOT program the query compiled (the
+   whole-stage-fused ones included) carries static flops/bytes figures
+   from ``compiled.cost_analysis()``;
+3. **finite roofline efficiency per hot operator** — the explain
+   snapshot's ``efficiency`` section reports a finite roofline fraction
+   for every attributed operator, and the rendered EXPLAIN ANALYZE
+   shows the device-efficiency section;
+4. **zero added host syncs** — costing + attribution ride the dispatch
+   path without a single new ``shuffle.host_syncs``;
+5. **seconds-basis planning on the warm re-plan** — a warm variant of
+   the query (same dim build side, fresh fact predicate) plans against
+   the measured build cardinality AND the calibrated bandwidth: its
+   broadcast decision record quotes predicted device seconds, with the
+   fresh probe side converting as a literal ``seconds(roofline)`` basis.
+
+Exit nonzero on any violation, with the observed figures printed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+from typing import Optional
+
+
+def _make_tables(tmp: str, seed: int = 20260807):
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    r = np.random.default_rng(seed)
+    n_fact, n_dim = 200_000, 20_000
+    fact = pa.table({
+        "fk": r.integers(0, n_dim, n_fact).astype(np.int64),
+        "v": r.integers(0, 1000, n_fact).astype(np.int64),
+        "flag": r.integers(0, 4, n_fact).astype(np.int64),
+    })
+    dim = pa.table({
+        "pk": np.arange(n_dim, dtype=np.int64),
+        "grp": r.integers(0, 64, n_dim).astype(np.int64),
+    })
+    fp = os.path.join(tmp, "fact.parquet")
+    dp = os.path.join(tmp, "dim.parquet")
+    pq.write_table(fact, fp, row_group_size=1 << 16)
+    pq.write_table(dim, dp)
+    return fp, dp
+
+
+def _query(ctx, fp, dp, flag_lt=3):
+    from quokka_tpu.expression import col
+
+    fact = ctx.read_parquet(fp)
+    dim = ctx.read_parquet(dp)
+    return (
+        fact.filter(col("flag") < flag_lt)
+        .join(dim, left_on="fk", right_on="pk")
+        .groupby("grp")
+        .agg_sql("sum(v) as sv, count(*) as n")
+    )
+
+
+def _efficiency_violation(snap, rendered: str) -> Optional[str]:
+    """Proof 3: attributed operators carry finite roofline figures and the
+    rendering surfaces them."""
+    import math
+
+    eff = snap.get("efficiency") or {}
+    rows = eff.get("operators") or []
+    if not rows:
+        return ("no operators were attributed any program cost — the "
+                "dispatch funnel recorded nothing")
+    if not eff.get("peaks"):
+        return "efficiency section carries no calibrated peaks"
+    for r in rows:
+        e = r.get("efficiency")
+        if e is None or not math.isfinite(e) or e <= 0:
+            return (f"operator a{r['actor']} ({r['op']}) has non-finite "
+                    f"roofline efficiency {e!r} despite calibrated peaks")
+    if "device efficiency" not in rendered:
+        return "rendered EXPLAIN ANALYZE carries no device-efficiency section"
+    return None
+
+
+def main() -> int:  # noqa: C901 — linear proof script, explain_smoke idiom
+    devprof_dir = tempfile.mkdtemp(prefix="qk-devprof-")
+    env_overrides = {
+        # isolate every profile this smoke writes or reads
+        "QK_DEVPROF_DIR": devprof_dir,
+        "QK_CARDPROFILE_DIR": tempfile.mkdtemp(prefix="qk-cardprofile-"),
+        "QK_MEMPROFILE_DIR": "",
+        # fresh AOT store: every program compiles (and is costed) this run
+        "QUOKKA_AOT_CACHE_DIR": tempfile.mkdtemp(prefix="qk-aot-"),
+    }
+    saved = {k: os.environ.get(k) for k in env_overrides}
+    os.environ.update(env_overrides)
+
+    def fail(msg: str) -> int:
+        sys.stderr.write(f"devprof-smoke: FAIL — {msg}\n")
+        return 1
+
+    try:
+        from quokka_tpu import QuokkaContext, obs
+        from quokka_tpu.obs import devprof
+        from quokka_tpu.runtime import compileplane
+        from quokka_tpu.service import QueryService
+
+        devprof.reset()
+
+        # -- proof 1: calibration persists per fingerprint ----------------
+        prof = devprof.ensure_calibrated()
+        if not prof:
+            return fail("ensure_calibrated produced no profile")
+        fpr = prof["fingerprint"]
+        path = os.path.join(devprof_dir, f"{fpr}.json")
+        if not os.path.exists(path):
+            return fail(f"no profile persisted at {path}")
+        devprof.reset()
+        reloaded = devprof.peaks()
+        if not reloaded or reloaded["peak_flops_s"] != prof["peak_flops_s"]:
+            return fail("persisted profile did not survive a state reset")
+        print(f"devprof-smoke: calibrated {fpr}: "
+              f"peak_flops={prof['peak_flops_s']:.3g}/s "
+              f"peak_bw={prof['peak_bw_bytes_s']:.3g}B/s")
+
+        # foreign fingerprint rejected wholesale
+        foreign = dict(reloaded, fingerprint="tpu-8x-deadbeef")
+        with open(path, "w") as f:
+            json.dump(foreign, f)
+        devprof.reset()
+        if devprof.peaks() is not None:
+            return fail("a foreign-fingerprint profile was accepted")
+        print("devprof-smoke: foreign-fingerprint profile rejected")
+        with open(path, "w") as f:
+            json.dump(reloaded, f)
+        devprof.reset()
+        if devprof.peaks() is None:
+            return fail("restored profile failed to reload")
+
+        with tempfile.TemporaryDirectory(prefix="qk-devprof-smoke-") as tmp:
+            fp, dp = _make_tables(tmp)
+            syncs0 = obs.REGISTRY.snapshot().get("shuffle.host_syncs", 0)
+            with QueryService(pool_size=2) as svc:
+                ctx = QuokkaContext(io_channels=2, exec_channels=2)
+                h1 = svc.submit(_query(ctx, fp, dp))
+                rows = h1.to_arrow(timeout=600)
+                if rows.num_rows <= 0:
+                    return fail("smoke query returned no rows")
+                snap = h1.explain(as_dict=True)
+                if not snap:
+                    return fail("no opstats snapshot survived the query GC")
+                rendered = h1.explain()
+                print(rendered)
+
+                # -- proof 2: every compiled program is costed ------------
+                uncosted = [k for k in compileplane.PROGRAMS
+                            if devprof.program_cost(k) is None]
+                ncost = len(compileplane.PROGRAMS) - len(uncosted)
+                if not compileplane.PROGRAMS:
+                    return fail("the query compiled no AOT programs")
+                if uncosted:
+                    return fail(
+                        f"{len(uncosted)}/{len(compileplane.PROGRAMS)} "
+                        "compiled program(s) carry no static cost figures: "
+                        + ", ".join(compileplane.key_hash(k)
+                                    for k in uncosted[:5]))
+                top = devprof.costs_snapshot()[0]
+                print(f"devprof-smoke: {ncost} program(s) costed; "
+                      f"heaviest {top['sig']}: flops={top['flops']:.3g} "
+                      f"bytes={top['bytes']:.3g} "
+                      f"dispatches={top['dispatches']}")
+
+                # -- proof 3: finite roofline efficiency ------------------
+                err = _efficiency_violation(snap, rendered)
+                if err:
+                    return fail(err)
+                effs = snap["efficiency"]["operators"]
+                print(f"devprof-smoke: roofline efficiency finite for "
+                      f"{len(effs)} attributed operator(s), worst "
+                      f"{min(r['efficiency'] for r in effs):.2%}")
+
+                # -- proof 4: zero added host syncs -----------------------
+                syncs = obs.REGISTRY.snapshot().get("shuffle.host_syncs",
+                                                    0) - syncs0
+                print(f"devprof-smoke: host_syncs delta {syncs}")
+                if syncs:
+                    return fail(f"costing + attribution cost {syncs} host "
+                                "sync(s) — the plane must never read a "
+                                "device value")
+
+                # -- proof 5: warm re-plan decides in seconds -------------
+                # a warm VARIANT (different fact predicate): the dim build
+                # side keeps its measured cardinality + scan seconds, the
+                # probe side's fresh signature has no measured seconds and
+                # must convert through the calibrated bandwidth — the
+                # decision record quotes a seconds(roofline)-basis figure
+                h2 = svc.submit(_query(QuokkaContext(io_channels=2,
+                                                     exec_channels=2),
+                                       fp, dp, flag_lt=2))
+                h2.result(timeout=600)
+                snap2 = h2.explain(as_dict=True)
+                rendered2 = h2.explain()
+                decisions = snap2.get("planner") or []
+                seconds_based = [
+                    d for d in decisions
+                    if "seconds(" in str(d.get("est_s_basis", ""))
+                    or "seconds(" in str(d.get("probe_s_basis", ""))]
+                if not seconds_based:
+                    return fail(
+                        "warm re-plan recorded no seconds-basis decision "
+                        f"(decisions: {decisions!r})")
+                d = seconds_based[0]
+                print("devprof-smoke: warm decision "
+                      f"{d.get('kind')}: broadcast_s={d.get('broadcast_s')} "
+                      f"partition_s={d.get('partition_s')} "
+                      f"[{d.get('est_s_basis')}, "
+                      f"probe {d.get('probe_s_basis')}]")
+                if "seconds(roofline)" not in rendered2:
+                    return fail("rendered warm EXPLAIN quotes no "
+                                "seconds(roofline)-basis figure")
+        print("devprof-smoke: OK — peaks calibrated+persisted (foreign "
+              "rejected), every program costed, roofline finite per "
+              "operator, zero added host syncs, warm re-plan decided in "
+              "predicted seconds")
+        return 0
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+if __name__ == "__main__":
+    sys.exit(main())

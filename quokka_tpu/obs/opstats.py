@@ -100,6 +100,10 @@ class OpStats:
         self._pending: List[tuple] = []
         # query_id -> per-query gauge names created (GC'd in on_query_gc)
         self._gauges: Dict[str, List[str]] = {}
+        # query_id -> worst edge skew ratio seen; the global shuffle.skew
+        # gauge is the max over LIVE queries (recomputed at GC so a
+        # /health skew alert clears without a process restart)
+        self._skew_worst: Dict[str, float] = {}
         # most recently finished query's snapshot (what bench reads after a
         # one-shot run's cleanup)
         self._last: Optional[dict] = None
@@ -347,6 +351,12 @@ class OpStats:
                 return last if last and last.get("query_id") == qid else None
             snap = self._render_locked(qid, plan, thresh, top_n)
         self._export_gauges(qid, snap)
+        # device-efficiency join (obs/devprof.py): static program costs vs
+        # the measured per-operator seconds above — outside the lock, no
+        # device reads
+        from quokka_tpu.obs import devprof
+
+        devprof.attach(qid, snap)
         return snap
 
     def _render_locked(self, qid: str, plan: dict, thresh: float,
@@ -467,11 +477,14 @@ class OpStats:
             if qid not in self._plans:
                 return  # GC'd between render and export: do not resurrect
             self._gauges[qid] = [name for name, _ in pairs]
+            self._skew_worst[qid] = max(self._skew_worst.get(qid, 0.0),
+                                        worst)
+            live_worst = max(self._skew_worst.values(), default=0.0)
         for name, value in pairs:
             obs.REGISTRY.gauge(name).set(value)
-        if worst:
-            g = obs.REGISTRY.gauge("shuffle.skew")
-            g.set(max(g.value, worst))
+        # max over LIVE queries, not a process-lifetime ratchet: the gauge
+        # falls back to 0 once the skewed query GCs (on_query_gc recomputes)
+        obs.REGISTRY.gauge("shuffle.skew").set(live_worst)
 
     def top_operator(self, qid: str) -> Optional[str]:
         """One-line hottest-operator label for /status (non-creating; falls
@@ -575,11 +588,21 @@ class OpStats:
                 del self._notes[key]
             self._pending = [p for p in self._pending if p[1][0] != qid]
             gauges = self._gauges.pop(qid, [])
+            self._skew_worst.pop(qid, None)
+            live_worst = max(self._skew_worst.values(), default=0.0)
             self._last = snap
         from quokka_tpu import obs
 
         if gauges:
             obs.REGISTRY.remove(*gauges)
+        # per-query epoch reset: with the skewed query gone the global max
+        # drops to the worst LIVE query (0 when idle), so /health alerts
+        # clear without a restart
+        obs.REGISTRY.gauge("shuffle.skew").set(live_worst)
+        from quokka_tpu.obs import devprof
+
+        devprof.on_query_finished(qid, plan_fp or (plan or {}).get("plan_fp"),
+                                  snap or {})
         fp = plan_fp or (plan or {}).get("plan_fp")
         if snap is not None:
             record_cardinalities(fp, snap)
@@ -603,6 +626,7 @@ class OpStats:
             self._notes.clear()
             self._pending.clear()
             self._gauges.clear()
+            self._skew_worst.clear()
             self._last = None
 
 

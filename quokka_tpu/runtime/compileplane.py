@@ -576,6 +576,10 @@ def acquire(key: Tuple, builder: Callable[[], object], args,
             # rebuilds from its own CURRENT builder.
             prog = AotProgram(loaded[1])
             PROGRAMS[key] = prog
+            from quokka_tpu.obs import devprof
+
+            # replay the persisted static-cost sidecar (no re-analysis)
+            devprof.load_cost(key, path)
             return prog
     _count("miss")
     fn = builder()
@@ -585,6 +589,12 @@ def acquire(key: Tuple, builder: Callable[[], object], args,
             lowered = lowerer() if lowerer is not None else fn.lower(*args)
             compiled = lowered.compile()
             prog = AotProgram(compiled, builder=lambda: fn)
+            from quokka_tpu.obs import devprof
+
+            # static flops/bytes from the fresh executable, persisted in a
+            # sidecar next to the AOT artifact under the same key
+            devprof.record_cost(key, compiled,
+                                _entry_path(key, create=True))
             _ensure_writer()
             _write_q.put((key, compiled))
         except Exception:  # noqa: BLE001 — AOT is an optimization layer:
@@ -621,6 +631,9 @@ def aot_kernel_call(kind: str, jit_fn, args: Tuple, statics: Tuple = ()):
                 return jit_fn
         prog = acquire(key, builder, args,
                        lowerer=lambda: jit_fn.lower(*args, *statics))
+    from quokka_tpu.obs import devprof
+
+    devprof.on_dispatch(key)
     try:
         return prog(*args)
     except AotMismatch:
@@ -660,6 +673,9 @@ def _install_hash(h: str) -> bool:
             _KEY_BY_HASH[h] = key
         if key not in PROGRAMS:
             PROGRAMS[key] = AotProgram(compiled, prewarmed=True)
+        from quokka_tpu.obs import devprof
+
+        devprof.load_cost(key, path)
         ok = True
         return True
     finally:

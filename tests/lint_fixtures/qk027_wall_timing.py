@@ -1,0 +1,37 @@
+"""QK027 fixture: three hand-rolled wall-clock deltas (dotted perf_counter,
+time.time name pair, bare imported perf_counter).  Deadline arithmetic must
+NOT fire."""
+
+import time
+from time import perf_counter
+
+
+def work():
+    return sum(range(10))
+
+
+def dotted_delta():
+    t0 = time.perf_counter()
+    work()
+    dt = time.perf_counter() - t0  # QK027
+    return dt
+
+
+def name_pair():
+    a = time.time()
+    work()
+    b = time.time()
+    return b - a  # QK027
+
+
+def bare_imported():
+    s = perf_counter()
+    work()
+    return perf_counter() - s  # QK027
+
+
+def deadline_ok():
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        work()
+    return deadline - time.monotonic()  # monotonic deadline: not flagged

@@ -1,0 +1,432 @@
+"""Device-time & roofline plane (obs/devprof.py + planner/cost.py seconds
+basis): static cost extraction, calibration profile lifecycle, roofline
+math, snapshot attachment, Prometheus families, and the per-query skew
+gauge reset (the never-shrinking global max regression)."""
+
+import json
+import os
+
+import pytest
+
+from quokka_tpu import obs
+from quokka_tpu import logical
+from quokka_tpu.obs import devprof
+from quokka_tpu.obs import explain
+from quokka_tpu.obs import export
+from quokka_tpu.obs import opstats
+from quokka_tpu.obs.metrics import Registry
+from quokka_tpu.obs.opstats import OpStats
+from quokka_tpu.planner import cost as pcost
+
+
+class _Compiled:
+    """Stands in for a compiled executable: cost_analysis() returns the
+    list-of-dicts shape jax produces."""
+
+    def __init__(self, flops=1000.0, nbytes=500.0, out=100.0):
+        self._ca = {"flops": flops, "bytes accessed": nbytes,
+                    "bytes accessedout{}": out}
+
+    def cost_analysis(self):
+        return [self._ca]
+
+
+class _Broken:
+    def cost_analysis(self):
+        raise RuntimeError("no analysis on this backend")
+
+
+@pytest.fixture(autouse=True)
+def _clean_devprof():
+    devprof.reset()
+    yield
+    devprof.reset()
+    if hasattr(opstats._CUR, "key"):
+        del opstats._CUR.key
+
+
+# -- static cost extraction ---------------------------------------------------
+
+
+class TestCostExtraction:
+    def test_known_answer_from_real_executable(self):
+        """XLA's static figures for a 128x128 f32 matmul+add: 2*n^3 + n^2
+        flops; exactly what the bench smoke relies on for every fused
+        program."""
+        import jax
+        import jax.numpy as jnp
+
+        n = 128
+        a = jnp.ones((n, n), dtype=jnp.float32)
+        fn = jax.jit(lambda x, y: x @ y + y)
+        compiled = fn.lower(a, a).compile()
+        cost = devprof.extract_cost(compiled)
+        assert cost is not None
+        assert cost["flops"] == 2 * n**3 + n**2  # 4210688
+        assert cost["bytes"] > 0
+        assert cost["out_bytes"] >= n * n * 4  # at least the f32 result
+
+    def test_extract_handles_failure_and_junk(self):
+        assert devprof.extract_cost(_Broken()) is None
+        c = devprof.extract_cost(
+            _Compiled(flops=float("nan"), nbytes=-5, out=0))
+        assert c == {"flops": 0.0, "bytes": 0.0, "out_bytes": 0.0}
+
+    def test_record_and_sidecar_roundtrip(self, tmp_path):
+        art = str(tmp_path / "prog.bin")
+        before = obs.REGISTRY.counter("devprof.programs_costed").value
+        devprof.record_cost("k1", _Compiled(), path=art)
+        assert devprof.program_cost("k1") == {
+            "flops": 1000.0, "bytes": 500.0, "out_bytes": 100.0}
+        assert obs.REGISTRY.counter(
+            "devprof.programs_costed").value == before + 1
+        sidecar = art + ".cost.json"
+        assert os.path.exists(sidecar)
+        # cache-hit replay: fresh process state loads the sidecar verbatim
+        devprof.reset()
+        assert devprof.program_cost("k1") is None
+        assert devprof.load_cost("k1", art) is True
+        assert devprof.program_cost("k1")["flops"] == 1000.0
+
+    def test_corrupt_sidecar_leaves_program_uncosted(self, tmp_path):
+        art = str(tmp_path / "prog.bin")
+        with open(art + ".cost.json", "w") as f:
+            f.write("{not json")
+        assert devprof.load_cost("k1", art) is False
+        with open(art + ".cost.json", "w") as f:
+            json.dump({"version": 999, "flops": 1, "bytes": 1,
+                       "out_bytes": 0}, f)
+        assert devprof.load_cost("k1", art) is False
+        assert devprof.program_cost("k1") is None
+
+    def test_costs_snapshot_sorts_and_tallies(self):
+        devprof.record_cost(("a",), _Compiled(flops=10.0))
+        devprof.record_cost(("b",), _Compiled(flops=99.0, nbytes=11.0))
+        devprof.on_dispatch(("b",))
+        devprof.on_dispatch(("b",))
+        snap = devprof.costs_snapshot()
+        assert [r["flops"] for r in snap] == [99.0, 10.0]
+        assert snap[0]["dispatches"] == 2
+        assert snap[0]["intensity"] == 99.0 / 11.0
+
+
+# -- calibration profile lifecycle --------------------------------------------
+
+
+class TestCalibration:
+    def test_calibrate_persists_and_reloads(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("QK_DEVPROF_DIR", str(tmp_path))
+        prof = devprof.calibrate()
+        assert prof["peak_flops_s"] > 0 and prof["peak_bw_bytes_s"] > 0
+        path = os.path.join(str(tmp_path), f"{prof['fingerprint']}.json")
+        assert os.path.exists(path)
+        # peaks mirrored onto gauges for /metrics
+        assert obs.REGISTRY.gauge("devprof.peak_flops").value == \
+            prof["peak_flops_s"]
+        # a fresh process (reset) lazily reloads the same profile
+        devprof.reset()
+        p2 = devprof.peaks()
+        assert p2 is not None and p2["peak_flops_s"] == prof["peak_flops_s"]
+        assert devprof.planning_bw() == prof["peak_bw_bytes_s"]
+
+    def test_foreign_fingerprint_rejected_wholesale(self, tmp_path,
+                                                    monkeypatch):
+        monkeypatch.setenv("QK_DEVPROF_DIR", str(tmp_path))
+        prof = devprof.calibrate()
+        path = os.path.join(str(tmp_path), f"{prof['fingerprint']}.json")
+        data = json.load(open(path))
+        data["fingerprint"] = "tpu-8x-deadbeef"
+        os.rename(path, os.path.join(
+            str(tmp_path), f"{devprof._fingerprint()}.json"))
+        json.dump(data, open(path, "w"))
+        devprof.reset()
+        assert devprof.peaks() is None
+        assert devprof.planning_bw() is None
+
+    def test_corrupt_or_versioned_away_profile_rejected(self, tmp_path,
+                                                        monkeypatch):
+        monkeypatch.setenv("QK_DEVPROF_DIR", str(tmp_path))
+        path = os.path.join(str(tmp_path), f"{devprof._fingerprint()}.json")
+        with open(path, "w") as f:
+            f.write("{torn write")
+        assert devprof.peaks() is None
+        devprof.reset()
+        json.dump({"version": -1, "fingerprint": devprof._fingerprint(),
+                   "peak_flops_s": 1.0, "peak_bw_bytes_s": 1.0,
+                   "sources": {}}, open(path, "w"))
+        assert devprof.peaks() is None
+
+    def test_ensure_calibrated_honors_skip_knob(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("QK_DEVPROF_DIR", str(tmp_path))
+        monkeypatch.setenv("QK_DEVPROF_CALIBRATE", "0")
+        assert devprof.ensure_calibrated() == {}
+        assert devprof.peaks() is None
+
+    def test_persistence_disabled_by_empty_dir(self, monkeypatch):
+        monkeypatch.setenv("QK_DEVPROF_DIR", "")
+        assert devprof._dir() is None
+        prof = devprof.calibrate()  # in-process only, no file writes
+        assert prof["peak_flops_s"] > 0
+        devprof.reset()
+        assert devprof.peaks() is None  # nothing persisted to reload
+
+
+# -- roofline math ------------------------------------------------------------
+
+
+class TestRoofline:
+    def test_compute_bound(self):
+        r = devprof.roofline(1e9, 1e6, 1.0, 1e10, 1e9)
+        assert r["intensity"] == 1000.0
+        assert r["achieved_flops_s"] == 1e9
+        # intensity*bw = 1e12 > peak 1e10 -> judged against the FLOP ceiling
+        assert r["efficiency"] == pytest.approx(0.1)
+
+    def test_memory_bound(self):
+        r = devprof.roofline(1e6, 1e9, 1.0, 1e12, 1e10)
+        # attainable = intensity(1e-3) * bw(1e10) = 1e7 FLOP/s
+        assert r["efficiency"] == pytest.approx(1e6 / 1e7)
+        assert r["achieved_bw_s"] == 1e9
+
+    def test_pure_data_movement_judged_on_bandwidth(self):
+        r = devprof.roofline(0.0, 5e8, 1.0, 1e12, 1e9)
+        assert r["intensity"] == 0.0
+        assert r["achieved_flops_s"] is None
+        assert r["efficiency"] == pytest.approx(0.5)
+
+    def test_degenerate_inputs(self):
+        assert devprof.roofline(0, 0, 1.0, 1e9, 1e9)["efficiency"] is None
+        assert devprof.roofline(1e6, 1e6, None, 1e9, 1e9)["efficiency"] is \
+            None
+        assert devprof.roofline(1e6, 1e6, 0.0, 1e9, 1e9)["efficiency"] is \
+            None
+        # uncalibrated: achieved rates still reported, efficiency unknowable
+        r = devprof.roofline(1e6, 1e6, 1.0, None, None)
+        assert r["achieved_flops_s"] == 1e6 and r["efficiency"] is None
+
+
+# -- seconds basis in the cost model ------------------------------------------
+
+
+class _Reader:
+    def __init__(self, hint=80000):
+        self._hint = hint
+
+    def size_hint(self):
+        return self._hint
+
+
+def _source_plan(rows_measured=None):
+    src = logical.SourceNode(_Reader(), ["k", "v"])
+    sub = {0: src}
+    sig = pcost.source_signature(src.reader, None, None)
+    profile = {}
+    if rows_measured is not None:
+        profile[sig] = {"rows": rows_measured, "bytes": rows_measured * 16.0}
+    return sub, sig, profile
+
+
+def _install_peaks(bw=1e10, sources=None):
+    devprof._install({
+        "version": 1, "fingerprint": "test-fp",
+        "peak_flops_s": 1e12, "peak_bw_bytes_s": bw,
+        "sources": sources or {},
+    })
+
+
+class TestSecondsBasis:
+    def test_hint_when_uncalibrated(self):
+        sub, _, profile = _source_plan(rows_measured=1000)
+        model = pcost.CostModel(sub, profile=profile)
+        sec = model.estimate_seconds(0)
+        assert sec.basis == pcost.SECONDS_HINT
+        assert sec.seconds == pytest.approx(16000.0 / pcost._NOMINAL_BW)
+        assert not pcost.seconds_usable(sec.basis)
+
+    def test_roofline_conversion_over_measured_bytes(self):
+        sub, _, profile = _source_plan(rows_measured=1000)
+        _install_peaks(bw=1e10)
+        model = pcost.CostModel(sub, profile=profile)
+        sec = model.estimate_seconds(0)
+        assert sec.basis == pcost.SECONDS_ROOFLINE
+        assert sec.seconds == pytest.approx(16000.0 / 1e10)
+        assert pcost.seconds_usable(sec.basis)
+
+    def test_measured_scan_seconds_win(self):
+        sub, sig, profile = _source_plan(rows_measured=1000)
+        _install_peaks(sources={sig: {"seconds": 0.125, "bytes": 16000.0}})
+        model = pcost.CostModel(sub, profile=profile)
+        sec = model.estimate_seconds(0)
+        assert sec.basis == pcost.SECONDS_MEASURED
+        assert sec.seconds == 0.125
+
+    def test_conversion_capped_by_cardinality_basis(self):
+        """Converting *guessed* bytes through a calibrated peak is still a
+        guess: the seconds basis can never outrank the rows/bytes basis."""
+        sub, _, profile = _source_plan(rows_measured=None)  # hint-only
+        _install_peaks(bw=1e10)
+        model = pcost.CostModel(sub, profile={})
+        sec = model.estimate_seconds(0)
+        assert sec.est.basis == pcost.BASIS_HINT
+        assert sec.basis == pcost.SECONDS_HINT
+        assert not pcost.seconds_usable(sec.basis)
+
+    def test_observed_bandwidth_preferred_over_calibrated_peak(self):
+        devprof._install({
+            "version": 1, "fingerprint": "test-fp",
+            "peak_flops_s": 1e12, "peak_bw_bytes_s": 1e10,
+            "observed_bw_bytes_s": 2e9, "sources": {},
+        })
+        assert devprof.planning_bw() == 2e9
+
+
+# -- snapshot attachment + explain render -------------------------------------
+
+
+class _Actor:
+    def __init__(self, kind, channels=2, targets=(), stage=0):
+        self.kind = kind
+        self.channels = channels
+        self.targets = {t: None for t in targets}
+        self.stage = stage
+        self.reader = _Reader()  # input actors carry their reader
+
+
+class _Graph:
+    def __init__(self, qid, actors, plan_fp="fp-test"):
+        self.query_id = qid
+        self.actors = actors
+        self.plan_fp = plan_fp
+
+
+def _run_attributed_query(s, qid="qeff"):
+    """One operator (actor 1) runs 0.5s and dispatches a costed program
+    twice: 2000 flops over 1000 bytes."""
+    s.register_plan(_Graph(qid, {
+        0: _Actor("input", targets=(1,)),
+        1: _Actor("exec", stage=1),
+    }))
+    devprof.record_cost("prog", _Compiled(flops=1000.0, nbytes=500.0))
+    opstats._CUR.key = (qid, 1, 0)
+    devprof.on_dispatch("prog")
+    devprof.on_dispatch("prog")
+    del opstats._CUR.key
+    s.dispatch_time(qid, 1, 0, 0.5)
+    s.exec_out(qid, 1, 0, 10)
+
+
+class TestAttach:
+    def test_snapshot_gains_efficiency_section(self):
+        _install_peaks(bw=1e10)
+        s = OpStats()
+        _run_attributed_query(s)
+        snap = s.snapshot("qeff")
+        eff = snap["efficiency"]
+        assert eff["peaks"]["fingerprint"] == "test-fp"
+        (row,) = [r for r in eff["operators"] if r["actor"] == 1]
+        assert row["flops"] == 2000.0 and row["bytes"] == 1000.0
+        assert row["program_dispatches"] == 2
+        assert row["achieved_flops_s"] == pytest.approx(4000.0)
+        # intensity 2.0 -> attainable = 2 * 1e10 = 2e10 (memory-bound)
+        assert row["efficiency"] == pytest.approx(4000.0 / 2e10)
+        assert row["flagged"] is True  # far below the 5% floor
+        g = obs.REGISTRY.gauge("devprof.eff.qeff.a1")
+        assert g.value == pytest.approx(row["efficiency"])
+        # explain() renders the section with the floor flag
+        text = explain.render(snap)
+        assert "device efficiency" in text
+        assert "** BELOW QK_EFF_FLOOR **" in text
+        assert "roofline=" in text
+        det = explain.efficiency_detail(snap)
+        assert det["operators"][0]["efficiency"] == row["efficiency"]
+        s.reset()
+
+    def test_uncalibrated_attach_still_reports_rates(self):
+        s = OpStats()
+        _run_attributed_query(s, qid="qunc")
+        snap = s.snapshot("qunc")
+        (row,) = [r for r in snap["efficiency"]["operators"]
+                  if r["actor"] == 1]
+        assert row["achieved_flops_s"] == pytest.approx(4000.0)
+        assert row["efficiency"] is None and row["flagged"] is False
+        assert "uncalibrated" in explain.render(snap)
+        s.reset()
+
+    def test_query_gc_drops_attribution_and_gauges(self):
+        _install_peaks()
+        s = OpStats()
+        _run_attributed_query(s, qid="qgc")
+        s.snapshot("qgc")
+        assert "devprof.eff.qgc.a1" in obs.REGISTRY.snapshot()
+        s.on_query_gc("qgc")
+        assert "devprof.eff.qgc.a1" not in obs.REGISTRY.snapshot()
+        with devprof._lock:
+            assert not any(k[0] == "qgc" for k in devprof._attr)
+        s.reset()
+
+    def test_summary_digest(self):
+        _install_peaks()
+        devprof.record_cost("p", _Compiled())
+        devprof.on_dispatch("p")
+        d = devprof.summary()
+        assert d["calibrated"] is True
+        assert d["programs_costed"] == 1 and d["program_dispatches"] == 1
+
+
+# -- Prometheus families ------------------------------------------------------
+
+
+class TestPromFamilies:
+    def test_roofline_gauge_renders_as_labeled_family(self):
+        r = Registry()
+        r.gauge('devprof.eff.q"1.a0').set(0.25)
+        text = export.render(r)
+        assert ('quokka_devprof_roofline_efficiency'
+                '{op="q\\"1.a0"} 0.25') in text
+
+    def test_peaks_render_as_exact_families(self):
+        r = Registry()
+        r.gauge("devprof.peak_flops").set(1e12)
+        r.gauge("devprof.peak_bw_bytes").set(5e10)
+        text = export.render(r)
+        assert "quokka_devprof_peak_flops 1000000000000" in text
+        assert "quokka_devprof_peak_bw_bytes 50000000000" in text
+        # the process-wide peaks must never fold into the labeled family
+        assert 'quokka_devprof_peak_flops{' not in text
+
+    def test_programs_costed_counter_renders(self):
+        r = Registry()
+        r.counter("devprof.programs_costed").inc(3)
+        text = export.render(r)
+        assert "quokka_devprof_programs_costed_total 3" in text
+
+
+# -- satellite: per-query skew gauge reset ------------------------------------
+
+
+class TestSkewGaugeReset:
+    def test_global_skew_gauge_tracks_live_queries_only(self):
+        """Regression: the global shuffle.skew gauge was a process-lifetime
+        ratchet (set(max(old, new))) — one skewed query pinned it forever
+        and /health skew alerts never cleared.  It must drop to the worst
+        LIVE query at GC, and to 0 when idle."""
+        s = OpStats()
+        for qid in ("qa", "qb"):
+            s.register_plan(_Graph(qid, {
+                0: _Actor("input", targets=(1,)),
+                1: _Actor("exec", stage=1),
+            }))
+        # qa: 900/100 over 2 channels -> ratio 1.8; qb: 600/400 -> 1.2
+        s.edge("qa", 0, 1, 0, 900)
+        s.edge("qa", 0, 1, 1, 100)
+        s.edge("qb", 0, 1, 0, 600)
+        s.edge("qb", 0, 1, 1, 400)
+        s.snapshot("qa")
+        s.snapshot("qb")
+        g = obs.REGISTRY.gauge("shuffle.skew")
+        assert g.value == pytest.approx(1.8)
+        s.on_query_gc("qa")
+        assert g.value == pytest.approx(1.2)  # worst LIVE query, not ratchet
+        s.on_query_gc("qb")
+        assert g.value == 0.0
+        s.reset()

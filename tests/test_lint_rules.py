@@ -31,6 +31,7 @@ CASES = [
     ("QK019", "qk019_row_tally.py", 3),      # attr +=, dict-slot +=, .get RMW
     ("QK020", "qk020_program_chain.py", 3),  # loop dispatch, straight #3, #4
     ("QK025", "qk025_lock_io.py", 3),        # open, sleep, helper->open
+    ("QK027", "qk027_wall_timing.py", 3),    # dotted, name pair, bare
 ]
 
 
